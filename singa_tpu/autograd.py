@@ -1,0 +1,1221 @@
+"""Define-by-run autograd over jnp.
+
+Reference parity: python/singa/autograd.py — `Operator` base (autograd.py:227)
+records `(creator, x_id, y, stores_grad)` per input (:285-294);
+`infer_dependency` counts consumer edges (:71-102); `backward()` is a
+*generator* doing reverse BFS with multi-consumer grad accumulation, yielding
+`(param, grad)` as soon as ready (:128-224) so the optimizer can overlap
+gradient communication with the rest of backward; `Dummy` wraps leaves (:344).
+
+TPU-native redesign: operator forwards are pure jnp/lax functions, so the
+backward rule of almost every op is derived mechanically with `jax.vjp` at
+record time instead of ~90 hand-written rules; fused/hand rules are kept only
+where the math matters (softmax-CE). The whole tape runs under `jax.jit`
+tracing unchanged — Model's graph mode simply traces one step (model.py).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .tensor import Tensor
+from . import tensor as tensor_module
+
+#: global train/eval switch (ref autograd.py `training`)
+training = False
+
+
+def _raw(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _is_float0(a):
+    return getattr(a, "dtype", None) == jax.dtypes.float0
+
+
+class Operator:
+    """Base op. Subclasses implement `forward(self, *arrays) -> array|tuple`.
+
+    Default backward is the vjp of `forward` captured at record time;
+    override `backward(self, *dys)` for fused rules.
+    """
+
+    #: class-level: op can never produce gradients (comparisons, casts, ...)
+    never_requires_grad = False
+
+    def __init__(self, name: str | None = None):
+        self.name = name or self.__class__.__name__
+        self.src = []          # [(src_op, x_id, x_tensor, x_stores_grad)]
+        self.y_id2idx = {}     # id(output tensor) -> output index
+        self.requires_grad = True
+        self._vjp = None
+        self._n_out = 1
+
+    def __call__(self, *xs):
+        return self._do_forward(*xs)
+
+    def _do_forward(self, *xs):
+        assert all(isinstance(x, Tensor) for x in xs), \
+            f"{self.name} inputs must be Tensor, got {[type(x) for x in xs]}"
+        device = xs[0].device
+
+        if training and not self.never_requires_grad:
+            self.requires_grad = any(x.requires_grad for x in xs)
+        else:
+            self.requires_grad = False
+
+        if self.requires_grad:
+            for x in xs:
+                if x.creator is None:
+                    x.creator = Dummy(x)
+                self.src.append((x.creator, id(x), x, x.stores_grad))
+            raw = [x.data for x in xs]
+            if type(self).backward is Operator.backward:
+                ys, self._vjp = jax.vjp(self.forward, *raw)
+            else:
+                ys = self.forward(*raw)
+        else:
+            ys = self.forward(*[x.data for x in xs])
+
+        single = not isinstance(ys, tuple)
+        if single:
+            ys = (ys,)
+        self._n_out = len(ys)
+        self._out_shapes = [(y.shape, y.dtype) for y in ys]
+        outs = []
+        for i, y in enumerate(ys):
+            t = Tensor(data=y, device=device,
+                       requires_grad=self.requires_grad,
+                       creator=self if self.requires_grad else None)
+            self.y_id2idx[id(t)] = i
+            outs.append(t)
+        return outs[0] if single else tuple(outs)
+
+    def forward(self, *xs):
+        raise NotImplementedError
+
+    def backward(self, *dys):
+        """Default: vjp-derived. dys are raw arrays aligned with outputs
+        (missing cotangents already zero-filled by the engine)."""
+        assert self._vjp is not None, f"{self.name} has no recorded vjp"
+        dxs = self._vjp(dys[0] if self._n_out == 1 else tuple(dys))
+        return dxs if len(dxs) > 1 else dxs[0]
+
+
+class Dummy(Operator):
+    """Leaf placeholder (ref autograd.py:344): wraps a parameter/input."""
+
+    def __init__(self, tensor: Tensor, name=None):
+        super().__init__(name or "Dummy")
+        self.tensor = tensor
+        self.y_id2idx = {id(tensor): 0}
+        self.requires_grad = tensor.requires_grad
+        self._n_out = 1
+
+
+def infer_dependency(op: Operator):
+    """Count pending consumer edges per op (ref autograd.py:71-102)."""
+    counts = {op: 0}
+    queue = deque([op])
+    while queue:
+        cur = queue.popleft()
+        for src_op, _, _, _ in cur.src:
+            if src_op.requires_grad:
+                if src_op in counts:
+                    counts[src_op] += 1
+                else:
+                    counts[src_op] = 1
+                    queue.append(src_op)
+    return counts
+
+
+def backward(y: Tensor, dy=None):
+    """Reverse-mode pass from scalar/tensor `y`; GENERATOR yielding
+    `(param_tensor, grad_tensor)` as each param's grad is finalized
+    (ref autograd.py:128-224). This incremental yield is what lets DistOpt
+    start all-reducing late-layer grads while early-layer backward runs.
+    """
+    assert y.creator is not None, "call backward on a tape output in training mode"
+    dependency = infer_dependency(y.creator)
+    if dy is None:
+        dy = jnp.ones(y.shape, dtype=y.dtype)
+    else:
+        dy = _raw(dy)
+
+    not_ready = {}  # op -> [grad per output]
+    ready = deque([(y.creator, [dy])])
+    visited = {y.creator}
+
+    while ready:
+        op, dys = ready.popleft()
+        if isinstance(op, Dummy):
+            continue
+        # zero-fill output cotangents that never received a gradient
+        full = [dys[i] if i < len(dys) else None for i in range(op._n_out)]
+        filled = [g if g is not None else jnp.zeros(s, d)
+                  for g, (s, d) in zip(full, op._out_shapes)]
+        dxs = op.backward(*filled)
+        if not isinstance(dxs, (tuple, list)):
+            dxs = (dxs,)
+        assert len(dxs) == len(op.src), \
+            f"{op.name}: {len(dxs)} grads for {len(op.src)} inputs"
+
+        for (src_op, x_id, x_tensor, x_stores_grad), dx in zip(op.src, dxs):
+            if not src_op.requires_grad:
+                continue
+            if dx is not None and not _is_float0(dx):
+                y_idx = src_op.y_id2idx[x_id]
+                slots = not_ready.setdefault(src_op, [None] * src_op._n_out)
+                slots[y_idx] = dx if slots[y_idx] is None \
+                    else slots[y_idx] + dx
+            dependency[src_op] -= 1
+            if dependency[src_op] == 0:
+                # Completion is uniform regardless of whether the LAST edge
+                # carried a real cotangent or a None/float0 one — a Dummy
+                # param still yields the grads accumulated from its other
+                # consumers, and an op queued with partial slots zero-fills
+                # the rest (so upstream params never stall).
+                slots = not_ready.pop(src_op, None)
+                if isinstance(src_op, Dummy):
+                    if x_stores_grad and slots is not None \
+                            and slots[0] is not None:
+                        yield (x_tensor,
+                               Tensor(data=slots[0], device=x_tensor.device,
+                                      requires_grad=False))
+                elif src_op not in visited:
+                    visited.add(src_op)
+                    ready.append((src_op,
+                                  slots if slots is not None else []))
+
+
+def gradients(y: Tensor, dy=None):
+    """Run full backward; return {param_tensor: grad_tensor} (ref :105)."""
+    grads = {}
+    for p, g in backward(y, dy):
+        grads[p] = g
+    return grads
+
+
+# ======================= operator zoo =====================================
+# Class names and functional wrappers match the reference inventory
+# (SURVEY.md §2.8, python/singa/autograd.py). Forwards are jnp; backward is
+# vjp-derived unless overridden.
+
+
+def _functional(op_cls):
+    def f(*xs, **kwargs):
+        return op_cls(**kwargs)(*xs)
+    f.__name__ = op_cls.__name__.lower()
+    return f
+
+
+# ---- arithmetic / logic --------------------------------------------------
+
+class Add(Operator):
+    def forward(self, a, b):
+        return a + b
+
+
+class Sub(Operator):
+    def forward(self, a, b):
+        return a - b
+
+
+class Mul(Operator):
+    def forward(self, a, b):
+        return a * b
+
+
+class Div(Operator):
+    def forward(self, a, b):
+        return a / b
+
+
+class Pow(Operator):
+    def forward(self, a, b):
+        return jnp.power(a, b)
+
+
+class Negative(Operator):
+    def forward(self, x):
+        return -x
+
+
+class Reciprocal(Operator):
+    def forward(self, x):
+        return 1.0 / x
+
+
+class Abs(Operator):
+    def forward(self, x):
+        return jnp.abs(x)
+
+
+class Sign(Operator):
+    never_requires_grad = True
+
+    def forward(self, x):
+        return jnp.sign(x)
+
+
+class Exp(Operator):
+    def forward(self, x):
+        return jnp.exp(x)
+
+
+class Log(Operator):
+    def forward(self, x):
+        return jnp.log(x)
+
+
+class Sqrt(Operator):
+    def forward(self, x):
+        return jnp.sqrt(x)
+
+
+class _BoolBinary(Operator):
+    never_requires_grad = True
+    _fn = None
+
+    def forward(self, a, b):
+        return type(self)._fn(a.astype(bool), b.astype(bool)).astype(jnp.float32)
+
+
+class And(_BoolBinary):
+    _fn = staticmethod(jnp.logical_and)
+
+
+class Or(_BoolBinary):
+    _fn = staticmethod(jnp.logical_or)
+
+
+class Xor(_BoolBinary):
+    _fn = staticmethod(jnp.logical_xor)
+
+
+class Not(Operator):
+    never_requires_grad = True
+
+    def forward(self, x):
+        return jnp.logical_not(x.astype(bool)).astype(jnp.float32)
+
+
+class _CmpBinary(Operator):
+    never_requires_grad = True
+    _fn = None
+
+    def forward(self, a, b):
+        return type(self)._fn(a, b).astype(jnp.float32)
+
+
+class Less(_CmpBinary):
+    _fn = staticmethod(jnp.less)
+
+
+class Greater(_CmpBinary):
+    _fn = staticmethod(jnp.greater)
+
+
+class Equal(_CmpBinary):
+    _fn = staticmethod(jnp.equal)
+
+
+# ---- activations ---------------------------------------------------------
+
+class ReLU(Operator):
+    def forward(self, x):
+        return jax.nn.relu(x)
+
+
+class LeakyRelu(Operator):
+    def __init__(self, a=0.01):
+        super().__init__()
+        self.a = a
+
+    def forward(self, x):
+        return jax.nn.leaky_relu(x, self.a)
+
+
+class Elu(Operator):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return jax.nn.elu(x, self.alpha)
+
+
+class SeLU(Operator):
+    def __init__(self, alpha=1.67326, gamma=1.0507):
+        super().__init__()
+        self.alpha, self.gamma = alpha, gamma
+
+    def forward(self, x):
+        return self.gamma * jnp.where(x > 0, x,
+                                      self.alpha * (jnp.exp(x) - 1.0))
+
+
+class PRelu(Operator):
+    def forward(self, x, slope):
+        return jnp.where(x > 0, x, slope * x)
+
+
+class Sigmoid(Operator):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class HardSigmoid(Operator):
+    def __init__(self, alpha=0.2, gamma=0.5):
+        super().__init__()
+        self.alpha, self.gamma = alpha, gamma
+
+    def forward(self, x):
+        return jnp.clip(self.alpha * x + self.gamma, 0.0, 1.0)
+
+
+class SoftMax(Operator):
+    def __init__(self, axis=1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return jax.nn.softmax(x, axis=self.axis)
+
+
+class SoftPlus(Operator):
+    def forward(self, x):
+        return jax.nn.softplus(x)
+
+
+class SoftSign(Operator):
+    def forward(self, x):
+        return x / (1.0 + jnp.abs(x))
+
+
+class Tanh(Operator):
+    def forward(self, x):
+        return jnp.tanh(x)
+
+
+def _trig(name, fn):
+    cls = type(name, (Operator,),
+               {"forward": (lambda self, x, _f=fn: _f(x))})
+    return cls
+
+
+Cos = _trig("Cos", jnp.cos)
+Cosh = _trig("Cosh", jnp.cosh)
+Acos = _trig("Acos", jnp.arccos)
+Acosh = _trig("Acosh", jnp.arccosh)
+Sin = _trig("Sin", jnp.sin)
+Sinh = _trig("Sinh", jnp.sinh)
+Asin = _trig("Asin", jnp.arcsin)
+Asinh = _trig("Asinh", jnp.arcsinh)
+Tan = _trig("Tan", jnp.tan)
+Atan = _trig("Atan", jnp.arctan)
+Atanh = _trig("Atanh", jnp.arctanh)
+Erf = _trig("Erf", jax.scipy.special.erf)
+
+
+# ---- shape / indexing ----------------------------------------------------
+
+class Reshape(Operator):
+    def __init__(self, shape):
+        super().__init__()
+        self.shape = tuple(int(s) for s in shape)
+
+    def forward(self, x):
+        shape = self.shape
+        if -1 in shape:
+            known = -int(np.prod(shape))
+            shape = tuple(int(x.size // known) if s == -1 else s for s in shape)
+        return x.reshape(shape)
+
+
+class Flatten(Operator):
+    def __init__(self, axis=1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        a = self.axis if self.axis >= 0 else x.ndim + self.axis
+        lead = int(np.prod(x.shape[:a])) if a > 0 else 1
+        return x.reshape(lead, -1)
+
+
+class Squeeze(Operator):
+    def __init__(self, axis=None):
+        super().__init__()
+        self.axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def forward(self, x):
+        return jnp.squeeze(x, axis=self.axis)
+
+
+class Unsqueeze(Operator):
+    def __init__(self, axis):
+        super().__init__()
+        self.axis = axis if isinstance(axis, (list, tuple)) else [axis]
+
+    def forward(self, x):
+        for a in sorted(self.axis):
+            x = jnp.expand_dims(x, a)
+        return x
+
+
+class Transpose(Operator):
+    def __init__(self, perm=None):
+        super().__init__()
+        self.perm = tuple(perm) if perm is not None else None
+
+    def forward(self, x):
+        return jnp.transpose(x, self.perm)
+
+
+class Concat(Operator):
+    def __init__(self, axis=0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, *xs):
+        return jnp.concatenate(xs, axis=self.axis)
+
+
+class Slice(Operator):
+    def __init__(self, starts, ends, axes=None, steps=None):
+        super().__init__()
+        self.starts, self.ends = list(starts), list(ends)
+        self.axes = list(axes) if axes is not None else list(range(len(starts)))
+        self.steps = list(steps) if steps is not None else [1] * len(starts)
+
+    def forward(self, x):
+        import builtins
+        idx = [builtins.slice(None)] * x.ndim
+        for s, e, a, st in zip(self.starts, self.ends, self.axes, self.steps):
+            dim = x.shape[a]
+            e = builtins.min(e, dim) if e >= 0 else e
+            idx[a] = builtins.slice(s, e, st)
+        return x[tuple(idx)]
+
+
+class Split(Operator):
+    def __init__(self, axis, parts):
+        super().__init__()
+        self.axis, self.parts = axis, list(parts)
+
+    def forward(self, x):
+        offs = np.cumsum([0] + self.parts)
+        return tuple(lax.slice_in_dim(x, int(offs[i]), int(offs[i + 1]),
+                                      axis=self.axis)
+                     for i in range(len(self.parts)))
+
+
+class Gather(Operator):
+    def __init__(self, axis, indices):
+        super().__init__()
+        self.axis = axis
+        self.indices = jnp.asarray(indices, dtype=jnp.int32)
+
+    def forward(self, x):
+        return jnp.take(x, self.indices, axis=self.axis)
+
+
+class Tile(Operator):
+    def __init__(self, repeats):
+        super().__init__()
+        self.repeats = tuple(repeats)
+
+    def forward(self, x):
+        return jnp.tile(x, self.repeats)
+
+
+class Expand(Operator):
+    def __init__(self, shape):
+        super().__init__()
+        self.shape = tuple(shape)
+
+    def forward(self, x):
+        return jnp.broadcast_to(x, jnp.broadcast_shapes(x.shape, self.shape))
+
+
+class Pad(Operator):
+    def __init__(self, mode, pads, constant=0.0):
+        super().__init__()
+        self.mode = {"constant": "constant", "reflect": "reflect",
+                     "edge": "edge"}[mode]
+        self.pads = list(pads)
+        self.constant = constant
+
+    def forward(self, x):
+        n = x.ndim
+        width = [(int(self.pads[i]), int(self.pads[i + n])) for i in range(n)]
+        if self.mode == "constant":
+            return jnp.pad(x, width, mode="constant",
+                           constant_values=self.constant)
+        return jnp.pad(x, width, mode=self.mode)
+
+
+class UpSample(Operator):
+    def __init__(self, scales, mode="nearest"):
+        super().__init__()
+        self.scales = [float(s) for s in scales]
+        assert mode == "nearest", "only nearest upsample supported"
+
+    def forward(self, x):
+        for a, s in enumerate(self.scales):
+            if s != 1.0:
+                x = jnp.repeat(x, int(s), axis=a)
+        return x
+
+
+class DepthToSpace(Operator):
+    def __init__(self, blocksize, mode="DCR"):
+        super().__init__()
+        self.b, self.mode = blocksize, mode
+
+    def forward(self, x):
+        n, c, h, w = x.shape
+        b = self.b
+        if self.mode == "DCR":
+            y = x.reshape(n, b, b, c // (b * b), h, w)
+            y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))
+        else:  # CRD
+            y = x.reshape(n, c // (b * b), b, b, h, w)
+            y = jnp.transpose(y, (0, 1, 4, 2, 5, 3))
+        return y.reshape(n, c // (b * b), h * b, w * b)
+
+
+class SpaceToDepth(Operator):
+    def __init__(self, blocksize):
+        super().__init__()
+        self.b = blocksize
+
+    def forward(self, x):
+        n, c, h, w = x.shape
+        b = self.b
+        y = x.reshape(n, c, h // b, b, w // b, b)
+        y = jnp.transpose(y, (0, 3, 5, 1, 2, 4))
+        return y.reshape(n, c * b * b, h // b, w // b)
+
+
+class Shape(Operator):
+    never_requires_grad = True
+
+    def forward(self, x):
+        return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+class NonZero(Operator):
+    never_requires_grad = True
+
+    def forward(self, x):
+        # NOTE: data-dependent shape -> host fallback; not jittable. Matches
+        # reference which also computes this on concrete tensors.
+        return jnp.asarray(np.array(np.nonzero(np.asarray(x))), dtype=jnp.int64)
+
+
+class Cast(Operator):
+    never_requires_grad = True
+
+    def __init__(self, to):
+        super().__init__()
+        self.to = to
+
+    def forward(self, x):
+        from .tensor import _resolve_dtype
+        return x.astype(_resolve_dtype(self.to))
+
+
+class OneHot(Operator):
+    never_requires_grad = True
+
+    def __init__(self, depth, values=(0.0, 1.0), axis=-1):
+        super().__init__()
+        self.depth, self.values, self.axis = depth, values, axis
+
+    def forward(self, idx):
+        off, on = self.values
+        oh = jax.nn.one_hot(idx.astype(jnp.int32), self.depth, axis=self.axis)
+        return oh * (on - off) + off
+
+
+class ConstantOfShape(Operator):
+    never_requires_grad = True
+
+    def __init__(self, value=0.0, dtype=jnp.float32):
+        super().__init__()
+        self.value, self.dtype = value, dtype
+
+    def forward(self, shape):
+        return jnp.full(tuple(int(s) for s in np.asarray(shape)), self.value,
+                        dtype=self.dtype)
+
+
+class ScatterElements(Operator):
+    def __init__(self, indices, axis=0):
+        super().__init__()
+        self.indices = jnp.asarray(indices, dtype=jnp.int32)
+        self.axis = axis
+
+    def forward(self, x, updates):
+        return jnp.put_along_axis(x, self.indices, updates, axis=self.axis,
+                                  inplace=False)
+
+
+class Where(Operator):
+    def __init__(self, condition):
+        super().__init__()
+        self.condition = _raw(condition).astype(bool)
+
+    def forward(self, a, b):
+        return jnp.where(self.condition, a, b)
+
+
+class Ceil(Operator):
+    never_requires_grad = True
+
+    def forward(self, x):
+        return jnp.ceil(x)
+
+
+class Floor(Operator):
+    never_requires_grad = True
+
+    def forward(self, x):
+        return jnp.floor(x)
+
+
+class Round(Operator):
+    never_requires_grad = True
+
+    def forward(self, x):
+        return jnp.round(x)
+
+
+class Rounde(Operator):
+    """Round half to even (ref autograd.py:5620)."""
+    never_requires_grad = True
+
+    def forward(self, x):
+        return jnp.round(x)  # numpy/jnp round IS half-to-even
+
+
+class Clip(Operator):
+    def __init__(self, min=None, max=None):  # noqa: A002
+        super().__init__()
+        self.min, self.max = min, max
+
+    def forward(self, x):
+        return jnp.clip(x, self.min, self.max)
+
+
+class Identity(Operator):
+    def forward(self, x):
+        return x
+
+
+# ---- reductions ----------------------------------------------------------
+
+class Mean(Operator):
+    def forward(self, *xs):
+        return sum(xs) / len(xs)
+
+
+class Sum(Operator):
+    def forward(self, *xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+
+
+class Min(Operator):
+    def forward(self, a, b):
+        return jnp.minimum(a, b)
+
+
+class Max(Operator):
+    def forward(self, a, b):
+        return jnp.maximum(a, b)
+
+
+class ReduceSum(Operator):
+    def __init__(self, axes=None, keepdims=True):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+        self.keepdims = bool(keepdims)
+
+    def forward(self, x):
+        return jnp.sum(x, axis=self.axes, keepdims=self.keepdims)
+
+
+class ReduceMean(Operator):
+    def __init__(self, axes=None, keepdims=True):
+        super().__init__()
+        self.axes = tuple(axes) if axes is not None else None
+        self.keepdims = bool(keepdims)
+
+    def forward(self, x):
+        return jnp.mean(x, axis=self.axes, keepdims=self.keepdims)
+
+
+# ---- linear algebra ------------------------------------------------------
+
+class Matmul(Operator):
+    def forward(self, a, b):
+        return jnp.matmul(a, b)
+
+
+class Gemm(Operator):
+    def __init__(self, alpha=1.0, beta=1.0, transA=0, transB=0):
+        super().__init__()
+        self.alpha, self.beta = alpha, beta
+        self.transA, self.transB = transA, transB
+
+    def forward(self, A, B, C=None):
+        if self.transA:
+            A = A.T
+        if self.transB:
+            B = B.T
+        y = self.alpha * (A @ B)
+        if C is not None:
+            y = y + self.beta * C
+        return y
+
+
+class AddBias(Operator):
+    def __init__(self, axis=0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x, b):
+        if self.axis == 0:
+            return x + b  # per-column bias (broadcast over rows)
+        return x + b[:, None]
+
+
+class CosSim(Operator):
+    def forward(self, a, b):
+        num = jnp.sum(a * b, axis=-1)
+        den = jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+        return num / den
+
+
+# ---- losses --------------------------------------------------------------
+
+class MeanSquareError(Operator):
+    def forward(self, x, t):
+        # ref autograd.py:1334: 0.5 * ||x-t||^2 / batch
+        return 0.5 * jnp.sum(jnp.square(x - t)) / x.shape[0]
+
+
+class CrossEntropy(Operator):
+    """CE on probabilities (ref autograd.py:1212)."""
+
+    def forward(self, p, t):
+        eps = 1e-10
+        return -jnp.sum(t * jnp.log(p + eps)) / p.shape[0]
+
+
+class BinaryCrossEntropy(Operator):
+    def forward(self, x, t):
+        eps = 1e-10
+        per = -(t * jnp.log(x + eps) + (1 - t) * jnp.log(1 - x + eps))
+        return jnp.sum(per) / x.shape[0]
+
+
+class RankingLoss(Operator):
+    def __init__(self, M=0.2):
+        super().__init__()
+        self.M = M
+
+    def forward(self, pos, neg):
+        return jnp.mean(jnp.maximum(self.M - (pos - neg), 0.0))
+
+
+class SoftMaxCrossEntropy(Operator):
+    """Fused stable softmax-CE with a HAND backward (ref: C++ fused
+    CrossEntropyFwd/Bwd tensor.h:625-637 for exactly this reason)."""
+
+    def __init__(self):
+        super().__init__()
+        self._cache = None
+
+    def forward(self, x, t):
+        self._cache = (x, t)
+        return jnp.mean(tensor_module.softmax_cross_entropy_fwd(x, t))
+
+    def backward(self, dy):
+        x, t = self._cache
+        # mean is over ALL leading dims (per-token for 3D logits), so the
+        # scale is prod(x.shape[:-1]), not just the batch dim
+        n = int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1
+        dx = tensor_module.softmax_cross_entropy_bwd(x, t) * (dy / n)
+        return dx, None  # no grad for targets
+
+
+# ---- NN ops (handle-backed in the reference, §2.6) -----------------------
+
+class _Conv2d(Operator):
+    """Convolution; replaces CudnnConvHandle (convolution.h:105) with
+    lax.conv_general_dilated which XLA tiles onto the MXU."""
+
+    def __init__(self, stride=(1, 1), padding=(0, 0), group=1, odd_padding=None):
+        super().__init__()
+        self.stride = tuple(stride)
+        self.padding = tuple(padding)
+        self.group = group
+        self.odd_padding = odd_padding  # (l, r, t, b) extra pad for "same"
+
+    def forward(self, x, W, b=None):
+        ph, pw = self.padding
+        pad = [(ph, ph), (pw, pw)]
+        if self.odd_padding is not None:
+            l, r, t, bt = self.odd_padding
+            pad = [(ph + t, ph + bt), (pw + l, pw + r)]
+        y = lax.conv_general_dilated(
+            x, W, window_strides=self.stride, padding=pad,
+            feature_group_count=self.group,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
+        if b is not None:
+            y = y + b[None, :, None, None]
+        return y
+
+
+class _BatchNorm2d(Operator):
+    """Train-mode BN: normalizes with batch stats; grads flow through them.
+    Replaces CudnnBatchNormHandle (batchnorm.cc). Running-stat updates are
+    computed functionally by `batchnorm_2d` below (XLA CSEs the duplicate
+    mean/var with the in-op ones under jit)."""
+
+    def __init__(self, eps=1e-5):
+        super().__init__()
+        self.eps = eps
+
+    def forward(self, x, gamma, beta):
+        axes = (0, 2, 3) if x.ndim == 4 else (0,)
+        m = jnp.mean(x, axis=axes)
+        v = jnp.var(x, axis=axes)
+        shape = (1, -1, 1, 1) if x.ndim == 4 else (1, -1)
+        xn = (x - m.reshape(shape)) * lax.rsqrt(v.reshape(shape) + self.eps)
+        return xn * gamma.reshape(shape) + beta.reshape(shape)
+
+
+class _BatchNorm2dInfer(Operator):
+    def __init__(self, eps=1e-5):
+        super().__init__()
+        self.eps = eps
+
+    def forward(self, x, gamma, beta, mean, var):
+        shape = (1, -1, 1, 1) if x.ndim == 4 else (1, -1)
+        xn = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + self.eps)
+        return xn * gamma.reshape(shape) + beta.reshape(shape)
+
+
+class _Pooling2d(Operator):
+    """Max/avg pooling via lax.reduce_window (replaces CudnnPoolingHandle)."""
+
+    def __init__(self, kernel, stride, padding=(0, 0), is_max=True,
+                 count_include_pad=False, odd_padding=None):
+        super().__init__()
+        self.kernel = tuple(kernel)
+        self.stride = tuple(stride)
+        self.padding = tuple(padding)
+        self.is_max = is_max
+        self.count_include_pad = count_include_pad
+        self.odd_padding = odd_padding  # (l, r, t, b) extra for SAME modes
+
+    def forward(self, x):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.padding
+        dims = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+        if self.odd_padding is not None:
+            l, r, t, b = self.odd_padding
+            pads = ((0, 0), (0, 0), (ph + t, ph + b), (pw + l, pw + r))
+        else:
+            pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if self.is_max:
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+                else jnp.iinfo(x.dtype).min
+            return lax.reduce_window(x, init, lax.max, dims, strides, pads)
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        if self.count_include_pad or all(p == (0, 0) for p in pads[2:]):
+            return s / (kh * kw)
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        return s / cnt
+
+
+class GlobalAveragePool(Operator):
+    def forward(self, x):
+        return jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+class Dropout(Operator):
+    def __init__(self, ratio=0.5, key=None):
+        super().__init__()
+        self.ratio = ratio
+        self.key = key
+
+    def forward(self, x):
+        if not training or self.ratio == 0.0:
+            return x
+        assert self.key is not None, "Dropout needs a PRNG key in training"
+        keep = 1.0 - self.ratio
+        mask = jax.random.bernoulli(self.key, keep, x.shape)
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+class Embedding(Operator):
+    """Row gather; vjp yields scatter-add grad for the table
+    (ref autograd.py:5648)."""
+
+    def __init__(self, indices):
+        super().__init__()
+        self.indices = jnp.asarray(_raw(indices), dtype=jnp.int32)
+
+    def forward(self, table):
+        return jnp.take(table, self.indices, axis=0)
+
+
+# ======================= functional wrappers ==============================
+
+add = _functional(Add)
+sub = _functional(Sub)
+mul = _functional(Mul)
+div = _functional(Div)
+negative = _functional(Negative)
+reciprocal = _functional(Reciprocal)
+abs = _functional(Abs)  # noqa: A001
+sign = _functional(Sign)
+exp = _functional(Exp)
+log = _functional(Log)
+sqrt = _functional(Sqrt)
+pow = _functional(Pow)  # noqa: A001
+less = _functional(Less)
+greater = _functional(Greater)
+equal = _functional(Equal)
+
+relu = _functional(ReLU)
+sigmoid = _functional(Sigmoid)
+tanh = _functional(Tanh)
+softplus = _functional(SoftPlus)
+softsign = _functional(SoftSign)
+cos = _functional(Cos)
+cosh = _functional(Cosh)
+acos = _functional(Acos)
+acosh = _functional(Acosh)
+sin = _functional(Sin)
+sinh = _functional(Sinh)
+asin = _functional(Asin)
+asinh = _functional(Asinh)
+tan = _functional(Tan)
+atan = _functional(Atan)
+atanh = _functional(Atanh)
+erf = _functional(Erf)
+matmul = _functional(Matmul)
+cossim = _functional(CosSim)
+identity = _functional(Identity)
+mean = _functional(Mean)
+
+
+def elu(x, alpha=1.0):
+    return Elu(alpha)(x)
+
+
+def selu(x, alpha=1.67326, gamma=1.0507):
+    return SeLU(alpha, gamma)(x)
+
+
+def leakyrelu(x, a=0.01):
+    return LeakyRelu(a)(x)
+
+
+def prelu(x, slope):
+    return PRelu()(x, slope)
+
+
+def hardsigmoid(x, alpha=0.2, gamma=0.5):
+    return HardSigmoid(alpha, gamma)(x)
+
+
+def softmax(x, axis=1):
+    return SoftMax(axis)(x)
+
+
+def reshape(x, shape):
+    return Reshape(shape)(x)
+
+
+def flatten(x, axis=1):
+    return Flatten(axis)(x)
+
+
+def squeeze(x, axis=None):
+    return Squeeze(axis)(x)
+
+
+def unsqueeze(x, axis):
+    return Unsqueeze(axis)(x)
+
+
+def transpose(x, perm=None):
+    return Transpose(perm)(x)
+
+
+def cat(xs, axis=0):
+    return Concat(axis)(*xs)
+
+
+concat = cat
+
+
+def slice(x, starts, ends, axes=None, steps=None):  # noqa: A001
+    return Slice(starts, ends, axes, steps)(x)
+
+
+def split(x, axis, parts):
+    return Split(axis, parts)(x)
+
+
+def gather(x, axis, indices):
+    return Gather(axis, indices)(x)
+
+
+def tile(x, repeats):
+    return Tile(repeats)(x)
+
+
+def expand(x, shape):
+    return Expand(shape)(x)
+
+
+def pad(x, mode, pads, constant=0.0):
+    return Pad(mode, pads, constant)(x)
+
+
+def upsample(x, mode="nearest", scales=None):
+    return UpSample(scales, mode)(x)
+
+
+def depth_to_space(x, blocksize, mode="DCR"):
+    return DepthToSpace(blocksize, mode)(x)
+
+
+def space_to_depth(x, blocksize):
+    return SpaceToDepth(blocksize)(x)
+
+
+def clip(x, min=None, max=None):  # noqa: A002
+    return Clip(min, max)(x)
+
+
+def cast(x, to):
+    return Cast(to)(x)
+
+
+def onehot(depth, indices, values=(0.0, 1.0), axis=-1):
+    return OneHot(depth, values, axis)(indices)
+
+
+def where(condition, a, b):
+    return Where(condition)(a, b)
+
+
+def min(a, b):  # noqa: A001
+    return Min()(a, b)
+
+
+def max(a, b):  # noqa: A001
+    return Max()(a, b)
+
+
+def reduce_sum(x, axes=None, keepdims=True):
+    return ReduceSum(axes, keepdims)(x)
+
+
+def reduce_mean(x, axes=None, keepdims=True):
+    return ReduceMean(axes, keepdims)(x)
+
+
+def gemm(A, B, C=None, alpha=1.0, beta=1.0, transA=0, transB=0):
+    op = Gemm(alpha, beta, transA, transB)
+    return op(A, B) if C is None else op(A, B, C)
+
+
+def add_bias(x, b, axis=0):
+    return AddBias(axis)(x, b)
+
+
+def mse_loss(x, t):
+    return MeanSquareError()(x, t)
+
+
+def cross_entropy(p, t):
+    return CrossEntropy()(p, t)
+
+
+def binary_cross_entropy(x, t):
+    return BinaryCrossEntropy()(x, t)
+
+
+def ranking_loss(pos, neg, M=0.2):
+    return RankingLoss(M)(pos, neg)
+
+
+def softmax_cross_entropy(x, t):
+    return SoftMaxCrossEntropy()(x, t)
+
+
+def conv2d(handle, x, W, b=None):
+    """handle: a layer-owned _Conv2d op-factory carrying geometry (parity
+    with GpuConvForward(handle, ...), model_operation.i)."""
+    op = _Conv2d(handle.stride, handle.padding, handle.group,
+                 handle.odd_padding)
+    return op(x, W, b) if b is not None else op(x, W)
+
+
+def batchnorm_2d(x, gamma, beta, running_mean, running_var, momentum=0.9,
+                 eps=1e-5, train: bool = True):
+    """Returns (y, new_running_mean, new_running_var) — running stats are
+    returned functionally; the Layer assigns them back (TPU-native stand-in
+    for the reference's in-place handle mutation)."""
+    if train:
+        y = _BatchNorm2d(eps)(x, gamma, beta)
+        xd = lax.stop_gradient(x.data)
+        axes = (0, 2, 3) if xd.ndim == 4 else (0,)
+        bm = jnp.mean(xd, axis=axes)
+        bv = jnp.var(xd, axis=axes)
+        new_m = momentum * running_mean.data + (1 - momentum) * bm
+        new_v = momentum * running_var.data + (1 - momentum) * bv
+        return y, new_m, new_v
+    y = _BatchNorm2dInfer(eps)(x, gamma, beta, running_mean, running_var)
+    return y, running_mean.data, running_var.data
+
+
+def pooling_2d(x, kernel, stride, padding=(0, 0), is_max=True,
+               odd_padding=None):
+    return _Pooling2d(kernel, stride, padding, is_max,
+                      odd_padding=odd_padding)(x)
+
+
+def globalaveragepool(x):
+    return GlobalAveragePool()(x)
+
+
+def dropout(x, ratio=0.5):
+    key = x.device.rand_key() if (training and ratio > 0.0) else None
+    return Dropout(ratio, key)(x)
+
+
+def embedding(indices, table):
+    return Embedding(indices)(table)

@@ -1,0 +1,640 @@
+"""Layer API with deferred shape-inferring initialization.
+
+Reference parity: python/singa/layer.py — `LayerMeta` wraps `initialize`
+(run lazily on first forward with concrete input shapes, layer.py:31-64);
+`Layer` base gives name scoping, `get/set_params`, `get/set_states`, and a
+sublayer registry populated through `__setattr__` (layer.py:75-284). The
+layer zoo below matches §2.7 of SURVEY.md name-for-name.
+
+TPU-native redesign: layers own `Tensor` params and call autograd ops whose
+forwards are jnp — under Model's graph mode the whole stack traces into one
+XLA executable, so there is no per-layer kernel dispatch cost to hide.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from . import autograd
+from . import initializer
+from .tensor import Tensor
+from . import tensor as tensor_module
+
+
+class LayerMeta(type):
+    """Wraps forward so initialize() runs once with real input shapes."""
+
+    def __new__(mcs, name, bases, attrs):
+        if "forward" in attrs:
+            inner = attrs["forward"]
+
+            def forward(self, *args, **kwargs):
+                if not self._initialized:
+                    self.initialize(*args, **kwargs)
+                    self._initialized = True
+                return inner(self, *args, **kwargs)
+
+            forward.__wrapped__ = inner
+            attrs["forward"] = forward
+        return super().__new__(mcs, name, bases, attrs)
+
+
+class Layer(metaclass=LayerMeta):
+    sep = "."  # param-name scoping separator (ref layer.py:77)
+
+    def __init__(self, name: str | None = None):
+        # use object.__setattr__ to avoid registry recursion
+        object.__setattr__(self, "_layers", OrderedDict())
+        object.__setattr__(self, "_initialized", False)
+        self.name = name or self.__class__.__name__
+        self._param_names = []   # attribute names holding trainable Tensors
+        self._state_names = []   # attribute names holding non-trainable state
+
+    # ---- registry -------------------------------------------------------
+    def __setattr__(self, key, value):
+        if isinstance(value, Layer):
+            self._layers[key] = value
+        object.__setattr__(self, key, value)
+
+    def _register_param(self, attr: str, t: Tensor):
+        t.requires_grad = True
+        t.stores_grad = True
+        t.name = attr
+        object.__setattr__(self, attr, t)
+        if attr not in self._param_names:
+            self._param_names.append(attr)
+
+    def _register_state(self, attr: str, t: Tensor):
+        t.requires_grad = False
+        t.stores_grad = False
+        t.name = attr
+        object.__setattr__(self, attr, t)
+        if attr not in self._state_names:
+            self._state_names.append(attr)
+
+    # ---- lifecycle ------------------------------------------------------
+    def initialize(self, *args, **kwargs):
+        pass
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    # ---- params / states (ref layer.py:140-220) --------------------------
+    # Names are scoped by *attribute path* (e.g. "conv1.W"), which is what
+    # the reference's __setattr__-based registration produces (layer.py:241)
+    # and what the checkpoint format keys on.
+    def get_params(self) -> "OrderedDict[str, Tensor]":
+        out = OrderedDict()
+        for attr in self._param_names:
+            out[attr] = getattr(self, attr)
+        for key, sub in self._layers.items():
+            for n, t in sub.get_params().items():
+                out[f"{key}{self.sep}{n}"] = t
+        return out
+
+    def set_params(self, params: dict):
+        own = self.get_params()
+        for n, v in params.items():
+            assert n in own, f"unknown param {n}; have {list(own)}"
+            if isinstance(v, Tensor):
+                own[n].copy_from(v)
+            else:
+                own[n].copy_from_numpy(np.asarray(v))
+
+    def get_states(self) -> "OrderedDict[str, Tensor]":
+        out = self.get_params()
+        for attr in self._state_names:
+            out[attr] = getattr(self, attr)
+        for key, sub in self._layers.items():
+            for n, t in sub.get_states().items():
+                out.setdefault(f"{key}{self.sep}{n}", t)
+        return out
+
+    def set_states(self, states: dict):
+        own = self.get_states()
+        for n, v in states.items():
+            if n in own:
+                if isinstance(v, Tensor):
+                    own[n].copy_from(v)
+                else:
+                    own[n].copy_from_numpy(np.asarray(v))
+
+    def sublayers(self):
+        return dict(self._layers)
+
+    # device of params follows input tensors; kept for API parity
+    def device_check(self, *xs):
+        pass
+
+
+# ======================= core layers ======================================
+
+
+class Linear(Layer):
+    """y = x W + b (ref layer.py:287)."""
+
+    def __init__(self, out_features: int, bias: bool = True, name=None):
+        super().__init__(name)
+        self.out_features = out_features
+        self.bias = bias
+
+    def initialize(self, x):
+        in_features = x.shape[-1]
+        W = Tensor((in_features, self.out_features), device=x.device,
+                   dtype=x.dtype)
+        initializer.he_uniform(W)
+        self._register_param("W", W)
+        if self.bias:
+            b = Tensor((self.out_features,), device=x.device, dtype=x.dtype)
+            b.set_value(0.0)
+            self._register_param("b", b)
+
+    def forward(self, x):
+        y = autograd.matmul(x, self.W)
+        if self.bias:
+            y = autograd.add_bias(y, self.b, axis=0)
+        return y
+
+
+class Gemm(Layer):
+    """alpha*A'B' + beta*C with optional transposes (ref layer.py:364)."""
+
+    def __init__(self, nb_kernels, alpha=1.0, beta=1.0, transA=False,
+                 transB=True, bias=True, bias_shape=None, name=None):
+        super().__init__(name)
+        self.nb_kernels = nb_kernels
+        self.alpha, self.beta = alpha, beta
+        self.transA, self.transB = int(transA), int(transB)
+        self.bias = bias
+        self.bias_shape = bias_shape
+
+    def initialize(self, x):
+        fan_in = x.shape[-1] if not self.transA else x.shape[0]
+        # init in (in, out) layout so he_uniform sees the true fan_in, then
+        # lay out as (out, in) when transB
+        W = Tensor((fan_in, self.nb_kernels), device=x.device, dtype=x.dtype)
+        initializer.he_uniform(W)
+        if self.transB:
+            W.data = W.data.T
+        self._register_param("W", W)
+        if self.bias:
+            shape = self.bias_shape or (1, self.nb_kernels)
+            b = Tensor(shape, device=x.device, dtype=x.dtype)
+            b.set_value(0.0)
+            self._register_param("b", b)
+
+    def forward(self, x):
+        if self.bias:
+            return autograd.gemm(x, self.W, self.b, self.alpha, self.beta,
+                                 self.transA, self.transB)
+        return autograd.gemm(x, self.W, None, self.alpha, self.beta,
+                             self.transA, self.transB)
+
+
+class Embedding(Layer):
+    """Token-id -> vector table lookup (ref layer.py:466)."""
+
+    def __init__(self, input_dim, output_dim, initializer_fn=None, name=None):
+        super().__init__(name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.initializer_fn = initializer_fn
+
+    def initialize(self, x):
+        W = Tensor((self.input_dim, self.output_dim), device=x.device,
+                   dtype=tensor_module.float32)
+        (self.initializer_fn or initializer.glorot_uniform)(W)
+        self._register_param("W", W)
+
+    def forward(self, x):
+        return autograd.embedding(x, self.W)
+
+
+class _ConvGeometry:
+    """Carries conv geometry; plays the role of ConvHandle
+    (src/model/operation/convolution.h:43) minus the cuDNN descriptors."""
+
+    def __init__(self, stride, padding, group, odd_padding=None):
+        self.stride = stride
+        self.padding = padding
+        self.group = group
+        self.odd_padding = odd_padding
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+class Conv2d(Layer):
+    """NCHW convolution, optional fused activation (ref layer.py:508; fused
+    relu used by examples/cnn/model/cnn.py:31)."""
+
+    def __init__(self, nb_kernels, kernel_size, stride=1, padding=0,
+                 dilation=1, group=1, bias=True, pad_mode="NOTSET",
+                 activation="NONE", name=None):
+        super().__init__(name)
+        self.nb_kernels = nb_kernels
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride)
+        self.padding = _pair(padding)
+        self.dilation = _pair(dilation)
+        assert self.dilation == (1, 1), "dilation > 1 not yet supported"
+        self.group = group
+        self.bias = bias
+        self.pad_mode = pad_mode
+        self.activation = activation
+
+    def _same_odd_padding(self, x):
+        # ONNX SAME_UPPER/SAME_LOWER: compute per-side pads (l, r, t, b)
+        ih, iw = x.shape[2], x.shape[3]
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        oh, ow = -(-ih // sh), -(-iw // sw)
+        ph = max((oh - 1) * sh + kh - ih, 0)
+        pw = max((ow - 1) * sw + kw - iw, 0)
+        if self.pad_mode == "SAME_UPPER":
+            return (pw // 2, pw - pw // 2, ph // 2, ph - ph // 2)
+        return (pw - pw // 2, pw // 2, ph - ph // 2, ph // 2)
+
+    def initialize(self, x):
+        in_channels = x.shape[1]
+        assert in_channels % self.group == 0
+        w_shape = (self.nb_kernels, in_channels // self.group,
+                   *self.kernel_size)
+        W = Tensor(w_shape, device=x.device, dtype=x.dtype)
+        initializer.he_normal(W)
+        self._register_param("W", W)
+        if self.bias:
+            b = Tensor((self.nb_kernels,), device=x.device, dtype=x.dtype)
+            b.set_value(0.0)
+            self._register_param("b", b)
+        odd = None
+        if self.pad_mode in ("SAME_UPPER", "SAME_LOWER"):
+            odd = self._same_odd_padding(x)
+        self.handle = _ConvGeometry(self.stride, self.padding, self.group, odd)
+
+    def forward(self, x):
+        y = autograd.conv2d(self.handle, x, self.W,
+                            self.b if self.bias else None)
+        if self.activation == "RELU":
+            y = autograd.relu(y)
+        return y
+
+
+class SeparableConv2d(Layer):
+    """Depthwise + pointwise conv (ref layer.py:740)."""
+
+    def __init__(self, nb_kernels, kernel_size, stride=1, padding=0,
+                 bias=False, name=None):
+        super().__init__(name)
+        self.nb_kernels = nb_kernels
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.bias = bias
+
+    def initialize(self, x):
+        in_channels = x.shape[1]
+        self.depthwise = Conv2d(in_channels, self.kernel_size,
+                                stride=self.stride, padding=self.padding,
+                                group=in_channels, bias=self.bias)
+        self.pointwise = Conv2d(self.nb_kernels, 1, bias=self.bias)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+class BatchNorm2d(Layer):
+    """BN over NCHW channel dim; running stats are layer states
+    (ref layer.py:802)."""
+
+    def __init__(self, momentum=0.9, eps=1e-5, name=None):
+        super().__init__(name)
+        self.momentum = momentum
+        self.eps = eps
+
+    def initialize(self, x):
+        c = x.shape[1]
+        scale = Tensor((c,), device=x.device, dtype=x.dtype)
+        scale.set_value(1.0)
+        self._register_param("scale", scale)
+        bias = Tensor((c,), device=x.device, dtype=x.dtype)
+        bias.set_value(0.0)
+        self._register_param("bias", bias)
+        rm = Tensor((c,), device=x.device, dtype=x.dtype)
+        rm.set_value(0.0)
+        self._register_state("running_mean", rm)
+        rv = Tensor((c,), device=x.device, dtype=x.dtype)
+        rv.set_value(1.0)
+        self._register_state("running_var", rv)
+
+    def forward(self, x):
+        y, new_m, new_v = autograd.batchnorm_2d(
+            x, self.scale, self.bias, self.running_mean, self.running_var,
+            self.momentum, self.eps, train=autograd.training)
+        self.running_mean.data = new_m
+        self.running_var.data = new_v
+        return y
+
+
+class Pooling2d(Layer):
+    """(ref layer.py:891)"""
+
+    def __init__(self, kernel_size, stride=None, padding=0, is_max=True,
+                 pad_mode="NOTSET", name=None):
+        super().__init__(name)
+        self.kernel_size = _pair(kernel_size)
+        self.stride = _pair(stride) if stride is not None else self.kernel_size
+        self.padding = _pair(padding)
+        self.is_max = is_max
+        self.pad_mode = pad_mode
+
+    def forward(self, x):
+        odd = None
+        if self.pad_mode in ("SAME_UPPER", "SAME_LOWER"):
+            ih, iw = x.shape[2], x.shape[3]
+            kh, kw = self.kernel_size
+            sh, sw = self.stride
+            ph = np.maximum((-(-ih // sh) - 1) * sh + kh - ih, 0)
+            pw = np.maximum((-(-iw // sw) - 1) * sw + kw - iw, 0)
+            if self.pad_mode == "SAME_UPPER":
+                odd = (pw // 2, pw - pw // 2, ph // 2, ph - ph // 2)
+            else:
+                odd = (pw - pw // 2, pw // 2, ph - ph // 2, ph // 2)
+        return autograd.pooling_2d(x, self.kernel_size, self.stride,
+                                   self.padding, self.is_max, odd_padding=odd)
+
+
+class MaxPool2d(Pooling2d):
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        super().__init__(kernel_size, stride, padding, True, name=name)
+
+
+class AvgPool2d(Pooling2d):
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        super().__init__(kernel_size, stride, padding, False, name=name)
+
+
+class _Pool1dMixin:
+    def forward(self, x):  # N, C, L -> unsqueeze W
+        x4 = autograd.unsqueeze(x, [3])
+        y = autograd.pooling_2d(x4, (self.kernel_size[0], 1),
+                                (self.stride[0], 1), (self.padding[0], 0),
+                                self.is_max)
+        return autograd.squeeze(y, 3)
+
+
+class MaxPool1d(_Pool1dMixin, Pooling2d):
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        Pooling2d.__init__(self, (kernel_size, 1),
+                           (stride, 1) if stride else (kernel_size, 1),
+                           (padding, 0), True, name=name)
+
+
+class AvgPool1d(_Pool1dMixin, Pooling2d):
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        Pooling2d.__init__(self, (kernel_size, 1),
+                           (stride, 1) if stride else (kernel_size, 1),
+                           (padding, 0), False, name=name)
+
+
+class GlobalAvgPool2d(Layer):
+    def forward(self, x):
+        y = autograd.globalaveragepool(x)
+        return autograd.flatten(y, 1)
+
+
+# ---- stateless wrappers (ref layer.py:1403-1548) -------------------------
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return autograd.relu(x)
+
+
+class Sigmoid(Layer):
+    def forward(self, x):
+        return autograd.sigmoid(x)
+
+
+class Tanh(Layer):
+    def forward(self, x):
+        return autograd.tanh(x)
+
+
+class Add(Layer):
+    def forward(self, a, b):
+        return autograd.add(a, b)
+
+
+class Flatten(Layer):
+    def __init__(self, axis=1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, x):
+        return autograd.flatten(x, self.axis)
+
+
+class Reshape(Layer):
+    def __init__(self, shape, name=None):
+        super().__init__(name)
+        self.shape = shape
+
+    def forward(self, x):
+        return autograd.reshape(x, self.shape)
+
+
+class Cat(Layer):
+    def __init__(self, axis=0, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, xs):
+        return autograd.cat(xs, self.axis)
+
+
+class Dropout(Layer):
+    def __init__(self, ratio=0.5, name=None):
+        super().__init__(name)
+        self.ratio = ratio
+
+    def forward(self, x):
+        return autograd.dropout(x, self.ratio)
+
+
+class SoftMax(Layer):
+    def __init__(self, axis=1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, x):
+        return autograd.softmax(x, self.axis)
+
+
+class SoftMaxCrossEntropy(Layer):
+    def forward(self, x, t):
+        return autograd.softmax_cross_entropy(x, t)
+
+
+class MeanSquareError(Layer):
+    def forward(self, x, t):
+        return autograd.mse_loss(x, t)
+
+
+class CrossEntropy(Layer):
+    def forward(self, p, t):
+        return autograd.cross_entropy(p, t)
+
+
+class BinaryCrossEntropy(Layer):
+    def forward(self, x, t):
+        return autograd.binary_cross_entropy(x, t)
+
+
+# ---- recurrent (ref layer.py:1115-1347 + CudnnRNN:1550) ------------------
+
+
+class RNN_Base(Layer):
+    pass
+
+
+class RNN(RNN_Base):
+    """Vanilla elman RNN composed from autograd ops, time loop in Python
+    (ref layer.py:1129). For long sequences prefer CudnnRNN (lax.scan)."""
+
+    def __init__(self, hidden_size, activation="tanh", name=None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+        self.activation = activation
+
+    def initialize(self, x, hx=None):
+        # x: (seq, batch, feature)
+        in_size = x.shape[2]
+        Wx = Tensor((in_size, self.hidden_size), device=x.device, dtype=x.dtype)
+        initializer.glorot_uniform(Wx)
+        self._register_param("Wx", Wx)
+        Wh = Tensor((self.hidden_size, self.hidden_size), device=x.device,
+                    dtype=x.dtype)
+        initializer.orthogonal(Wh)
+        self._register_param("Wh", Wh)
+        b = Tensor((self.hidden_size,), device=x.device, dtype=x.dtype)
+        b.set_value(0.0)
+        self._register_param("b", b)
+
+    def step(self, xt, h):
+        z = autograd.add(autograd.matmul(xt, self.Wx),
+                         autograd.matmul(h, self.Wh))
+        z = autograd.add_bias(z, self.b, axis=0)
+        return autograd.tanh(z) if self.activation == "tanh" \
+            else autograd.relu(z)
+
+    def forward(self, x, hx=None):
+        seq = x.shape[0]
+        if hx is None:
+            hx = Tensor((x.shape[1], self.hidden_size), device=x.device,
+                        dtype=x.dtype)
+        ys = []
+        h = hx
+        for t in range(seq):
+            h = self.step(x[t], h)
+            ys.append(h)
+        return ys, h
+
+
+class LSTM(RNN_Base):
+    """Autograd-composed LSTM (ref layer.py:1229), fused-gates formulation."""
+
+    def __init__(self, hidden_size, name=None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+
+    def initialize(self, x, hx_cx=None):
+        in_size = x.shape[2]
+        H = self.hidden_size
+        Wx = Tensor((in_size, 4 * H), device=x.device, dtype=x.dtype)
+        initializer.glorot_uniform(Wx)
+        self._register_param("Wx", Wx)
+        Wh = Tensor((H, 4 * H), device=x.device, dtype=x.dtype)
+        initializer.glorot_uniform(Wh)
+        self._register_param("Wh", Wh)
+        b = Tensor((4 * H,), device=x.device, dtype=x.dtype)
+        b.set_value(0.0)
+        self._register_param("b", b)
+
+    def step(self, xt, h, c):
+        H = self.hidden_size
+        z = autograd.add(autograd.matmul(xt, self.Wx),
+                         autograd.matmul(h, self.Wh))
+        z = autograd.add_bias(z, self.b, axis=0)
+        zi = autograd.slice(z, [0], [H], axes=[1])
+        zf = autograd.slice(z, [H], [2 * H], axes=[1])
+        zg = autograd.slice(z, [2 * H], [3 * H], axes=[1])
+        zo = autograd.slice(z, [3 * H], [4 * H], axes=[1])
+        i = autograd.sigmoid(zi)
+        f = autograd.sigmoid(zf)
+        g = autograd.tanh(zg)
+        o = autograd.sigmoid(zo)
+        c_new = autograd.add(autograd.mul(f, c), autograd.mul(i, g))
+        h_new = autograd.mul(o, autograd.tanh(c_new))
+        return h_new, c_new
+
+    def forward(self, x, hx_cx=None):
+        seq, batch = x.shape[0], x.shape[1]
+        if hx_cx is None:
+            h = Tensor((batch, self.hidden_size), device=x.device, dtype=x.dtype)
+            c = Tensor((batch, self.hidden_size), device=x.device, dtype=x.dtype)
+        else:
+            h, c = hx_cx
+        ys = []
+        for t in range(seq):
+            h, c = self.step(x[t], h, c)
+            ys.append(h)
+        return ys, (h, c)
+
+
+class CudnnRNN(Layer):
+    """Fused multi-step LSTM: one autograd op whose forward is a lax.scan —
+    the TPU-native replacement for CudnnRNNHandle (rnn.h:38). Name kept for
+    API parity; `FusedRNN` is the honest alias."""
+
+    def __init__(self, hidden_size, batch_first=False, name=None,
+                 return_sequences=True):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+        self.batch_first = batch_first
+        self.return_sequences = return_sequences
+
+    def initialize(self, x, hx=None, cx=None):
+        from .ops.rnn import init_lstm_params
+        in_size = x.shape[2]  # feature axis is 2 in both layouts
+        Wx, Wh, b = init_lstm_params(in_size, self.hidden_size, x.device,
+                                     x.dtype)
+        self._register_param("Wx", Wx)
+        self._register_param("Wh", Wh)
+        self._register_param("b", b)
+
+    def forward(self, x, hx=None, cx=None):
+        from .ops.rnn import lstm_scan
+        if self.batch_first:
+            x = autograd.transpose(x, (1, 0, 2))
+        batch = x.shape[1]
+        dev = x.device
+        if hx is None:
+            hx = Tensor((batch, self.hidden_size), device=dev, dtype=x.dtype)
+        if cx is None:
+            cx = Tensor((batch, self.hidden_size), device=dev, dtype=x.dtype)
+        ys, hy, cy = lstm_scan(x, hx, cx, self.Wx, self.Wh, self.b)
+        if self.batch_first:
+            ys = autograd.transpose(ys, (1, 0, 2))
+        if self.return_sequences:
+            return ys, hy, cy
+        return hy, hy, cy
+
+
+FusedRNN = CudnnRNN

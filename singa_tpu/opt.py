@@ -1,0 +1,381 @@
+"""Optimizers + distributed training strategies.
+
+Reference parity: python/singa/opt.py — `DecayScheduler/Constant/
+ExponentialDecay` (opt.py:28-68); `Optimizer` with tensor-valued hyperparams
+living inside the training step (:71-171); `SGD` (momentum/nesterov/
+dampening/weight-decay, :174-333), `RMSProp` (:336), `AdaGrad` (:444),
+`Adam` (:536); `DistOpt` (:686) with four strategies: plain fused allreduce
+(:826), fp16 (:867), partial update (:922), sparsified w/ error feedback
+(:994).
+
+TPU-native redesign: gradients come from the tape generator
+(autograd.backward) so communication can start per-gradient, exactly like
+the reference; collectives are `lax.psum`/`all_gather` bound to the mesh
+axis of Model's shard_map step (parallel/communicator.py) instead of NCCL
+stream calls. Optimizer state are Tensors threaded through the jitted step
+(buffer donation = the reference's in-place Axpy update).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import autograd
+from .tensor import Tensor
+
+
+# ---- learning-rate schedulers (ref opt.py:28-68) -------------------------
+
+class DecayScheduler:
+    def __init__(self, init_value: float):
+        self.init_value = init_value
+
+    def __call__(self, step):
+        raise NotImplementedError
+
+
+class Constant(DecayScheduler):
+    def __call__(self, step):
+        return jnp.asarray(self.init_value, dtype=jnp.float32)
+
+
+class ExponentialDecay(DecayScheduler):
+    def __init__(self, init_value, decay_steps, decay_rate, staircase=False):
+        super().__init__(init_value)
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def __call__(self, step):
+        s = step / self.decay_steps
+        if self.staircase:
+            s = jnp.floor(s)
+        return self.init_value * jnp.power(self.decay_rate, s)
+
+
+def _sched(lr) -> DecayScheduler:
+    return lr if isinstance(lr, DecayScheduler) else Constant(float(lr))
+
+
+# ---- base optimizer ------------------------------------------------------
+
+class Optimizer:
+    """Per-param state lives in `self._states[pid]` dicts of jnp arrays; the
+    step counter is an array so schedulers trace into the jitted step."""
+
+    def __init__(self, lr):
+        self.lr = _sched(lr)
+        self.step_counter = jnp.zeros((), dtype=jnp.float32)
+        self._states = {}       # id(param) -> {name: array}
+        self._state_order = []  # pids in creation order (checkpoint order)
+
+    # -- state plumbing for Model's jitted step ---------------------------
+    def state_arrays(self):
+        """Flat list of state arrays (stable order) + the step counter."""
+        arrs = [self.step_counter]
+        for pid in self._state_order:
+            for k in sorted(self._states[pid]):
+                arrs.append(self._states[pid][k])
+        return arrs
+
+    def load_state_arrays(self, arrs):
+        self.step_counter = arrs[0]
+        i = 1
+        for pid in self._state_order:
+            for k in sorted(self._states[pid]):
+                self._states[pid][k] = arrs[i]
+                i += 1
+
+    def get_states(self) -> dict:
+        out = {"step_counter": np.asarray(self.step_counter)}
+        for j, pid in enumerate(self._state_order):
+            for k, v in self._states[pid].items():
+                out[f"p{j}.{k}"] = np.asarray(v)
+        return out
+
+    def set_states(self, states: dict):
+        if "step_counter" in states:
+            self.step_counter = jnp.asarray(states["step_counter"])
+        for j, pid in enumerate(self._state_order):
+            for k in self._states[pid]:
+                key = f"p{j}.{k}"
+                if key in states:
+                    self._states[pid][k] = jnp.asarray(states[key])
+
+    def _state(self, param: Tensor) -> dict:
+        pid = id(param)
+        if pid not in self._states:
+            self._states[pid] = self._init_state(param)
+            self._state_order.append(pid)
+        return self._states[pid]
+
+    def _init_state(self, param: Tensor) -> dict:
+        return {}
+
+    def setup(self, params):
+        """Pre-create all per-param state so the jitted step threads concrete
+        buffers (the reference creates them lazily on first apply)."""
+        for p in params:
+            self._state(p)
+
+    # -- API ---------------------------------------------------------------
+    def __call__(self, loss: Tensor):
+        return self.backward_and_update(loss)
+
+    def backward_and_update(self, loss: Tensor):
+        for p, g in autograd.backward(loss):
+            self.apply(p, g)
+        self.step()
+
+    def step(self):
+        self.step_counter = self.step_counter + 1.0
+
+    def apply(self, param: Tensor, grad: Tensor):
+        raise NotImplementedError
+
+    def device_check(self, *args):
+        pass
+
+
+class SGD(Optimizer):
+    """(ref opt.py:174-333)"""
+
+    def __init__(self, lr=0.1, momentum=0.0, dampening=0.0, weight_decay=0.0,
+                 nesterov=False):
+        super().__init__(lr)
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError("nesterov needs momentum>0, dampening=0")
+
+    def _init_state(self, param):
+        if self.momentum > 0:
+            return {"momentum_buf": jnp.zeros(param.shape, dtype=param.dtype)}
+        return {}
+
+    def apply(self, param: Tensor, grad: Tensor):
+        g = grad.data
+        lr = self.lr(self.step_counter).astype(param.dtype)
+        if self.weight_decay > 0:
+            g = g + self.weight_decay * param.data
+        if self.momentum > 0:
+            st = self._state(param)
+            buf = self.momentum * st["momentum_buf"] + (1 - self.dampening) * g
+            st["momentum_buf"] = buf
+            g = g + self.momentum * buf if self.nesterov else buf
+        param.data = param.data - lr * g
+
+
+class RMSProp(Optimizer):
+    """(ref opt.py:336)"""
+
+    def __init__(self, lr=0.1, rho=0.9, epsilon=1e-8, weight_decay=0.0):
+        super().__init__(lr)
+        self.rho = rho
+        self.epsilon = epsilon
+        self.weight_decay = weight_decay
+
+    def _init_state(self, param):
+        return {"running_average": jnp.zeros(param.shape, dtype=param.dtype)}
+
+    def apply(self, param: Tensor, grad: Tensor):
+        g = grad.data
+        lr = self.lr(self.step_counter).astype(param.dtype)
+        if self.weight_decay > 0:
+            g = g + self.weight_decay * param.data
+        st = self._state(param)
+        avg = self.rho * st["running_average"] + (1 - self.rho) * g * g
+        st["running_average"] = avg
+        param.data = param.data - lr * g / jnp.sqrt(avg + self.epsilon)
+
+
+class AdaGrad(Optimizer):
+    """(ref opt.py:444)"""
+
+    def __init__(self, lr=0.1, epsilon=1e-8, weight_decay=0.0):
+        super().__init__(lr)
+        self.epsilon = epsilon
+        self.weight_decay = weight_decay
+
+    def _init_state(self, param):
+        return {"history": jnp.zeros(param.shape, dtype=param.dtype)}
+
+    def apply(self, param: Tensor, grad: Tensor):
+        g = grad.data
+        lr = self.lr(self.step_counter).astype(param.dtype)
+        if self.weight_decay > 0:
+            g = g + self.weight_decay * param.data
+        st = self._state(param)
+        hist = st["history"] + g * g
+        st["history"] = hist
+        param.data = param.data - lr * g / jnp.sqrt(hist + self.epsilon)
+
+
+class Adam(Optimizer):
+    """(ref opt.py:536)"""
+
+    def __init__(self, lr=0.001, beta_1=0.9, beta_2=0.999, epsilon=1e-8,
+                 weight_decay=0.0):
+        super().__init__(lr)
+        self.beta_1 = beta_1
+        self.beta_2 = beta_2
+        self.epsilon = epsilon
+        self.weight_decay = weight_decay
+
+    def _init_state(self, param):
+        return {"m": jnp.zeros(param.shape, dtype=param.dtype),
+                "v": jnp.zeros(param.shape, dtype=param.dtype)}
+
+    def apply(self, param: Tensor, grad: Tensor):
+        g = grad.data
+        lr = self.lr(self.step_counter).astype(param.dtype)
+        if self.weight_decay > 0:
+            g = g + self.weight_decay * param.data
+        st = self._state(param)
+        t = self.step_counter + 1.0
+        m = self.beta_1 * st["m"] + (1 - self.beta_1) * g
+        v = self.beta_2 * st["v"] + (1 - self.beta_2) * g * g
+        st["m"], st["v"] = m, v
+        mhat = m / (1 - jnp.power(self.beta_1, t)).astype(param.dtype)
+        vhat = v / (1 - jnp.power(self.beta_2, t)).astype(param.dtype)
+        param.data = param.data - lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+
+
+# ---- distributed optimizer (ref opt.py:686-1094) -------------------------
+
+class DistOpt(Optimizer):
+    """Synchronous data-parallel wrapper.
+
+    Reference: wraps NCCL `Communicator` with 4 strategies (opt.py:826-1094).
+    Here: wraps the mesh-axis communicator (parallel/communicator.py); the
+    actual collective is an XLA psum/all_gather over ICI, inserted wherever
+    the tape yields a gradient — so late-layer allreduce overlaps remaining
+    backward exactly like the reference's 3-stream pipeline, courtesy of
+    XLA's latency-hiding scheduler.
+
+    Must run inside Model graph mode (the step is shard_mapped over the
+    mesh); `world_size` is the size of the `axis` mesh axis.
+    """
+
+    def __init__(self, opt: Optimizer, axis: str = "data", mesh=None,
+                 topk_frac: float = 0.01):
+        # NOTE: intentionally not calling super().__init__ — we delegate to
+        # the wrapped optimizer's state machinery.
+        from .parallel.communicator import Communicator
+        self.opt = opt
+        self.axis = axis
+        self.communicator = Communicator(axis=axis, mesh=mesh)
+        self.world_size = self.communicator.world_size
+        self.topk_frac = topk_frac
+        self._spars_residual = {}   # id(param) -> error-feedback residual
+        self._spars_order = []
+        self._partial_counter = 0
+
+    # delegate scheduler/step state to the inner optimizer
+    @property
+    def lr(self):
+        return self.opt.lr
+
+    @property
+    def step_counter(self):
+        return self.opt.step_counter
+
+    def setup(self, params):
+        self.opt.setup(params)
+
+    def state_arrays(self):
+        arrs = list(self.opt.state_arrays())
+        for pid in self._spars_order:
+            arrs.append(self._spars_residual[pid])
+        return arrs
+
+    def load_state_arrays(self, arrs):
+        n = len(arrs) - len(self._spars_order)
+        self.opt.load_state_arrays(arrs[:n])
+        for i, pid in enumerate(self._spars_order):
+            self._spars_residual[pid] = arrs[n + i]
+
+    def get_states(self):
+        out = self.opt.get_states()
+        for i, pid in enumerate(self._spars_order):
+            out[f"spars_residual.{i}"] = np.asarray(self._spars_residual[pid])
+        return out
+
+    def set_states(self, states):
+        self.opt.set_states(states)
+        for i, pid in enumerate(self._spars_order):
+            key = f"spars_residual.{i}"
+            if key in states:
+                self._spars_residual[pid] = jnp.asarray(states[key])
+
+    def step(self):
+        self.opt.step()
+
+    def apply(self, param, grad):
+        self.opt.apply(param, grad)
+
+    # -- strategy 1: plain synchronous allreduce (ref opt.py:826) ----------
+    def backward_and_update(self, loss: Tensor):
+        for p, g in autograd.backward(loss):
+            g.data = self.communicator.all_reduce(g.data) / self.world_size
+            self.opt.apply(p, g)
+        self.opt.step()
+
+    def __call__(self, loss):
+        return self.backward_and_update(loss)
+
+    # -- strategy 2: reduced-precision allreduce (ref opt.py:867) ----------
+    def backward_and_update_half(self, loss: Tensor, clipping=False,
+                                 clip_value=100.0):
+        """bf16 on TPU where the reference uses fp16 (ICI moves half the
+        bytes; bf16 keeps fp32's exponent so no loss-scaling needed)."""
+        for p, g in autograd.backward(loss):
+            gd = g.data
+            if clipping:
+                gd = jnp.clip(gd, -clip_value, clip_value)
+            gd = self.communicator.all_reduce_half(gd) / self.world_size
+            g.data = gd.astype(p.dtype)
+            self.opt.apply(p, g)
+        self.opt.step()
+
+    # -- strategy 3: async partial-parameter update (ref opt.py:922) -------
+    def backward_and_partial_update(self, loss: Tensor, num_partitions=4):
+        """Rotates which 1/k slice of params is synchronized each step.
+
+        NOTE on TPU semantics: the collective is still compiled into the
+        step for every param (XLA needs static comm schedules); the rotating
+        mask reproduces the reference's *numerics*. True bandwidth saving
+        needs per-partition compiled steps — see parallel/README.
+        """
+        k = num_partitions
+        sel = jnp.mod(self.opt.step_counter, k)
+        for i, (p, g) in enumerate(autograd.backward(loss)):
+            synced = self.communicator.all_reduce(g.data) / self.world_size
+            g.data = jnp.where(jnp.equal(sel, i % k), synced, g.data)
+            self.opt.apply(p, g)
+        self.opt.step()
+
+    # -- strategy 4: sparsified allreduce w/ error feedback (ref :994) -----
+    def backward_and_sparse_update(self, loss: Tensor, spars: float = 0.05,
+                                   topK: bool = True, corr: bool = True):
+        for p, g in autograd.backward(loss):
+            pid = id(p)
+            if pid not in self._spars_residual:
+                self._spars_residual[pid] = jnp.zeros(p.shape, dtype=p.dtype)
+                self._spars_order.append(pid)
+            acc = self._spars_residual[pid] if corr else 0.0
+            x = g.data + acc
+            if topK:
+                out, residual = self.communicator.sparse_all_reduce_topk(
+                    x, spars)
+            else:
+                out, residual = self.communicator.sparse_all_reduce_threshold(
+                    x, spars)
+            if corr:
+                self._spars_residual[pid] = residual
+            g.data = out / self.world_size
+            self.opt.apply(p, g)
+        self.opt.step()

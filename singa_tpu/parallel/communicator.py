@@ -1,0 +1,116 @@
+"""Mesh-axis collectives — the NCCL Communicator, TPU-native.
+
+Reference parity: `Communicator` (include/singa/io/communicator.h:76-152,
+src/io/communicator.cc) exposes synch / fusedSynch / synchHalf /
+fusedSynchHalf / sparsification / fusedSparsification / wait over NCCL with
+a 3-stream copy-in/comm/copy-out pipeline.
+
+TPU-native redesign: each method is a jnp/lax expression over a *mesh axis*;
+when called inside Model's shard_mapped step the axis is bound and XLA emits
+an ICI all-reduce/all-gather, scheduled asynchronously by the latency-hiding
+scheduler (this subsumes the reference's stream/event pipeline and the
+fused-buffer trick — XLA's all-reduce combiner fuses small collectives).
+With world_size == 1 every method degrades to the identity, which is what
+lets the reference's `test_dist.py` pattern pass without a cluster.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import data_parallel_mesh
+
+
+class Communicator:
+    def __init__(self, axis: str = "data", mesh=None):
+        self.axis = axis
+        self.mesh = mesh
+        if mesh is not None:
+            self.world_size = int(mesh.shape[axis])
+        else:
+            self.world_size = 1
+        # parity attributes (communicator.h): global/local rank only
+        # meaningful inside the mapped step via lax.axis_index
+        self.global_rank = 0
+        self.local_rank = 0
+
+    def rank(self):
+        """Traced rank inside the mapped step."""
+        if self.world_size == 1:
+            return jnp.zeros((), jnp.int32)
+        return lax.axis_index(self.axis)
+
+    # -- synch / fusedSynch (communicator.cc:212-327) ----------------------
+    def all_reduce(self, x):
+        """Sum over the axis (reference `synch`). Fusion of small tensors is
+        XLA's all-reduce combiner; no manual buffer packing needed."""
+        if self.world_size == 1:
+            return x
+        return lax.psum(x, self.axis)
+
+    # -- synchHalf (communicator.cc:330-467) -------------------------------
+    def all_reduce_half(self, x):
+        """Halved-width allreduce: bf16 over ICI (fp16 in the reference)."""
+        if self.world_size == 1:
+            return x
+        return lax.psum(x.astype(jnp.bfloat16), self.axis).astype(x.dtype)
+
+    def all_gather(self, x, tiled=True):
+        if self.world_size == 1:
+            return x
+        return lax.all_gather(x, self.axis, axis=0, tiled=tiled)
+
+    def broadcast(self, x, root=0):
+        if self.world_size == 1:
+            return x
+        sel = jnp.where(jnp.equal(self.rank(), root), x, jnp.zeros_like(x))
+        return lax.psum(sel, self.axis)
+
+    def reduce_scatter(self, x):
+        if self.world_size == 1:
+            return x
+        return lax.psum_scatter(x, self.axis, scatter_dimension=0, tiled=True)
+
+    def wait(self):
+        """Stream fence (communicator.cc:169-186): nothing to do — XLA's
+        dataflow ordering subsumes the reference's cross-stream events."""
+
+    # -- sparsification (communicator.cc:619-807) --------------------------
+    def sparse_all_reduce_topk(self, x, frac: float):
+        """Top-K sparsified allreduce.
+
+        Reference (`topKSparsAllReduce`, communicator.cc:721-807): thrust
+        sort for top-K, allgather of (index, value) pairs, cusparse axpy
+        accumulate. Here: lax.top_k + all_gather of the (idx, val) pairs
+        (2*K*world elements over ICI instead of N) + one scatter-add.
+        Returns (summed_dense, residual_for_error_feedback).
+        """
+        flat = x.ravel()
+        n = flat.size
+        k = max(1, int(n * float(frac)))
+        _, idx = lax.top_k(jnp.abs(flat), k)
+        vals = jnp.take(flat, idx)
+        residual = flat.at[idx].set(0.0).reshape(x.shape)
+        if self.world_size == 1:
+            out = jnp.zeros_like(flat).at[idx].add(vals)
+            return out.reshape(x.shape), residual
+        gidx = lax.all_gather(idx, self.axis)    # (world, k)
+        gvals = lax.all_gather(vals, self.axis)  # (world, k)
+        out = jnp.zeros_like(flat).at[gidx.ravel()].add(gvals.ravel())
+        return out.reshape(x.shape), residual
+
+    def sparse_all_reduce_threshold(self, x, threshold: float):
+        """Threshold-sparsified allreduce (`valSparsAllReduce`,
+        communicator.cc:619-719).
+
+        XLA needs static shapes, so instead of a variable-nnz allgather
+        (the reference pads to max-nnz) this sends the thresholded-dense
+        tensor through psum: numerics identical (incl. error feedback),
+        bandwidth saving deferred to a packed-format Pallas path.
+        """
+        mask = jnp.abs(x) >= threshold
+        send = jnp.where(mask, x, jnp.zeros_like(x))
+        residual = x - send
+        return self.all_reduce(send), residual

@@ -1,0 +1,41 @@
+"""Test fixture: virtual 8-device CPU mesh.
+
+SURVEY.md §4's lesson: the reference cannot test collectives without a
+cluster; we can — shard_map over forced host devices. This must run before
+any JAX backend initialization (the sandbox's sitecustomize pins
+JAX_PLATFORMS=axon, so overriding the env var alone is not enough).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def dev():
+    from singa_tpu.device import get_default_device
+    return get_default_device()
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+@pytest.fixture
+def train_mode():
+    from singa_tpu import autograd
+    prev = autograd.training
+    autograd.training = True
+    yield
+    autograd.training = prev

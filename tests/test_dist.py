@@ -1,0 +1,149 @@
+"""Distributed data-parallel: DistOpt strategies on an 8-device CPU mesh.
+
+Improves on ref test/python/test_dist.py, which can only assert at
+world_size 1 without a cluster (SURVEY.md §4): here the mesh is real
+(8 forced host devices), so allreduce numerics are exercised for real.
+"""
+
+import numpy as np
+import pytest
+
+from singa_tpu import layer, model, opt, tensor
+from singa_tpu.parallel import data_parallel_mesh, make_mesh
+from singa_tpu.parallel.communicator import Communicator
+
+
+class MLP(model.Model):
+    def __init__(self, hidden=16, classes=4):
+        super().__init__()
+        self.l1 = layer.Linear(hidden)
+        self.relu = layer.ReLU()
+        self.l2 = layer.Linear(classes)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.l2(self.relu(self.l1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self._optimizer(loss)
+        return out, loss
+
+
+class MLPHalf(MLP):
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self._optimizer.backward_and_update_half(loss)
+        return out, loss
+
+
+class MLPSparse(MLP):
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self._optimizer.backward_and_sparse_update(loss, spars=0.25,
+                                                   topK=True, corr=True)
+        return out, loss
+
+
+class MLPPartial(MLP):
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self._optimizer.backward_and_partial_update(loss, num_partitions=2)
+        return out, loss
+
+
+@pytest.fixture
+def data(rng):
+    X = rng.randn(32, 10).astype(np.float32)
+    Y = np.argmax(X @ rng.randn(10, 4).astype(np.float32), 1).astype(np.int32)
+    return X, Y
+
+
+@pytest.fixture
+def mesh():
+    return data_parallel_mesh(8)
+
+
+def _run(cls, dev, mesh, X, Y, steps=40, lr=0.2):
+    m = cls()
+    m.set_optimizer(opt.DistOpt(opt.SGD(lr=lr, momentum=0.9), mesh=mesh))
+    tx, ty = tensor.from_numpy(X, dev), tensor.from_numpy(Y, dev)
+    m.compile([tx], is_train=True, use_graph=True)
+    losses = []
+    for _ in range(steps):
+        out, loss = m(tx, ty)
+        losses.append(float(loss.numpy()))
+    return m, losses, out
+
+
+def test_world_size(mesh):
+    assert opt.DistOpt(opt.SGD(0.1), mesh=mesh).world_size == 8
+
+
+@pytest.mark.parametrize("cls", [MLP, MLPHalf, MLPSparse, MLPPartial],
+                         ids=["plain", "half", "sparse_topk", "partial"])
+def test_strategies_converge(cls, dev, mesh, data):
+    X, Y = data
+    m, losses, out = _run(cls, dev, mesh, X, Y)
+    assert losses[-1] < 0.4 * losses[0], losses
+    assert out.shape == (32, 4)  # global batch gathered back
+
+
+def test_dp_matches_single_device(dev, mesh, data):
+    """psum-mean grads over 8 shards == full-batch single device."""
+    X, Y = data
+    m1 = MLP()
+    m1.set_optimizer(opt.SGD(lr=0.1))
+    tx, ty = tensor.from_numpy(X, dev), tensor.from_numpy(Y, dev)
+    m1.compile([tx], is_train=True, use_graph=True)
+    w0 = {k: v.numpy().copy() for k, v in m1.get_params().items()}
+
+    m2 = MLP()
+    m2.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1), mesh=mesh))
+    m2.compile([tx], is_train=True, use_graph=True)
+    m2.set_params(w0)
+
+    for _ in range(3):
+        _, l1 = m1(tx, ty)
+        _, l2 = m2(tx, ty)
+    assert abs(float(l1.numpy()) - float(l2.numpy())) < 1e-4
+    for k in m1.get_params():
+        assert np.allclose(m1.get_params()[k].numpy(),
+                           m2.get_params()[k].numpy(), atol=1e-4), k
+
+
+def test_world1_degrades_to_identity(dev, rng):
+    """Reference test_dist.py asserts at world_size 1; same here."""
+    comm = Communicator()
+    assert comm.world_size == 1
+    x = np.asarray(rng.randn(8).astype(np.float32))
+    import jax.numpy as jnp
+    assert np.allclose(np.asarray(comm.all_reduce(jnp.asarray(x))), x)
+    out, res = comm.sparse_all_reduce_topk(jnp.asarray(x), 0.25)
+    assert np.allclose(np.asarray(out) + np.asarray(res), x, atol=1e-6)
+
+
+def test_topk_error_feedback_identity(dev, rng, mesh):
+    """out + residual must reconstruct the input per shard."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    comm = Communicator(mesh=mesh)
+    x = rng.randn(8, 16).astype(np.float32)
+
+    def f(xs):
+        out, res = comm.sparse_all_reduce_topk(xs, 0.25)
+        own = xs - res  # what this shard contributed
+        return out, res, own
+
+    f_sharded = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False))
+    out, res, own = f_sharded(x)
+    # sum over shards of own contributions == each shard's dense result
+    want = np.asarray(own).reshape(8, 16).sum(0)
+    got = np.asarray(out)[0]
+    assert np.allclose(got, want, atol=1e-5)

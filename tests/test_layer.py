@@ -1,0 +1,185 @@
+"""Layer zoo: deferred init, shapes, param registry
+(pattern of ref test/python/test_layer.py / test_operation.py)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, layer, tensor
+
+
+@pytest.fixture(autouse=True)
+def _train(train_mode):
+    yield
+
+
+def _x(rng, dev, *shape):
+    return tensor.from_numpy(rng.randn(*shape).astype(np.float32), dev)
+
+
+def test_linear_deferred_init(dev, rng):
+    lin = layer.Linear(8)
+    assert not lin._initialized
+    y = lin(_x(rng, dev, 4, 16))
+    assert lin._initialized
+    assert y.shape == (4, 8)
+    assert lin.W.shape == (16, 8)
+    assert set(lin.get_params()) == {"W", "b"}
+
+
+def test_linear_no_bias(dev, rng):
+    lin = layer.Linear(8, bias=False)
+    lin(_x(rng, dev, 4, 16))
+    assert set(lin.get_params()) == {"W"}
+
+
+def test_conv2d_shapes(dev, rng):
+    conv = layer.Conv2d(16, 3, stride=1, padding=1)
+    y = conv(_x(rng, dev, 2, 3, 8, 8))
+    assert y.shape == (2, 16, 8, 8)
+    assert conv.W.shape == (16, 3, 3, 3)
+    conv2 = layer.Conv2d(8, 3, stride=2)
+    y2 = conv2(_x(rng, dev, 2, 3, 9, 9))
+    assert y2.shape == (2, 8, 4, 4)
+
+
+def test_conv2d_same_padding(dev, rng):
+    conv = layer.Conv2d(4, 3, stride=2, pad_mode="SAME_UPPER")
+    y = conv(_x(rng, dev, 1, 3, 7, 7))
+    assert y.shape == (1, 4, 4, 4)
+
+
+def test_conv2d_group(dev, rng):
+    conv = layer.Conv2d(6, 3, padding=1, group=3)
+    y = conv(_x(rng, dev, 1, 3, 5, 5))
+    assert y.shape == (1, 6, 5, 5)
+    assert conv.W.shape == (6, 1, 3, 3)
+
+
+def test_conv2d_fused_activation(dev, rng):
+    conv = layer.Conv2d(4, 3, padding=1, activation="RELU")
+    y = conv(_x(rng, dev, 1, 3, 5, 5))
+    assert float(y.numpy().min()) >= 0.0
+
+
+def test_separable_conv(dev, rng):
+    sep = layer.SeparableConv2d(8, 3, padding=1)
+    y = sep(_x(rng, dev, 1, 4, 6, 6))
+    assert y.shape == (1, 8, 6, 6)
+    names = set(sep.get_params())
+    assert "depthwise.W" in names and "pointwise.W" in names
+
+
+def test_batchnorm_layer_updates_running_stats(dev, rng):
+    bn = layer.BatchNorm2d()
+    x = _x(rng, dev, 8, 3, 4, 4)
+    before = None
+    y = bn(x)
+    assert y.shape == x.shape
+    after = bn.running_mean.numpy()
+    assert not np.allclose(after, 0.0)  # moved toward batch mean
+    states = bn.get_states()
+    assert "running_mean" in states and "running_var" in states
+    assert set(bn.get_params()) == {"scale", "bias"}
+
+
+def test_batchnorm_eval_mode(dev, rng):
+    bn = layer.BatchNorm2d()
+    x = _x(rng, dev, 8, 3, 4, 4)
+    bn(x)  # init + one train step
+    autograd.training = False
+    y = bn(x)
+    assert y.shape == x.shape
+    autograd.training = True
+
+
+def test_pooling_layers(dev, rng):
+    x = _x(rng, dev, 2, 3, 8, 8)
+    assert layer.MaxPool2d(2, 2)(x).shape == (2, 3, 4, 4)
+    assert layer.AvgPool2d(2, 2)(x).shape == (2, 3, 4, 4)
+    x1 = _x(rng, dev, 2, 3, 10)
+    assert layer.MaxPool1d(2, 2)(x1).shape == (2, 3, 5)
+    assert layer.AvgPool1d(2, 2)(x1).shape == (2, 3, 5)
+
+
+def test_embedding_layer(dev):
+    emb = layer.Embedding(100, 16)
+    ids = tensor.from_numpy(np.array([[1, 2], [3, 4]], np.int32), dev)
+    y = emb(ids)
+    assert y.shape == (2, 2, 16)
+
+
+def test_gemm_layer(dev, rng):
+    g = layer.Gemm(8, transB=True)
+    y = g(_x(rng, dev, 4, 16))
+    assert y.shape == (4, 8)
+    assert g.W.shape == (8, 16)
+
+
+def test_stateless_layers(dev, rng):
+    x = _x(rng, dev, 4, 10)
+    assert layer.ReLU()(x).shape == (4, 10)
+    assert layer.Sigmoid()(x).shape == (4, 10)
+    assert layer.Tanh()(x).shape == (4, 10)
+    assert layer.SoftMax()(x).shape == (4, 10)
+    assert layer.Reshape((2, 20))(x).shape == (2, 20)
+    assert layer.Flatten()(_x(rng, dev, 2, 3, 4)).shape == (2, 12)
+    assert layer.Cat(axis=1)([x, x]).shape == (4, 20)
+    a, b = _x(rng, dev, 3, 3), _x(rng, dev, 3, 3)
+    assert layer.Add()(a, b).shape == (3, 3)
+    assert layer.Dropout(0.5)(x).shape == (4, 10)
+
+
+def test_loss_layers(dev, rng):
+    logits = _x(rng, dev, 4, 5)
+    labels = tensor.from_numpy(np.array([0, 1, 2, 3], np.int32), dev)
+    loss = layer.SoftMaxCrossEntropy()(logits, labels)
+    assert loss.shape == ()
+    t = _x(rng, dev, 4, 5)
+    assert layer.MeanSquareError()(logits, t).shape == ()
+    probs = layer.SoftMax()(logits)
+    onehot = autograd.onehot(5, labels)
+    assert layer.CrossEntropy()(probs, onehot).shape == ()
+    sig = layer.Sigmoid()(logits)
+    tgt = tensor.from_numpy(
+        (rng.rand(4, 5) > 0.5).astype(np.float32), dev)
+    assert layer.BinaryCrossEntropy()(sig, tgt).shape == ()
+
+
+def test_rnn_layers(dev, rng):
+    x = _x(rng, dev, 6, 2, 4)  # (seq, batch, feat)
+    rnn = layer.RNN(8)
+    ys, h = rnn(x)
+    assert len(ys) == 6 and h.shape == (2, 8)
+    lstm = layer.LSTM(8)
+    ys, (h, c) = lstm(x)
+    assert len(ys) == 6 and h.shape == (2, 8) and c.shape == (2, 8)
+    fused = layer.CudnnRNN(8)
+    ys, hy, cy = fused(x)
+    assert ys.shape == (6, 2, 8) and hy.shape == (2, 8)
+
+
+def test_param_name_scoping_unique(dev, rng):
+    class Block(layer.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(4)
+            self.fc2 = layer.Linear(4)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    b = Block()
+    b(_x(rng, dev, 2, 4))
+    names = list(b.get_params())
+    assert len(names) == len(set(names)) == 4
+    assert "fc1.W" in names and "fc2.b" in names
+
+
+def test_set_params_roundtrip(dev, rng):
+    lin = layer.Linear(4)
+    lin(_x(rng, dev, 2, 8))
+    w = rng.randn(8, 4).astype(np.float32)
+    lin.set_params({"W": w})
+    assert np.allclose(lin.W.numpy(), w)
+    with pytest.raises(AssertionError):
+        lin.set_params({"bogus": w})

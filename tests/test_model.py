@@ -1,0 +1,131 @@
+"""Model API: graph buffering (jit), eager parity, checkpointing
+(pattern of ref test/python/test_model.py)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import layer, model, opt, tensor
+
+
+class MLP(model.Model):
+    def __init__(self, hidden=16, classes=4):
+        super().__init__()
+        self.l1 = layer.Linear(hidden)
+        self.relu = layer.ReLU()
+        self.l2 = layer.Linear(classes)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.l2(self.relu(self.l1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self._optimizer(loss)
+        return out, loss
+
+
+@pytest.fixture
+def data(rng):
+    X = rng.randn(32, 10).astype(np.float32)
+    Y = np.argmax(X @ rng.randn(10, 4).astype(np.float32), 1).astype(np.int32)
+    return X, Y
+
+
+def _train(m, dev, X, Y, steps, use_graph):
+    m.set_optimizer(opt.SGD(lr=0.2, momentum=0.9))
+    tx, ty = tensor.from_numpy(X, dev), tensor.from_numpy(Y, dev)
+    m.compile([tx], is_train=True, use_graph=use_graph)
+    losses = []
+    for _ in range(steps):
+        out, loss = m(tx, ty)
+        losses.append(float(loss.numpy()))
+    return losses, out
+
+
+@pytest.mark.parametrize("use_graph", [False, True])
+def test_training_converges(dev, data, use_graph):
+    X, Y = data
+    losses, out = _train(MLP(), dev, X, Y, 40, use_graph)
+    assert losses[-1] < 0.3 * losses[0]
+    acc = np.mean(np.argmax(out.numpy(), 1) == Y)
+    assert acc > 0.9
+
+
+def test_graph_matches_eager(dev, data):
+    """Same seed -> graph-mode step == eager step numerically."""
+    X, Y = data
+    m1, m2 = MLP(), MLP()
+    m1.set_optimizer(opt.SGD(lr=0.1))
+    m2.set_optimizer(opt.SGD(lr=0.1))
+    tx, ty = tensor.from_numpy(X, dev), tensor.from_numpy(Y, dev)
+    m1.compile([tx], is_train=True, use_graph=False)
+    m2.compile([tx], is_train=True, use_graph=True)
+    m2.set_params({k: v.numpy() for k, v in m1.get_params().items()})
+    for _ in range(3):
+        _, l1 = m1(tx, ty)
+        _, l2 = m2(tx, ty)
+    assert abs(float(l1.numpy()) - float(l2.numpy())) < 1e-4
+    for k in m1.get_params():
+        assert np.allclose(m1.get_params()[k].numpy(),
+                           m2.get_params()[k].numpy(), atol=1e-4), k
+
+
+def test_graph_step_is_compiled_once(dev, data):
+    X, Y = data
+    m = MLP()
+    losses, _ = _train(m, dev, X, Y, 5, True)
+    assert m._compiled_step is not None
+    assert m._step_stats["steps"] == 5
+    assert m._step_stats["compile_s"] > 0
+
+
+def test_eval_mode_uses_forward(dev, data):
+    X, Y = data
+    m = MLP()
+    losses, _ = _train(m, dev, X, Y, 3, True)
+    m.eval()
+    out = m(tensor.from_numpy(X, dev))
+    assert out.shape == (32, 4)
+
+
+def test_checkpoint_roundtrip(tmp_path, dev, data):
+    X, Y = data
+    m = MLP()
+    _train(m, dev, X, Y, 5, False)
+    path = str(tmp_path / "ck.zip")
+    m.save_states(path, aux_states={"epoch": np.int32(7)})
+
+    m2 = MLP()
+    m2.set_optimizer(opt.SGD(lr=0.2))
+    m2.compile([tensor.from_numpy(X, dev)], is_train=True, use_graph=False)
+    aux = m2.load_states(path)
+    assert int(aux["epoch"]) == 7
+    for k, v in m.get_states().items():
+        assert np.allclose(v.numpy(), m2.get_states()[k].numpy()), k
+
+
+def test_checkpoint_zip_layout(tmp_path, dev, data):
+    import zipfile
+    X, Y = data
+    m = MLP()
+    _train(m, dev, X, Y, 1, False)
+    path = str(tmp_path / "ck.zip")
+    m.save_states(path)
+    with zipfile.ZipFile(path) as zf:
+        assert set(zf.namelist()) == {"tensor_dict.npz", "states_attr.json"}
+
+
+def test_optimizer_state_threaded_through_graph(dev, data):
+    """Momentum must keep accumulating across jitted steps."""
+    X, Y = data
+    m = MLP()
+    sgd = opt.SGD(lr=0.1, momentum=0.9)
+    m.set_optimizer(sgd)
+    tx, ty = tensor.from_numpy(X, dev), tensor.from_numpy(Y, dev)
+    m.compile([tx], is_train=True, use_graph=True)
+    for _ in range(3):
+        m(tx, ty)
+    assert float(np.asarray(sgd.step_counter)) == 3.0
+    bufs = [v for st in sgd._states.values() for v in st.values()]
+    assert bufs and all(float(np.abs(np.asarray(b)).max()) > 0 for b in bufs)

@@ -1,0 +1,96 @@
+"""Optimizers: update rules vs hand-computed numpy
+(pattern of ref test/python/test_opt.py)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import opt, tensor
+
+
+def _param(dev, val):
+    t = tensor.from_numpy(np.asarray(val, np.float32), dev)
+    t.requires_grad = True
+    t.stores_grad = True
+    return t
+
+
+def _grad(dev, val):
+    return tensor.from_numpy(np.asarray(val, np.float32), dev)
+
+
+def test_sgd_plain(dev):
+    p = _param(dev, [1.0, 2.0])
+    sgd = opt.SGD(lr=0.1)
+    sgd.apply(p, _grad(dev, [1.0, 1.0]))
+    assert np.allclose(p.numpy(), [0.9, 1.9])
+
+
+def test_sgd_momentum(dev):
+    p = _param(dev, [1.0])
+    sgd = opt.SGD(lr=0.1, momentum=0.9)
+    sgd.apply(p, _grad(dev, [1.0]))   # buf=1, p=1-0.1
+    sgd.step()
+    sgd.apply(p, _grad(dev, [1.0]))   # buf=1.9, p=0.9-0.19
+    assert np.allclose(p.numpy(), [0.71], atol=1e-6)
+
+
+def test_sgd_nesterov(dev):
+    p = _param(dev, [1.0])
+    sgd = opt.SGD(lr=0.1, momentum=0.9, nesterov=True)
+    sgd.apply(p, _grad(dev, [1.0]))  # buf=1, g=1+0.9 -> p=1-0.19
+    assert np.allclose(p.numpy(), [0.81], atol=1e-6)
+
+
+def test_sgd_weight_decay(dev):
+    p = _param(dev, [1.0])
+    sgd = opt.SGD(lr=0.1, weight_decay=0.1)
+    sgd.apply(p, _grad(dev, [0.0]))
+    assert np.allclose(p.numpy(), [0.99], atol=1e-6)
+
+
+def test_adagrad(dev):
+    p = _param(dev, [1.0])
+    ada = opt.AdaGrad(lr=0.1, epsilon=0.0)
+    ada.apply(p, _grad(dev, [2.0]))
+    # hist=4, update = 0.1*2/2 = 0.1
+    assert np.allclose(p.numpy(), [0.9], atol=1e-5)
+
+
+def test_rmsprop(dev):
+    p = _param(dev, [1.0])
+    rms = opt.RMSProp(lr=0.1, rho=0.5, epsilon=0.0)
+    rms.apply(p, _grad(dev, [2.0]))
+    # avg = 0.5*4 = 2; update = 0.1*2/sqrt(2)
+    assert np.allclose(p.numpy(), [1.0 - 0.2 / np.sqrt(2)], atol=1e-5)
+
+
+def test_adam_first_step(dev):
+    p = _param(dev, [1.0])
+    adam = opt.Adam(lr=0.001)
+    adam.apply(p, _grad(dev, [1.0]))
+    # bias-corrected first step moves by ~lr
+    assert np.allclose(p.numpy(), [1.0 - 0.001], atol=1e-5)
+
+
+def test_exponential_decay_schedule(dev):
+    import jax.numpy as jnp
+    sch = opt.ExponentialDecay(0.1, decay_steps=10, decay_rate=0.5)
+    assert np.isclose(float(sch(jnp.asarray(0.0))), 0.1)
+    assert np.isclose(float(sch(jnp.asarray(10.0))), 0.05)
+    stair = opt.ExponentialDecay(0.1, 10, 0.5, staircase=True)
+    assert np.isclose(float(stair(jnp.asarray(9.0))), 0.1)
+
+
+def test_optimizer_state_checkpoint(dev):
+    p = _param(dev, [1.0, 2.0])
+    sgd = opt.SGD(lr=0.1, momentum=0.9)
+    sgd.apply(p, _grad(dev, [1.0, 1.0]))
+    sgd.step()
+    states = sgd.get_states()
+    assert "step_counter" in states
+
+    sgd2 = opt.SGD(lr=0.1, momentum=0.9)
+    p2 = _param(dev, [1.0, 2.0])
+    sgd2.setup([p2])
+    sgd2.set_states(states)
+    assert float(np.asarray(sgd2.step_counter)) == 1.0

@@ -1,0 +1,160 @@
+"""Tensor facade: numpy-parity checks (pattern of ref test/python/test_tensor.py)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import tensor
+
+
+def test_create_and_numpy(dev, rng):
+    a = rng.randn(3, 4).astype(np.float32)
+    t = tensor.from_numpy(a, dev)
+    assert t.shape == (3, 4)
+    assert t.dtype == np.float32
+    assert np.allclose(t.numpy(), a)
+    assert t.size() == 12
+    assert t.memsize() == 48
+
+
+def test_zeros_ones_like(dev):
+    t = tensor.ones((2, 3), dev)
+    assert np.all(t.numpy() == 1)
+    z = tensor.zeros_like(t)
+    assert z.shape == (2, 3) and np.all(z.numpy() == 0)
+
+
+def test_arith_operators(dev, rng):
+    a = rng.randn(5).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+    ta, tb = tensor.from_numpy(a, dev), tensor.from_numpy(b, dev)
+    assert np.allclose((ta + tb).numpy(), a + b)
+    assert np.allclose((ta - tb).numpy(), a - b)
+    assert np.allclose((ta * tb).numpy(), a * b)
+    assert np.allclose((ta / tb).numpy(), a / b, rtol=1e-5)
+    assert np.allclose((ta + 2.0).numpy(), a + 2)
+    assert np.allclose((3.0 - ta).numpy(), 3 - a)
+    assert np.allclose((-ta).numpy(), -a)
+
+
+def test_inplace_ops(dev):
+    t = tensor.ones((3,), dev)
+    t += 2.0
+    assert np.allclose(t.numpy(), 3)
+    t *= 2.0
+    assert np.allclose(t.numpy(), 6)
+
+
+def test_unary_functions(dev, rng):
+    a = np.abs(rng.randn(4, 4)).astype(np.float32) + 0.1
+    t = tensor.from_numpy(a, dev)
+    assert np.allclose(tensor.exp(t).numpy(), np.exp(a), rtol=1e-5)
+    assert np.allclose(tensor.log(t).numpy(), np.log(a), rtol=1e-5)
+    assert np.allclose(tensor.sqrt(t).numpy(), np.sqrt(a), rtol=1e-5)
+    assert np.allclose(tensor.tanh(t).numpy(), np.tanh(a), rtol=1e-5)
+    assert np.allclose(tensor.sigmoid(t).numpy(), 1 / (1 + np.exp(-a)),
+                       rtol=1e-5)
+    assert np.allclose(tensor.square(t).numpy(), a * a, rtol=1e-5)
+
+
+def test_matmul_and_gemm(dev, rng):
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 5).astype(np.float32)
+    ta, tb = tensor.from_numpy(a, dev), tensor.from_numpy(b, dev)
+    assert np.allclose(tensor.mult(ta, tb).numpy(), a @ b, rtol=1e-4)
+    assert np.allclose((ta @ tb).numpy(), a @ b, rtol=1e-4)
+
+
+def test_axpy(dev):
+    x = tensor.ones((4,), dev)
+    y = tensor.ones((4,), dev)
+    tensor.axpy(2.0, x, y)
+    assert np.allclose(y.numpy(), 3.0)
+
+
+def test_reshape_transpose(dev, rng):
+    a = rng.randn(2, 6).astype(np.float32)
+    t = tensor.from_numpy(a, dev)
+    assert t.reshape((3, 4)).shape == (3, 4)
+    assert np.allclose(t.transpose().numpy(), a.T)
+    assert np.allclose(tensor.transpose(t, (1, 0)).numpy(), a.T)
+
+
+def test_comparison_masks(dev):
+    t = tensor.from_numpy(np.array([-1.0, 0.0, 1.0], np.float32), dev)
+    assert np.allclose((t > 0).numpy(), [0, 0, 1])
+    assert np.allclose((t <= 0).numpy(), [1, 1, 0])
+    assert (t > 0).requires_grad is False
+
+
+def test_row_col_ops(dev, rng):
+    m = rng.randn(3, 4).astype(np.float32)
+    r = rng.randn(4).astype(np.float32)
+    c = rng.randn(3).astype(np.float32)
+    tm = tensor.from_numpy(m, dev)
+    assert np.allclose(tensor.add_row(tm, tensor.from_numpy(r, dev)).numpy(),
+                       m + r)
+    assert np.allclose(
+        tensor.mult_column(tm, tensor.from_numpy(c, dev)).numpy(),
+        m * c[:, None])
+    assert np.allclose(tensor.sum_rows(tm).numpy(), m.sum(0), rtol=1e-5)
+    assert np.allclose(tensor.sum_columns(tm).numpy(), m.sum(1), rtol=1e-5)
+
+
+def test_random_fill(dev):
+    t = tensor.Tensor((1000,), dev)
+    t.gaussian(1.0, 2.0)
+    assert abs(float(t.numpy().mean()) - 1.0) < 0.3
+    t.uniform(0, 1)
+    x = t.numpy()
+    assert x.min() >= 0 and x.max() <= 1
+    t.bernoulli(0.3)
+    assert set(np.unique(t.numpy())) <= {0.0, 1.0}
+
+
+def test_concat_repeat(dev, rng):
+    a = rng.randn(2, 3).astype(np.float32)
+    t = tensor.from_numpy(a, dev)
+    cc = tensor.concatenate([t, t], axis=0)
+    assert cc.shape == (4, 3)
+    rr = tensor.repeat(t, 2, axis=1)
+    assert rr.shape == (2, 6)
+
+
+def test_einsum_tensordot(dev, rng):
+    a = rng.randn(2, 3).astype(np.float32)
+    b = rng.randn(3, 4).astype(np.float32)
+    ta, tb = tensor.from_numpy(a, dev), tensor.from_numpy(b, dev)
+    assert np.allclose(tensor.einsum("ij,jk->ik", ta, tb).numpy(), a @ b,
+                       rtol=1e-4)
+    assert np.allclose(tensor.tensordot(ta, tb, axes=1).numpy(), a @ b,
+                       rtol=1e-4)
+
+
+def test_softmax_ce_fused_pair(dev, rng):
+    logits = rng.randn(4, 7).astype(np.float32)
+    labels = np.array([1, 0, 6, 3], np.int32)
+    ce = tensor.softmax_cross_entropy_fwd(
+        tensor.from_numpy(logits, dev).data,
+        tensor.from_numpy(labels, dev).data)
+    # reference formula
+    p = np.exp(logits - logits.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    want = -np.log(p[np.arange(4), labels])
+    assert np.allclose(np.asarray(ce), want, rtol=1e-4)
+
+
+def test_astype_l1_l2(dev):
+    t = tensor.from_numpy(np.array([3.0, 4.0], np.float32), dev)
+    h = t.as_type(tensor.float16)
+    assert h.dtype == np.float16
+    assert abs(t.l1() - 3.5) < 1e-5
+    assert abs(t.l2() - 5.0 / np.sqrt(2)) < 1e-5
+
+
+def test_clone_copy(dev):
+    t = tensor.ones((2, 2), dev)
+    c = t.clone()
+    c.set_value(5.0)
+    assert np.all(t.numpy() == 1) and np.all(c.numpy() == 5)
+    t.copy_from(c)
+    assert np.all(t.numpy() == 5)
