@@ -1,0 +1,84 @@
+"""Driver benchmark: ResNet-50 training throughput on synthetic data.
+
+Mirrors the reference harness (examples/cifar_distributed_cnn/benchmark.py:
+34-92): synthetic 224x224 batch-32 images, time `niters` graph-mode train
+steps after warmup, report images/sec. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--size", type=int, default=224)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    args = p.parse_args()
+
+    import numpy as np
+    import jax
+    from singa_tpu import device, models, opt, tensor
+
+    dev = device.best_device()
+    on_cpu = dev.is_host()
+    if on_cpu:
+        # host-only run (no TPU attached): shrink so the bench still finishes
+        args.size = min(args.size, 64)
+        args.iters = min(args.iters, 10)
+        args.warmup = 2
+
+    rng = np.random.RandomState(0)
+    x_np = rng.standard_normal((args.batch, 3, args.size, args.size)).astype(
+        np.float32)
+    y_np = rng.randint(0, 10, args.batch).astype(np.int32)
+
+    m = models.create_model(args.model, num_channels=3)
+    sgd = opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5)
+    m.set_optimizer(sgd)
+    tx = tensor.Tensor(data=x_np, device=dev, dtype=args.dtype)
+    ty = tensor.from_numpy(y_np, device=dev)
+    m.compile([tx], is_train=True, use_graph=True)
+
+    for _ in range(args.warmup):
+        out, loss = m(tx, ty)
+    jax.block_until_ready((out.data, loss.data))
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out, loss = m(tx, ty)
+    # fence on the actual result buffers — Device.Sync may not block under
+    # every backend's client
+    jax.block_until_ready((out.data, loss.data))
+    elapsed = time.perf_counter() - t0
+
+    throughput = args.iters * args.batch / elapsed
+    # Baseline: the reference publishes no absolute numbers (BASELINE.md);
+    # use any number recorded in BASELINE.json "published", else 1.0.
+    vs = 1.0
+    try:
+        with open("BASELINE.json") as f:
+            pub = json.load(f).get("published", {})
+        base = pub.get("resnet50_img_per_sec")
+        if base:
+            vs = throughput / float(base)
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "metric": f"{args.model}_train_throughput_b{args.batch}_s{args.size}"
+                  + ("_cpu" if on_cpu else ""),
+        "value": round(throughput, 2),
+        "unit": "img/s",
+        "vs_baseline": round(vs, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

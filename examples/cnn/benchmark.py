@@ -1,0 +1,69 @@
+"""ResNet-50 synthetic-data throughput harness (ref
+examples/cifar_distributed_cnn/benchmark.py:34-92): batch 32/chip, 224x224,
+100 iters, throughput = iters*batch*world/elapsed.
+
+`--dist` runs data-parallel over all attached devices in one process (the
+reference needs mpirun); scaling efficiency = throughput(N)/(N*throughput(1)).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from singa_tpu import device, models, opt, tensor  # noqa: E402
+
+
+def run(args):
+    dev = device.best_device()
+    world = 1
+    sgd = opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5)
+    if args.dist:
+        from singa_tpu.parallel import data_parallel_mesh
+        mesh = data_parallel_mesh()
+        sgd = opt.DistOpt(sgd, axis="data", mesh=mesh)
+        world = sgd.world_size
+
+    batch = args.batch * world  # batch per chip, like the reference
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((batch, 3, args.size, args.size)).astype(np.float32)
+    y = rng.randint(0, 1000, batch).astype(np.int32)
+
+    model = models.create_model(args.model, num_channels=3, num_classes=1000)
+    model.set_optimizer(sgd)
+    tx = tensor.Tensor(data=x, device=dev, dtype=args.precision)
+    ty = tensor.from_numpy(y, device=dev)
+
+    import jax
+    compile_start = time.time()
+    model.compile([tx], is_train=True, use_graph=True)
+    for _ in range(args.warmup):
+        out, loss = model(tx, ty)
+    jax.block_until_ready((out.data, loss.data))
+    print(f"world={world} warmup+compile {time.time() - compile_start:.1f}s")
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out, loss = model(tx, ty)
+    jax.block_until_ready((out.data, loss.data))
+    elapsed = time.perf_counter() - t0
+    thr = args.iters * batch / elapsed
+    print(f"throughput: {thr:.1f} img/s total, {thr / world:.1f} img/s/chip "
+          f"({args.iters} iters, {elapsed:.2f}s)")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50")
+    p.add_argument("--batch", type=int, default=32, help="per-chip batch")
+    p.add_argument("--size", type=int, default=224)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--dist", action="store_true")
+    p.add_argument("--precision", default="float32",
+                   choices=["float32", "bfloat16"])
+    run(p.parse_args())

@@ -1,0 +1,61 @@
+"""CIFAR-10 loader (ref examples/cnn/data/cifar10.py): reads the python
+pickle batches from ~/data/cifar-10-batches-py; synthetic fallback when the
+dataset isn't on disk (zero-egress sandbox)."""
+
+import os
+import pickle
+
+import numpy as np
+
+SEARCH_DIRS = [
+    os.path.expanduser("~/data/cifar-10-batches-py"),
+    os.path.expanduser("~/data/cifar10/cifar-10-batches-py"),
+    "/tmp/cifar-10-batches-py",
+]
+
+MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32).reshape(3, 1, 1)
+STD = np.array([0.2470, 0.2435, 0.2616], np.float32).reshape(3, 1, 1)
+
+
+def _dir():
+    for d in SEARCH_DIRS:
+        if os.path.exists(os.path.join(d, "data_batch_1")):
+            return d
+    return None
+
+
+def _read_batch(path):
+    with open(path, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    x = d[b"data"].reshape(-1, 3, 32, 32).astype(np.float32) / 255.0
+    y = np.asarray(d.get(b"labels", d.get(b"fine_labels")), np.int32)
+    return x, y
+
+
+def synthetic(n_train=2048, n_val=512, num_classes=10, seed=0):
+    rng = np.random.RandomState(seed)
+    tx = rng.rand(n_train, 3, 32, 32).astype(np.float32)
+    ty = rng.randint(0, num_classes, n_train).astype(np.int32)
+    vx = rng.rand(n_val, 3, 32, 32).astype(np.float32)
+    vy = rng.randint(0, num_classes, n_val).astype(np.int32)
+    return tx, ty, vx, vy
+
+
+def normalize(x):
+    return (x - MEAN) / STD
+
+
+def load():
+    d = _dir()
+    if d is None:
+        print("cifar10: dataset not found on disk; using synthetic data")
+        return synthetic()
+    xs, ys = [], []
+    for i in range(1, 6):
+        x, y = _read_batch(os.path.join(d, f"data_batch_{i}"))
+        xs.append(x)
+        ys.append(y)
+    train_x = normalize(np.concatenate(xs))
+    train_y = np.concatenate(ys)
+    vx, vy = _read_batch(os.path.join(d, "test_batch"))
+    return train_x, train_y, normalize(vx), vy

@@ -1,0 +1,26 @@
+"""CIFAR-100 loader (ref examples/cnn/data/cifar100.py); synthetic fallback."""
+
+import os
+
+import numpy as np
+
+from . import cifar10
+
+SEARCH_DIRS = [
+    os.path.expanduser("~/data/cifar-100-python"),
+    "/tmp/cifar-100-python",
+]
+
+
+def load():
+    d = None
+    for c in SEARCH_DIRS:
+        if os.path.exists(os.path.join(c, "train")):
+            d = c
+            break
+    if d is None:
+        print("cifar100: dataset not found on disk; using synthetic data")
+        return cifar10.synthetic(num_classes=100)
+    tx, ty = cifar10._read_batch(os.path.join(d, "train"))
+    vx, vy = cifar10._read_batch(os.path.join(d, "test"))
+    return cifar10.normalize(tx), ty, cifar10.normalize(vx), vy

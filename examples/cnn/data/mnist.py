@@ -1,0 +1,65 @@
+"""MNIST loader (ref examples/cnn/data/mnist.py).
+
+Looks for the standard IDX files under ~/data/mnist (and common variants);
+with no dataset on disk (this sandbox has zero egress) falls back to a
+deterministic synthetic set with the same shapes/dtypes so the training
+pipeline is exercisable end to end.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+SEARCH_DIRS = [
+    os.path.expanduser("~/data/mnist"),
+    os.path.expanduser("~/data"),
+    "/tmp/mnist",
+    os.path.join(os.path.dirname(__file__), "mnist"),
+]
+
+FILES = {
+    "train_x": ["train-images-idx3-ubyte.gz", "train-images.idx3-ubyte"],
+    "train_y": ["train-labels-idx1-ubyte.gz", "train-labels.idx1-ubyte"],
+    "val_x": ["t10k-images-idx3-ubyte.gz", "t10k-images.idx3-ubyte"],
+    "val_y": ["t10k-labels-idx1-ubyte.gz", "t10k-labels.idx1-ubyte"],
+}
+
+
+def _find(names):
+    for d in SEARCH_DIRS:
+        for n in names:
+            p = os.path.join(d, n)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def _read_idx(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        zero, dtype, dims = struct.unpack(">HBB", f.read(4))
+        shape = tuple(struct.unpack(">I", f.read(4))[0] for _ in range(dims))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def synthetic(n_train=2048, n_val=512, seed=0):
+    rng = np.random.RandomState(seed)
+    tx = rng.randint(0, 256, (n_train, 1, 28, 28)).astype(np.float32) / 255.0
+    ty = rng.randint(0, 10, n_train).astype(np.int32)
+    vx = rng.randint(0, 256, (n_val, 1, 28, 28)).astype(np.float32) / 255.0
+    vy = rng.randint(0, 10, n_val).astype(np.int32)
+    return tx, ty, vx, vy
+
+
+def load():
+    paths = {k: _find(v) for k, v in FILES.items()}
+    if any(p is None for p in paths.values()):
+        print("mnist: dataset not found on disk; using synthetic data")
+        return synthetic()
+    train_x = _read_idx(paths["train_x"]).astype(np.float32) / 255.0
+    train_y = _read_idx(paths["train_y"]).astype(np.int32)
+    val_x = _read_idx(paths["val_x"]).astype(np.float32) / 255.0
+    val_y = _read_idx(paths["val_y"]).astype(np.int32)
+    return (train_x[:, None, :, :], train_y, val_x[:, None, :, :], val_y)

@@ -1,0 +1,137 @@
+"""Train a zoo model on MNIST/CIFAR (ref examples/cnn/train_cnn.py).
+
+Single-chip by default; `--dist` data-parallels over every attached device
+via a mesh (replaces the reference's mpirun/NCCL launch: one process, XLA
+collectives over ICI).
+
+Usage: python train_cnn.py cnn mnist --epochs 2 --batch 64
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from singa_tpu import device, models, opt, tensor  # noqa: E402
+
+
+def augmentation(x, batch_size):
+    """Random-crop-with-pad + horizontal flip, numpy-side (ref
+    train_cnn.py:34-44)."""
+    xpad = np.pad(x, [[0, 0], [0, 0], [4, 4], [4, 4]], "symmetric")
+    for i in range(batch_size):
+        ox, oy = np.random.randint(8, size=2)
+        x[i] = xpad[i, :, ox:ox + x.shape[2], oy:oy + x.shape[3]]
+        if np.random.randint(2):
+            x[i] = x[i, :, :, ::-1]
+    return x
+
+
+def accuracy(pred, target):
+    return int((np.argmax(pred, axis=1) == target).sum())
+
+
+def run(args):
+    dev = device.best_device()
+    dev.SetRandSeed(0)
+    np.random.seed(0)
+
+    from data import mnist, cifar10, cifar100
+    loader = {"mnist": mnist, "cifar10": cifar10, "cifar100": cifar100}
+    train_x, train_y, val_x, val_y = loader[args.data].load()
+
+    num_channels = train_x.shape[1]
+    num_classes = int(np.max(train_y)) + 1
+    data_size = int(np.prod(train_x.shape[1:]))
+
+    kwargs = ({"data_size": data_size} if args.model == "mlp"
+              else {"num_channels": num_channels})
+    model = models.create_model(args.model, num_classes=num_classes, **kwargs)
+
+    if getattr(model, "dimension", 4) == 2:
+        train_x = train_x.reshape(train_x.shape[0], -1)
+        val_x = val_x.reshape(val_x.shape[0], -1)
+
+    sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
+    world_size = 1
+    if args.dist:
+        from singa_tpu.parallel import data_parallel_mesh
+        mesh = data_parallel_mesh()
+        sgd = opt.DistOpt(sgd, axis="data", mesh=mesh)
+        world_size = sgd.world_size
+        print(f"data-parallel over {world_size} devices")
+    model.set_optimizer(sgd)
+
+    bs = args.batch
+    assert bs % world_size == 0, "batch must divide the data axis"
+    tx = tensor.Tensor(data=train_x[:bs].astype(np.float32), device=dev,
+                       dtype=args.precision)
+    ty = tensor.from_numpy(train_y[:bs], device=dev)
+    model.compile([tx], is_train=True, use_graph=args.graph)
+    dev.SetVerbosity(args.verbosity)
+
+    num_train_batch = train_x.shape[0] // bs
+    num_val_batch = val_x.shape[0] // bs
+    idx = np.arange(train_x.shape[0], dtype=np.int32)
+
+    for epoch in range(args.epochs):
+        start = time.time()
+        np.random.shuffle(idx)
+        model.train()
+        correct, loss_sum = 0, 0.0
+        for b in range(num_train_batch):
+            x = train_x[idx[b * bs:(b + 1) * bs]]
+            if x.ndim == 4 and args.augment:
+                x = augmentation(np.array(x), bs)
+            y = train_y[idx[b * bs:(b + 1) * bs]]
+            tx.copy_from_numpy(x.astype(np.float32))
+            ty.copy_from_numpy(y)
+            out, loss = model(tx, ty, args.dist_option, args.spars)
+            correct += accuracy(out.numpy(), y)
+            loss_sum += float(loss.numpy())
+        n = num_train_batch * bs
+        print(f"epoch {epoch}: train loss={loss_sum / num_train_batch:.4f} "
+              f"acc={correct / n:.4f} time={time.time() - start:.1f}s",
+              flush=True)
+
+        model.eval()
+        correct = 0
+        for b in range(num_val_batch):
+            x = val_x[b * bs:(b + 1) * bs].astype(np.float32)
+            y = val_y[b * bs:(b + 1) * bs]
+            tx.copy_from_numpy(x)
+            out = model(tx)
+            correct += accuracy(out.numpy(), y)
+        print(f"epoch {epoch}: eval acc={correct / (num_val_batch * bs):.4f}",
+              flush=True)
+
+    dev.PrintTimeProfiling()
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("model", choices=["cnn", "mlp", "alexnet", "resnet",
+                                     "resnet18", "resnet50", "xceptionnet"],
+                   default="cnn", nargs="?")
+    p.add_argument("data", choices=["mnist", "cifar10", "cifar100"],
+                   default="mnist", nargs="?")
+    p.add_argument("--epochs", "-m", type=int, default=10)
+    p.add_argument("--batch", "-b", type=int, default=64)
+    p.add_argument("--lr", "-l", type=float, default=0.005)
+    p.add_argument("--dist", action="store_true",
+                   help="data-parallel over all attached devices")
+    p.add_argument("--dist-option", default="plain",
+                   choices=["plain", "half", "partialUpdate", "sparseTopK",
+                            "sparseThreshold"])
+    p.add_argument("--spars", type=float, default=0.05)
+    p.add_argument("--no-graph", dest="graph", action="store_false",
+                   help="eager mode (no jit)")
+    p.add_argument("--no-augment", dest="augment", action="store_false")
+    p.add_argument("--verbosity", "-v", type=int, default=0)
+    p.add_argument("--precision", "-p", default="float32",
+                   choices=["float32", "float16", "bfloat16"])
+    run(p.parse_args())

@@ -1,0 +1,48 @@
+"""MLP on a synthetic linear boundary (ref examples/mlp/model.py __main__):
+classify points above/below y = 5x + 1 with label noise."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from singa_tpu import device, models, opt, tensor  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", "-m", type=int, default=300)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--no-graph", dest="graph", action="store_false")
+    args = p.parse_args()
+
+    np.random.seed(0)
+    f = lambda x: 5 * x + 1  # noqa: E731
+    x = np.random.uniform(-1, 1, 400)
+    y = f(x) + 2 * np.random.randn(len(x))
+    label = (y > f(x)).astype(np.int32)
+    data = np.stack([x, y], axis=1).astype(np.float32)
+
+    dev = device.best_device()
+    m = models.create_model("mlp", data_size=2, perceptron_size=3,
+                            num_classes=2)
+    sgd = opt.SGD(lr=args.lr)
+    m.set_optimizer(sgd)
+    tx = tensor.Tensor(data=data, device=dev)
+    ty = tensor.from_numpy(label, device=dev)
+    m.compile([tx], is_train=True, use_graph=args.graph)
+
+    for epoch in range(args.epochs):
+        out, loss = m(tx, ty)
+        if epoch % 50 == 0:
+            acc = float((np.argmax(out.numpy(), 1) == label).mean())
+            print(f"epoch {epoch}: loss={float(loss.numpy()):.4f} acc={acc:.3f}")
+    acc = float((np.argmax(out.numpy(), 1) == label).mean())
+    print(f"final: loss={float(loss.numpy()):.4f} acc={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

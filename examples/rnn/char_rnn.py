@@ -1,0 +1,150 @@
+"""Character-level LSTM language model (ref examples/rnn/char_rnn.py).
+
+The recurrence is one fused `lax.scan` op (singa_tpu.ops.rnn) — the whole
+seq_length-step LSTM is a single tape node, so graph mode compiles one XLA
+while-loop instead of seq_length unrolled cells.
+
+Usage: python char_rnn.py [corpus.txt]   (synthetic corpus if no file given)
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from singa_tpu import autograd, device, layer, model, opt, tensor  # noqa: E402
+
+
+class CharRNN(model.Model):
+
+    def __init__(self, vocab_size, hidden_size=128):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.embed = layer.Embedding(vocab_size, hidden_size)
+        self.lstm = layer.CudnnRNN(hidden_size)  # fused scan LSTM
+        self.dense = layer.Linear(vocab_size)
+        self.softmax_cross_entropy = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        # x: (seq, batch) int ids
+        e = self.embed(x)                       # (seq, batch, hidden)
+        ys, hy, cy = self.lstm(e)               # (seq, batch, hidden)
+        flat = autograd.reshape(ys, (-1, self.hidden_size))
+        return self.dense(flat)                 # (seq*batch, vocab)
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.softmax_cross_entropy(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+class Data:
+
+    def __init__(self, text, batch_size=32, seq_length=100, train_ratio=0.8):
+        self.raw = text
+        self.vocab = sorted(set(text))
+        self.char2idx = {c: i for i, c in enumerate(self.vocab)}
+        self.idx2char = {i: c for i, c in enumerate(self.vocab)}
+        self.vocab_size = len(self.vocab)
+        data = np.array([self.char2idx[c] for c in text], np.int32)
+        n_train = int(len(data) * train_ratio)
+        self.train_dat = data[:n_train]
+        self.val_dat = data[n_train:]
+        self.batch_size = batch_size
+        self.seq_length = seq_length
+        self.num_train_batch = len(self.train_dat) // (batch_size * seq_length)
+        self.num_test_batch = len(self.val_dat) // (batch_size * seq_length)
+
+    def batch(self, data, b):
+        bs, sl = self.batch_size, self.seq_length
+        chunk = data[b * bs * sl: (b + 1) * bs * sl + 1]
+        x = chunk[:bs * sl].reshape(bs, sl).T            # (seq, batch)
+        y = chunk[1:bs * sl + 1].reshape(bs, sl).T.ravel()  # next-char ids
+        return np.ascontiguousarray(x), np.ascontiguousarray(y)
+
+
+def sample(m, data, dev, nsamples=100, seed_char=None):
+    """Ancestral sampling, eager mode, carrying LSTM state across steps."""
+    m.eval()
+    import jax
+    cur = data.char2idx[seed_char or data.vocab[0]]
+    h = c = None
+    out_chars = []
+    x = np.zeros((1, 1), np.int32)
+    for _ in range(nsamples):
+        x[0, 0] = cur
+        tx = tensor.from_numpy(x, device=dev)
+        e = m.embed(tx)
+        ys, h, c = m.lstm(e, h, c)
+        logits = m.dense(autograd.reshape(ys, (-1, m.hidden_size)))
+        p = np.asarray(jax.nn.softmax(logits.data[-1]))
+        cur = int(np.random.choice(len(p), p=p / p.sum()))
+        out_chars.append(data.idx2char[cur])
+    return "".join(out_chars)
+
+
+def synthetic_corpus(n=40000, seed=0):
+    rng = np.random.RandomState(seed)
+    words = ["singa", "tpu", "mesh", "scan", "xla", "pallas", "jit", "grad"]
+    return " ".join(rng.choice(words) for _ in range(n // 5))
+
+
+def train(args):
+    dev = device.best_device()
+    if args.corpus and os.path.exists(args.corpus):
+        with open(args.corpus) as f:
+            text = f.read()
+    else:
+        print("no corpus file; using synthetic word soup")
+        text = synthetic_corpus()
+    data = Data(text, args.batch, args.seq)
+    m = CharRNN(data.vocab_size, args.hidden)
+    sgd = opt.SGD(lr=args.lr, momentum=0.9)
+    m.set_optimizer(sgd)
+
+    x0, y0 = data.batch(data.train_dat, 0)
+    tx = tensor.from_numpy(x0, device=dev)
+    ty = tensor.from_numpy(y0, device=dev)
+    m.compile([tx], is_train=True, use_graph=True)
+
+    for epoch in range(args.epochs):
+        m.train()
+        t0, loss_sum = time.time(), 0.0
+        for b in range(data.num_train_batch):
+            x, y = data.batch(data.train_dat, b)
+            tx.copy_from_numpy(x)
+            ty.copy_from_numpy(y)
+            _, loss = m(tx, ty)
+            loss_sum += float(loss.numpy())
+        print(f"epoch {epoch}: train loss/char="
+              f"{loss_sum / max(data.num_train_batch, 1):.4f} "
+              f"time={time.time() - t0:.1f}s", flush=True)
+        if data.num_test_batch:
+            m.eval()
+            vl = 0.0
+            for b in range(data.num_test_batch):
+                x, y = data.batch(data.val_dat, b)
+                out = m.forward(tensor.from_numpy(x, device=dev))
+                loss = autograd.softmax_cross_entropy(
+                    out, tensor.from_numpy(y, device=dev))
+                vl += float(loss.numpy())
+            print(f"  val loss/char={vl / data.num_test_batch:.4f}")
+            m.train()
+    print("sample:", sample(m, data, dev, 80))
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("corpus", nargs="?", default=None)
+    p.add_argument("--epochs", "-m", type=int, default=3)
+    p.add_argument("--batch", "-b", type=int, default=32)
+    p.add_argument("--seq", "-s", type=int, default=100)
+    p.add_argument("--hidden", "-d", type=int, default=128)
+    p.add_argument("--lr", "-l", type=float, default=0.05)
+    train(p.parse_args())

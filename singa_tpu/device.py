@@ -31,6 +31,10 @@ class Device:
         # Profiling verbosity 0-3 + warmup skip, mirrors device.h:115-129.
         self.verbosity = 0
         self.skip_iteration = 5
+        # Filled by Model when verbosity > 0 (replaces the reference's
+        # per-node cudaEvent timing, scheduler.cc:240-295).
+        self.step_times = []       # seconds per profiled step
+        self.cost_analysis = None  # XLA cost analysis of the step, if any
         # Per-device PRNG stream (reference: curandGenerator in Context).
         self._rng_key = jax.random.key(0, impl="threefry2x32")
         self._rng_key = jax.device_put(self._rng_key, jax_device)
@@ -75,6 +79,30 @@ class Device:
 
     def SetSkipIteration(self, n: int):
         self.skip_iteration = int(n)
+
+    def PrintTimeProfiling(self):
+        """Per-step timing summary (reference Graph::PrintTimeProfiling,
+        scheduler.cc:240-295; fwd/bwd split is replaced by whole-step wall
+        time + XLA cost analysis since XLA fuses across the phases)."""
+        if not self.step_times:
+            print("time profiling: no steps recorded "
+                  "(SetVerbosity(>=1) before training)")
+            return
+        t = np.asarray(self.step_times)
+        print(f"time profiling: {len(t)} steps, "
+              f"mean {t.mean() * 1e3:.3f} ms, std {t.std() * 1e3:.3f} ms, "
+              f"min {t.min() * 1e3:.3f} ms")
+        if self.verbosity >= 2 and self.cost_analysis:
+            ca = self.cost_analysis
+            flops = ca.get("flops", 0.0)
+            bytes_ = ca.get("bytes accessed", 0.0)
+            print(f"  XLA cost: {flops / 1e9:.2f} GFLOP/step, "
+                  f"{bytes_ / 1e6:.1f} MB accessed/step, "
+                  f"{flops / max(t.mean(), 1e-12) / 1e12:.2f} TFLOP/s achieved")
+        if self.verbosity >= 3 and self.cost_analysis:
+            for k, v in sorted(self.cost_analysis.items()):
+                if isinstance(v, (int, float)):
+                    print(f"  {k}: {v:.3g}")
 
     # ---- info ------------------------------------------------------------
     @property

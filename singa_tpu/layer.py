@@ -124,6 +124,23 @@ class Layer(metaclass=LayerMeta):
                 else:
                     own[n].copy_from_numpy(np.asarray(v))
 
+    def register_layers(self, *args):
+        """Register sublayers held in lists/closures rather than attributes
+        (ref layer.py:265-284; used by resnet's _make_layer blocks)."""
+        if len(args) == 1 and isinstance(args[0], OrderedDict):
+            items = list(args[0].items())
+        else:
+            items = [(f"{v.__class__.__name__}_{i}", v)
+                     for i, v in enumerate(args)]
+        for name, value in items:
+            if isinstance(value, Layer):
+                # unlike the reference, survive repeated register_layers
+                # calls (resnet registers one stage at a time)
+                while name in self._layers:
+                    name += "_"
+                self._layers[name] = value
+                value.name = name
+
     def sublayers(self):
         return dict(self._layers)
 
@@ -138,8 +155,12 @@ class Layer(metaclass=LayerMeta):
 class Linear(Layer):
     """y = x W + b (ref layer.py:287)."""
 
-    def __init__(self, out_features: int, bias: bool = True, name=None):
+    def __init__(self, out_features: int, *args, bias: bool = True, name=None,
+                 **kwargs):
         super().__init__(name)
+        # legacy call style Linear(in_features, out_features) (ref layer.py:294)
+        if len(args) > 0 and isinstance(args[0], int):
+            out_features = args[0]
         self.out_features = out_features
         self.bias = bias
 
@@ -234,10 +255,19 @@ class Conv2d(Layer):
     """NCHW convolution, optional fused activation (ref layer.py:508; fused
     relu used by examples/cnn/model/cnn.py:31)."""
 
-    def __init__(self, nb_kernels, kernel_size, stride=1, padding=0,
+    def __init__(self, nb_kernels, kernel_size, *args, stride=1, padding=0,
                  dilation=1, group=1, bias=True, pad_mode="NOTSET",
-                 activation="NONE", name=None):
+                 activation="NONE", name=None, **kwargs):
         super().__init__(name)
+        # legacy call style Conv2d(in_ch, out_ch, k[, stride[, padding]])
+        # (ref layer.py:551-560); in_ch is re-derived from the input anyway
+        if len(args) > 0:
+            nb_kernels = kernel_size
+            kernel_size = args[0]
+        if len(args) > 1:
+            stride = args[1]
+        if len(args) > 2:
+            padding = args[2]
         self.nb_kernels = nb_kernels
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride)
@@ -281,7 +311,7 @@ class Conv2d(Layer):
     def forward(self, x):
         y = autograd.conv2d(self.handle, x, self.W,
                             self.b if self.bias else None)
-        if self.activation == "RELU":
+        if self.activation in ("RELU", "relu"):
             y = autograd.relu(y)
         return y
 
@@ -289,9 +319,17 @@ class Conv2d(Layer):
 class SeparableConv2d(Layer):
     """Depthwise + pointwise conv (ref layer.py:740)."""
 
-    def __init__(self, nb_kernels, kernel_size, stride=1, padding=0,
-                 bias=False, name=None):
+    def __init__(self, nb_kernels, kernel_size, *args, stride=1, padding=0,
+                 bias=False, name=None, **kwargs):
         super().__init__(name)
+        # legacy call style SeparableConv2d(in_ch, out_ch, k[, stride[, pad]])
+        if len(args) > 0:
+            nb_kernels = kernel_size
+            kernel_size = args[0]
+        if len(args) > 1:
+            stride = args[1]
+        if len(args) > 2:
+            padding = args[2]
         self.nb_kernels = nb_kernels
         self.kernel_size = kernel_size
         self.stride = stride
@@ -300,10 +338,13 @@ class SeparableConv2d(Layer):
 
     def initialize(self, x):
         in_channels = x.shape[1]
+        # nb_kernels None = keep channel count (used by blocks whose input
+        # width is only known at first call, e.g. xception middle reps)
+        nb = self.nb_kernels if self.nb_kernels is not None else in_channels
         self.depthwise = Conv2d(in_channels, self.kernel_size,
                                 stride=self.stride, padding=self.padding,
                                 group=in_channels, bias=self.bias)
-        self.pointwise = Conv2d(self.nb_kernels, 1, bias=self.bias)
+        self.pointwise = Conv2d(nb, 1, bias=self.bias)
 
     def forward(self, x):
         return self.pointwise(self.depthwise(x))
@@ -313,8 +354,12 @@ class BatchNorm2d(Layer):
     """BN over NCHW channel dim; running stats are layer states
     (ref layer.py:802)."""
 
-    def __init__(self, momentum=0.9, eps=1e-5, name=None):
+    def __init__(self, *args, momentum=0.9, eps=1e-5, name=None, **kwargs):
         super().__init__(name)
+        # legacy call style BatchNorm2d(num_features[, momentum]); channel
+        # count is re-derived from the input at initialize()
+        if len(args) > 1:
+            momentum = args[1]
         self.momentum = momentum
         self.eps = eps
 

@@ -171,6 +171,7 @@ class Model(Layer, metaclass=ModelMeta):
         static_args = {i: a for i, a in enumerate(example_args)
                        if not isinstance(a, Tensor)}
         self._tensor_pos = tensor_pos
+        self._static_args = static_args
         out_template_box = {}
 
         def step(state_arrs, opt_arrs, rng, input_arrs):
@@ -234,6 +235,17 @@ class Model(Layer, metaclass=ModelMeta):
     def _invoke_step(self, args):
         opt = self._optimizer
         dev = self._device
+        # non-Tensor args (dist_option, spars, ...) are baked into the
+        # compiled step at trace time; changing them later must not be
+        # silently ignored
+        cur_static = {i: a for i, a in enumerate(args)
+                      if not isinstance(a, Tensor)}
+        if cur_static != self._static_args:
+            raise ValueError(
+                f"graph mode compiled with static args {self._static_args}, "
+                f"got {cur_static}; non-Tensor arguments cannot change "
+                "between calls (recompile by resetting the model, or run "
+                "with use_graph=False)")
         state_arrs = [t.data for t in self._state_tensors]
         opt_arrs = opt.state_arrays() if opt is not None else []
         input_arrs = [args[i].data for i in self._tensor_pos]
@@ -247,8 +259,23 @@ class Model(Layer, metaclass=ModelMeta):
             opt_arrs = [jax.device_put(a, rep) for a in opt_arrs]
             rng = jax.device_put(rng, rep)
             input_arrs = [jax.device_put(a, shard) for a in input_arrs]
+        profiling = (dev.verbosity > 0 and
+                     self._step_stats["steps"] >= dev.skip_iteration)
+        if profiling:
+            if dev.cost_analysis is None and dev.verbosity >= 2:
+                try:
+                    ca = self._compiled_step.lower(
+                        state_arrs, opt_arrs, rng,
+                        input_arrs).compile().cost_analysis()
+                    dev.cost_analysis = ca[0] if isinstance(ca, list) else ca
+                except Exception:
+                    dev.cost_analysis = {}
+            t0 = time.perf_counter()
         new_states, new_opt, new_rng, outs = self._compiled_step(
             state_arrs, opt_arrs, rng, input_arrs)
+        if profiling:
+            jax.block_until_ready(new_states)
+            dev.step_times.append(time.perf_counter() - t0)
         for t, a in zip(self._state_tensors, new_states):
             t.data = a
         if opt is not None and new_opt:

@@ -1,0 +1,54 @@
+"""Snapshot: named-tensor kv store on disk (ref python/singa/snapshot.py +
+src/io/snapshot.cc — the reference's binfile-of-TensorProto version is dead
+code; this one is alive and npz-backed, keeping the two-file layout:
+<prefix>.npz (data) + <prefix>.meta (names/shapes manifest)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from .tensor import Tensor, from_numpy
+
+
+class Snapshot:
+
+    def __init__(self, fpath: str, mode_write: bool, buffer_size: int = 0):
+        """mode_write=True opens for writing (ref snapshot.py:42)."""
+        self.fpath = fpath
+        self.mode_write = mode_write
+        self._store = {}
+        if not mode_write:
+            path = fpath if fpath.endswith(".npz") else fpath + ".npz"
+            with np.load(path) as z:
+                self._store = {k: z[k] for k in z.files}
+
+    def write(self, param_name: str, param_val: Tensor):
+        assert self.mode_write
+        self._store[param_name] = param_val.numpy() \
+            if isinstance(param_val, Tensor) else np.asarray(param_val)
+
+    def read(self, param_name: str) -> Tensor:
+        assert not self.mode_write
+        return from_numpy(self._store[param_name])
+
+    def names(self):
+        return list(self._store)
+
+    def flush(self):
+        if not self.mode_write:
+            return
+        path = self.fpath if self.fpath.endswith(".npz") else self.fpath + ".npz"
+        np.savez(path, **self._store)
+        meta = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                for k, v in self._store.items()}
+        with open(os.path.splitext(path)[0] + ".meta", "w") as f:
+            json.dump(meta, f, indent=1)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.flush()

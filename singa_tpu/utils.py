@@ -1,0 +1,54 @@
+"""Misc helpers (ref python/singa/utils.py)."""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def update_progress(progress: float, info: str):
+    """Text progress bar (ref utils.py:27)."""
+    length = 20
+    progress = max(0.0, min(1.0, float(progress)))
+    block = int(round(length * progress))
+    bar = "#" * block + "-" * (length - block)
+    sys.stdout.write(f"[{bar}] {progress * 100:3.1f}% {info}\r")
+    sys.stdout.flush()
+
+
+def force_unicode(s):
+    """(ref utils.py:219)"""
+    return s.decode() if isinstance(s, bytes) else str(s)
+
+
+def get_padding_shape(pad_mode, input_spatial_shape, kernel_spatial_shape,
+                      stride_spatial_shape):
+    """Per-side pads for ONNX SAME_UPPER/SAME_LOWER (ref utils.py:159)."""
+    pads = []
+    for i, k, s in zip(input_spatial_shape, kernel_spatial_shape,
+                       stride_spatial_shape):
+        out = -(-i // s)
+        total = max((out - 1) * s + k - i, 0)
+        half = total // 2
+        if pad_mode == "SAME_UPPER":
+            pads.append((half, total - half))
+        else:
+            pads.append((total - half, half))
+    return pads
+
+
+def get_output_shape(auto_pad, input_spatial_shape, kernel_spatial_shape,
+                     stride_spatial_shape):
+    """(ref utils.py:189)"""
+    if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+        return [-(-i // s) for i, s in
+                zip(input_spatial_shape, stride_spatial_shape)]
+    return [(i - k) // s + 1 for i, k, s in
+            zip(input_spatial_shape, kernel_spatial_shape,
+                stride_spatial_shape)]
+
+
+def accuracy(pred: np.ndarray, target: np.ndarray) -> float:
+    """Top-1 accuracy of logits/probs vs int labels."""
+    return float((np.argmax(pred, axis=1) == target).mean())

@@ -1,0 +1,78 @@
+"""Aux subsystem tests: data iterators, snapshot, channel, utils,
+profiling verbosity (SURVEY.md §5)."""
+
+import os
+
+import numpy as np
+
+from singa_tpu import channel, data, snapshot, tensor, utils
+
+
+def test_numpy_batch_iter_covers_all(rng):
+    x = np.arange(100, dtype=np.float32).reshape(100, 1)
+    y = np.arange(100, dtype=np.int32)
+    it = data.NumpyBatchIter(x, y, batch_size=16, shuffle=True)
+    seen = []
+    for xb, yb in it:
+        assert xb.shape == (16, 1)
+        seen.extend(yb.tolist())
+    assert len(seen) == 96 and len(set(seen)) == 96
+
+
+def test_numpy_batch_iter_transform():
+    x = np.ones((32, 2), np.float32)
+    y = np.zeros(32, np.int32)
+    it = data.NumpyBatchIter(x, y, 8, transform=lambda b: b * 2, shuffle=False)
+    xb, _ = next(iter(it))
+    assert (xb == 2).all()
+
+
+def test_snapshot_roundtrip(tmp_path):
+    p = str(tmp_path / "snap")
+    with snapshot.Snapshot(p, True) as s:
+        s.write("w", tensor.from_numpy(np.arange(6, dtype=np.float32)))
+        s.write("b", np.zeros(3, np.float32))
+    r = snapshot.Snapshot(p, False)
+    assert sorted(r.names()) == ["b", "w"]
+    np.testing.assert_array_equal(r.read("w").numpy(),
+                                  np.arange(6, dtype=np.float32))
+    assert os.path.exists(p + ".meta")
+
+
+def test_channel_file(tmp_path, capsys):
+    channel.InitChannel(str(tmp_path))
+    ch = channel.GetChannel("train")
+    ch.EnableDestFile(True)
+    ch.EnableDestStderr(False)
+    ch.Send("hello")
+    ch.EnableDestFile(False)
+    with open(tmp_path / "train") as f:
+        assert "hello" in f.read()
+
+
+def test_padding_helpers():
+    pads = utils.get_padding_shape("SAME_UPPER", (5, 5), (3, 3), (2, 2))
+    assert pads == [(1, 1), (1, 1)]
+    pads = utils.get_padding_shape("SAME_UPPER", (4, 4), (2, 2), (2, 2))
+    assert pads == [(0, 0), (0, 0)]
+    out = utils.get_output_shape("SAME_UPPER", (5, 5), (3, 3), (2, 2))
+    assert out == [3, 3]
+
+
+def test_profiling_records_steps(dev, train_mode):
+    from singa_tpu import models, opt
+    m = models.create_model("mlp", data_size=4, num_classes=2)
+    m.set_optimizer(opt.SGD(lr=0.1))
+    x = tensor.Tensor(data=np.random.randn(8, 4).astype(np.float32),
+                      device=dev)
+    y = tensor.from_numpy(np.zeros(8, np.int32), device=dev)
+    m.compile([x], is_train=True, use_graph=True)
+    dev.SetVerbosity(2)
+    dev.SetSkipIteration(1)
+    dev.step_times = []
+    dev.cost_analysis = None
+    for _ in range(4):
+        m(x, y)
+    assert len(dev.step_times) == 3
+    dev.PrintTimeProfiling()
+    dev.SetVerbosity(0)
