@@ -1,0 +1,72 @@
+"""Generic ONNX inference runner (stands in for the reference's per-model
+scripts under examples/onnx/, which download pretrained .onnx files — this
+sandbox has no egress, so point it at any local model).
+
+Usage:
+  python infer.py model.onnx                    # random inputs from graph
+  python infer.py model.onnx --input data.npy
+  python infer.py --selftest                    # export resnet18 -> reimport
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from singa_tpu import device, models, sonnx, tensor  # noqa: E402
+
+
+def selftest():
+    dev = device.best_device()
+    m = models.create_model("resnet18", num_channels=3, num_classes=10)
+    x = np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32)
+    tx = tensor.Tensor(data=x, device=dev)
+    m.compile([tx], is_train=False, use_graph=False)
+    m.eval()
+    ref = m.forward(tx).numpy()
+    path = "/tmp/resnet18.onnx"
+    sonnx.export(m, [tx], path)
+    print(f"exported {path} ({os.path.getsize(path) / 1e6:.1f} MB)")
+    rep = sonnx.prepare(sonnx.load_model(path), dev)
+    out = rep.run([tensor.Tensor(data=x, device=dev)])[0].numpy()
+    err = np.abs(out - ref).max()
+    print(f"reimport max|err| vs native eval: {err:.2e}")
+    assert err < 2e-2, "BN running-stats path mismatch"
+    print("selftest ok")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("model", nargs="?", default=None)
+    p.add_argument("--input", default=None, help=".npy input file")
+    p.add_argument("--selftest", action="store_true")
+    args = p.parse_args()
+    if args.selftest or args.model is None:
+        return selftest()
+
+    dev = device.best_device()
+    proto = sonnx.load_model(args.model)
+    rep = sonnx.prepare(proto, dev)
+    b = rep.backend
+    if args.input:
+        xs = [np.load(args.input)]
+    else:
+        xs = []
+        for vi in proto.graph.input:
+            if vi.name not in b.input_names:
+                continue
+            dims = [d.dim_value or 1 for d in vi.type.tensor_type.shape.dim]
+            xs.append(np.random.randn(*dims).astype(np.float32))
+            print(f"random input {vi.name}: {dims}")
+    t0 = time.time()
+    outs = rep.run([tensor.from_numpy(x, device=dev) for x in xs])
+    for name, o in zip(b.output_names, outs):
+        print(f"{name}: shape={o.shape} [{time.time() - t0:.3f}s]")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,7 +22,7 @@ from . import config  # noqa: F401
 _LAZY_MODULES = (
     "tensor", "device", "autograd", "layer", "model", "opt",
     "initializer", "sonnx", "data", "image_tool", "snapshot",
-    "parallel", "utils", "ops", "models",
+    "parallel", "utils", "ops", "models", "io", "channel", "native",
 )
 
 

@@ -987,6 +987,57 @@ class Embedding(Operator):
         return jnp.take(table, self.indices, axis=0)
 
 
+class LayerNorm(Operator):
+    """Normalize over the last axis (no reference counterpart — SINGA has
+    no transformer ops; required by the attention stack)."""
+
+    def __init__(self, eps=1e-5):
+        super().__init__()
+        self.eps = eps
+
+    def forward(self, x, gamma, beta):
+        m = jnp.mean(x, axis=-1, keepdims=True)
+        v = jnp.var(x, axis=-1, keepdims=True)
+        return (x - m) * lax.rsqrt(v + self.eps) * gamma + beta
+
+
+class Gelu(Operator):
+    def forward(self, x):
+        return jax.nn.gelu(x)
+
+
+class _FlashAttention(Operator):
+    """Fused attention on the tape; forward is the Pallas flash kernel (or
+    its reference fallback), backward is its custom_vjp (ops/attention.py)."""
+
+    def __init__(self, causal=False):
+        super().__init__()
+        self.causal = causal
+
+    def forward(self, q, k, v):
+        from .ops.attention import flash_attention
+        return flash_attention(q, k, v, self.causal)
+
+
+class _RingAttention(Operator):
+    """Sequence-parallel attention over a mesh axis; only meaningful inside
+    a shard_mapped step (Model graph mode with an 'sp' axis)."""
+
+    def __init__(self, axis_name, causal=False):
+        super().__init__()
+        self.axis_name = axis_name
+        self.causal = causal
+
+    def forward(self, q, k, v):
+        from .ops.attention import ring_attention, flash_attention
+        try:
+            return ring_attention(q, k, v, self.axis_name, self.causal)
+        except NameError:
+            # axis unbound: running outside the shard_mapped step (param
+            # init, single-device eval) — full attention is equivalent
+            return flash_attention(q, k, v, self.causal)
+
+
 # ======================= functional wrappers ==============================
 
 add = _functional(Add)
@@ -1190,7 +1241,12 @@ def batchnorm_2d(x, gamma, beta, running_mean, running_var, momentum=0.9,
     returned functionally; the Layer assigns them back (TPU-native stand-in
     for the reference's in-place handle mutation)."""
     if train:
-        y = _BatchNorm2d(eps)(x, gamma, beta)
+        op = _BatchNorm2d(eps)
+        # stash running-stat refs + hyperparams for ONNX export (the ONNX
+        # BatchNormalization node needs all five inputs)
+        op._bn_extras = (running_mean, running_var)
+        op._bn_momentum = momentum
+        y = op(x, gamma, beta)
         xd = lax.stop_gradient(x.data)
         axes = (0, 2, 3) if xd.ndim == 4 else (0,)
         bm = jnp.mean(xd, axis=axes)
@@ -1219,3 +1275,19 @@ def dropout(x, ratio=0.5):
 
 def embedding(indices, table):
     return Embedding(indices)(table)
+
+
+def layernorm(x, gamma, beta, eps=1e-5):
+    return LayerNorm(eps)(x, gamma, beta)
+
+
+def gelu(x):
+    return Gelu()(x)
+
+
+def attention(q, k, v, causal=False, seq_axis=None):
+    """Fused attention (B,H,S,D); seq_axis names a mesh axis for ring
+    (sequence-parallel) execution."""
+    if seq_axis is not None:
+        return _RingAttention(seq_axis, causal)(q, k, v)
+    return _FlashAttention(causal)(q, k, v)

@@ -20,12 +20,9 @@ USE_DNNL = False
 #: jax.distributed). The reference gates this on an MPI/NCCL build.
 USE_DIST = True
 
-#: ONNX support is available iff the `onnx` package is importable.
-try:
-    import onnx  # noqa: F401
-    USE_ONNX = True
-except ImportError:
-    USE_ONNX = False
+#: ONNX support is always on: sonnx ships its own protobuf wire codec
+#: (singa_tpu/sonnx/onnx_pb.py), no `onnx` package needed.
+USE_ONNX = True
 
 CUDNN_VERSION = 0  # parity constant; no cuDNN on TPU
 

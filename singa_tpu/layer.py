@@ -542,6 +542,87 @@ class BinaryCrossEntropy(Layer):
         return autograd.binary_cross_entropy(x, t)
 
 
+# ---- transformer stack (no reference counterpart; long-context is
+# first-class in this framework — SURVEY.md §5 notes the reference has no
+# attention op at all) ------------------------------------------------------
+
+
+class LayerNorm(Layer):
+    def __init__(self, eps=1e-5, name=None):
+        super().__init__(name)
+        self.eps = eps
+
+    def initialize(self, x):
+        d = x.shape[-1]
+        g = Tensor((d,), device=x.device, dtype=x.dtype)
+        g.set_value(1.0)
+        self._register_param("gamma", g)
+        b = Tensor((d,), device=x.device, dtype=x.dtype)
+        b.set_value(0.0)
+        self._register_param("beta", b)
+
+    def forward(self, x):
+        return autograd.layernorm(x, self.gamma, self.beta, self.eps)
+
+
+class MultiHeadAttention(Layer):
+    """Self-attention over (B, S, E); the core runs as ONE fused tape op
+    (flash attention / ring attention when seq_axis is a mesh axis)."""
+
+    def __init__(self, num_heads, causal=False, seq_axis=None, name=None):
+        super().__init__(name)
+        self.num_heads = num_heads
+        self.causal = causal
+        self.seq_axis = seq_axis
+
+    def initialize(self, x):
+        e = x.shape[-1]
+        assert e % self.num_heads == 0
+        for attr in ("Wq", "Wk", "Wv", "Wo"):
+            W = Tensor((e, e), device=x.device, dtype=x.dtype)
+            initializer.glorot_uniform(W)
+            self._register_param(attr, W)
+
+    def _split(self, t, B, S):
+        h = self.num_heads
+        t = autograd.reshape(t, (B, S, h, -1))
+        return autograd.transpose(t, (0, 2, 1, 3))  # (B,H,S,D)
+
+    def forward(self, x):
+        B, S, E = x.shape
+        q = self._split(autograd.matmul(x, self.Wq), B, S)
+        k = self._split(autograd.matmul(x, self.Wk), B, S)
+        v = self._split(autograd.matmul(x, self.Wv), B, S)
+        o = autograd.attention(q, k, v, causal=self.causal,
+                               seq_axis=self.seq_axis)
+        o = autograd.transpose(o, (0, 2, 1, 3))
+        o = autograd.reshape(o, (B, S, E))
+        return autograd.matmul(o, self.Wo)
+
+
+class TransformerBlock(Layer):
+    """Pre-LN block: x + MHA(LN(x)); x + MLP(LN(x))."""
+
+    def __init__(self, num_heads, mlp_ratio=4, causal=True, seq_axis=None,
+                 name=None):
+        super().__init__(name)
+        self.ln1 = LayerNorm()
+        self.attn = MultiHeadAttention(num_heads, causal=causal,
+                                       seq_axis=seq_axis)
+        self.ln2 = LayerNorm()
+        self.mlp_ratio = mlp_ratio
+
+    def initialize(self, x):
+        e = x.shape[-1]
+        self.fc1 = Linear(e * self.mlp_ratio)
+        self.fc2 = Linear(e)
+
+    def forward(self, x):
+        x = autograd.add(x, self.attn(self.ln1(x)))
+        h = autograd.gelu(self.fc1(self.ln2(x)))
+        return autograd.add(x, self.fc2(h))
+
+
 # ---- recurrent (ref layer.py:1115-1347 + CudnnRNN:1550) ------------------
 
 

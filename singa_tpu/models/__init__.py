@@ -8,7 +8,7 @@ dispatch in every model file; here it lives once in `base.Classifier`).
 """
 
 from .base import Classifier  # noqa: F401
-from . import mlp, cnn, alexnet, resnet, xceptionnet  # noqa: F401
+from . import mlp, cnn, alexnet, resnet, xceptionnet, transformer  # noqa: F401
 
 _REGISTRY = {
     "mlp": mlp.create_model,
@@ -21,6 +21,7 @@ _REGISTRY = {
     "resnet101": resnet.resnet101,
     "resnet152": resnet.resnet152,
     "xceptionnet": xceptionnet.create_model,
+    "gpt": transformer.create_model,
 }
 
 

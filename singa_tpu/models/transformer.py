@@ -1,0 +1,89 @@
+"""GPT-style decoder-only LM — the long-context flagship.
+
+Beyond reference scope (SINGA has no transformer; SURVEY.md §2.3/§5): this
+model family exists because long-context + sequence parallelism are
+first-class here. `seq_axis` turns every block's attention into ring
+attention over that mesh axis (K/V shards rotate over ICI), so context
+length scales with the number of chips.
+"""
+
+from __future__ import annotations
+
+from .. import autograd, layer, model
+from ..tensor import Tensor, float32
+
+
+class _PosSlice(autograd.Operator):
+    """Slice `length` rows of the position table starting at this device's
+    global sequence offset (axis_index * length when sequence-sharded)."""
+
+    def __init__(self, length, seq_axis=None):
+        super().__init__("PosSlice")
+        self.length = length
+        self.seq_axis = seq_axis
+
+    def forward(self, table):
+        from jax import lax
+        off = 0
+        if self.seq_axis is not None:
+            try:
+                off = lax.axis_index(self.seq_axis) * self.length
+            except NameError:
+                off = 0
+        return lax.dynamic_slice_in_dim(table, off, self.length, axis=0)
+
+
+class GPT(model.Model):
+
+    def __init__(self, vocab_size, max_seq=1024, dim=256, num_heads=8,
+                 num_layers=4, mlp_ratio=4, seq_axis=None, name=None):
+        super().__init__(name)
+        self.vocab_size = vocab_size
+        self.max_seq = max_seq
+        self.dim = dim
+        self.tok_embed = layer.Embedding(vocab_size, dim)
+        blocks = [layer.TransformerBlock(num_heads, mlp_ratio, causal=True,
+                                         seq_axis=seq_axis)
+                  for _ in range(num_layers)]
+        self.blocks = blocks
+        self.register_layers(*blocks)
+        self.ln_f = layer.LayerNorm()
+        self.head = layer.Linear(vocab_size, bias=False)
+        self.sce = layer.SoftMaxCrossEntropy()
+        self.seq_axis = seq_axis
+        self._pos_init = False
+
+    def _pos_embedding(self, x):
+        if not self._pos_init:
+            p = Tensor((self.max_seq, self.dim), device=x.device,
+                       dtype=float32)
+            p.gaussian(0.0, 0.02)
+            self._register_param("pos_embed", p)
+            self._pos_init = True
+        S = x.shape[1]  # local shard length under sequence parallelism
+        return _PosSlice(S, self.seq_axis)(self.pos_embed)
+
+    def forward(self, ids):
+        # ids: (B, S) int32
+        h = self.tok_embed(ids)                       # (B, S, E)
+        pos = self._pos_embedding(h)
+        h = autograd.add(h, autograd.expand(pos, h.shape))
+        for b in self.blocks:
+            h = b(h)
+        h = self.ln_f(h)
+        return self.head(h)                           # (B, S, V)
+
+    def train_one_batch(self, ids, targets):
+        logits = self.forward(ids)
+        flat = autograd.reshape(logits, (-1, self.vocab_size))
+        tflat = autograd.reshape(targets, (-1,))
+        loss = self.sce(flat, tflat)
+        self.optimizer(loss)
+        return logits, loss
+
+
+def create_model(vocab_size=256, **kwargs):
+    return GPT(vocab_size, **kwargs)
+
+
+__all__ = ["GPT", "create_model"]

@@ -1,0 +1,68 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+`lib()` compiles native/recordio.cc into a cached shared object and loads
+it via ctypes (this environment has no pybind11; ctypes IS the binding
+layer). Falls back to None when no compiler is available — singa_tpu.io
+then uses its pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "recordio.cc")
+_SO = os.path.join(_DIR, "librecordio.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _compile() -> bool:
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return True
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             _SRC, "-o", _SO + ".tmp"],
+            check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def lib():
+    """The loaded ctypes library, or None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _compile():
+            return None
+        lb = ctypes.CDLL(_SO)
+        lb.rio_writer_open.restype = ctypes.c_void_p
+        lb.rio_writer_open.argtypes = [ctypes.c_char_p]
+        lb.rio_writer_write.restype = ctypes.c_int
+        lb.rio_writer_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint64]
+        lb.rio_writer_close.restype = ctypes.c_int
+        lb.rio_writer_close.argtypes = [ctypes.c_void_p]
+        lb.rio_reader_open.restype = ctypes.c_void_p
+        lb.rio_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lb.rio_reader_next.restype = ctypes.c_int
+        lb.rio_reader_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64)]
+        lb.rio_reader_close.restype = None
+        lb.rio_reader_close.argtypes = [ctypes.c_void_p]
+        _lib = lb
+        return _lib

@@ -1,0 +1,297 @@
+"""Attention ops: fused flash attention (Pallas) + ring attention (sequence
+parallel over a mesh axis).
+
+No counterpart exists in the reference — it has no attention op at all
+(SURVEY.md §2.3: transformers enter only via ONNX import) — but long-context
+is first-class here. Layout is (batch, heads, seq, head_dim) throughout.
+
+Three tiers, same math:
+  1. `attention_reference`  — jnp, O(S^2) memory; ground truth for tests.
+  2. `flash_attention`      — Pallas online-softmax kernel, O(S) memory,
+                              custom_vjp with blockwise recompute backward.
+  3. `ring_attention`       — flash over sequence shards on a mesh axis;
+                              K/V blocks rotate via lax.ppermute so each
+                              ICI hop overlaps with the local block matmul
+                              (the jax-native form of the RDMA ring pattern
+                              in /opt/skills/guides/pallas_guide.md §18).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _causal_mask(sq, sk, q_off=0, k_off=0, dtype=jnp.float32):
+    q_pos = q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    k_pos = k_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    return jnp.where(k_pos > q_pos, _NEG_INF, 0.0).astype(dtype)
+
+
+# ======================= 1. reference ====================================
+
+def attention_reference(q, k, v, causal=False, scale=None):
+    """q,k,v: (B, H, S, D). Returns (B, H, Sq, D)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        s = s + _causal_mask(q.shape[2], k.shape[2], dtype=s.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+# ======================= 2. flash attention ==============================
+# Online-softmax over K blocks; the kernel keeps one (Bq, D) accumulator,
+# running row-max m and row-sum l in VMEM scratch. Backward recomputes
+# blockwise (no S matrix ever materialized).
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      block_k, seq_k, causal, scale, block_q):
+    """Grid: (batch*heads, q_blocks). Refs are (1, block_q, D) for q/o and
+    (1, seq_k, D) for k/v (whole K/V row per head in VMEM)."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale           # (Bq, D)
+    bq, d = q.shape
+    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = s + _causal_mask(bq, block_k, q_off=qi * block_q,
+                                 k_off=kb * block_k)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.dot(p, v_blk,
+                                   preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    if causal:
+        # skip K blocks strictly above the diagonal
+        last = (qi + 1) * block_q  # first k index NOT needed
+        num_needed = pl.cdiv(last, block_k)
+        m, l, acc = lax.fori_loop(0, num_needed, body, (m, l, acc))
+    else:
+        m, l, acc = lax.fori_loop(0, num_kb, body, (m, l, acc))
+
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+try:  # import here so CPU-only environments still import the module
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    qf = q.reshape(bh, sq, d)
+    kf = k.reshape(bh, sk, d)
+    vf = v.reshape(bh, sk, d)
+    grid = (bh, sq // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, seq_k=sk, causal=causal,
+        scale=scale, block_q=block_q)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _flash_bwd_blockwise(q, k, v, o, lse, do, causal, scale, block_k):
+    """Recompute-based backward, scanned over K blocks (O(S) memory)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    qs = q.astype(jnp.float32) * scale
+    do_ = do.astype(jnp.float32)
+    # delta = rowsum(do * o)  (standard flash-2 backward term)
+    delta = jnp.sum(do_ * o.astype(jnp.float32), axis=-1)  # (B,H,Sq)
+
+    nkb = sk // block_k
+    kb_idx = jnp.arange(nkb)
+
+    def per_kblock(kb):
+        k_blk = lax.dynamic_slice_in_dim(k, kb * block_k, block_k, axis=2)
+        v_blk = lax.dynamic_slice_in_dim(v, kb * block_k, block_k, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs, k_blk.astype(jnp.float32))
+        if causal:
+            s = s + _causal_mask(sq, block_k, 0, kb * block_k)[None, None]
+        p = jnp.exp(s - lse[..., None])                    # (B,H,Sq,Bk)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, do_)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do_, v_blk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None])
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qs) * 1.0
+        dq_part = jnp.einsum("bhqk,bhkd->bhqd", ds,
+                             k_blk.astype(jnp.float32))
+        return dq_part, dk, dv
+
+    def scan_body(dq_acc, kb):
+        dq_part, dk, dv = per_kblock(kb)
+        return dq_acc + dq_part, (dk, dv)
+
+    dq, (dks, dvs) = lax.scan(scan_body,
+                              jnp.zeros(q.shape, jnp.float32), kb_idx)
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, sk, d)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, sk, d)
+    return (dq * scale).astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=None):
+    """Fused attention; q,k,v (B,H,S,D). Falls back to the reference path
+    when shapes don't tile (S % block != 0) or Pallas is unavailable."""
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _resolve(scale, d, interpret):
+    scale = scale if scale is not None else d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return scale, interpret
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    d = q.shape[-1]
+    scale, interpret = _resolve(scale, d, interpret)
+    sq, sk = q.shape[2], k.shape[2]
+    # shrink blocks only to hardware-aligned sizes; anything that still
+    # doesn't tile falls back to the reference path
+    block_q = min(block_q, sq) if sq % min(block_q, sq) == 0 \
+        and min(block_q, sq) % 8 == 0 else block_q
+    block_k = min(block_k, sk) if sk % min(block_k, sk) == 0 \
+        and min(block_k, sk) % 8 == 0 else block_k
+    if (not _HAS_PALLAS or sq % block_q or sk % block_k):
+        out = attention_reference(q, k, v, causal, scale)
+        lse = None
+    else:
+        out, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q,
+                                     block_k, interpret)
+    return out, lse
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    if lse is None:  # fallback path: vjp of the reference impl
+        d = q.shape[-1]
+        s, _ = _resolve(scale, d, interpret)
+        _, ref_vjp = jax.vjp(
+            lambda q_, k_, v_: attention_reference(q_, k_, v_, causal, s),
+            q, k, v)
+        return out, (None, ref_vjp)
+    return out, ((q, k, v, out, lse), None)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    saved, ref_vjp = res
+    if saved is None:
+        return ref_vjp(g)
+    q, k, v, out, lse = saved
+    d = q.shape[-1]
+    s, _ = _resolve(scale, d, interpret)
+    bk = min(block_k, k.shape[2])
+    return _flash_bwd_blockwise(q, k, v, out, lse, g, causal, s, bk)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ======================= 3. ring attention ===============================
+
+def ring_attention(q, k, v, axis_name: str, causal=False, scale=None):
+    """Sequence-parallel attention INSIDE shard_map: q/k/v hold this
+    device's sequence shard (B,H,S_local,D); the axis is the 'sp' mesh
+    dimension. K/V shards rotate around the ring with lax.ppermute while
+    each device accumulates online-softmax partials — peak memory is one
+    shard, total traffic (n-1) shard-hops over ICI, and XLA overlaps each
+    hop with the local block's matmuls.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    d = q.shape[-1]
+    s_local = q.shape[2]
+    scale = scale if scale is not None else d ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qs = q.astype(jnp.float32) * scale
+    m = jnp.full(q.shape[:3] + (1,), _NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
+    acc = jnp.zeros(qs.shape, jnp.float32)
+
+    def step(carry, step_i):
+        m, l, acc, k_cur, v_cur = carry
+        src = (my - step_i) % n  # which global shard k_cur came from
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs, k_cur.astype(jnp.float32))
+        if causal:
+            s = s + _causal_mask(s_local, s_local, my * s_local,
+                                 src * s_local)[None, None]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        # rotate K/V to the next device (no-op cost on the last step's
+        # result; XLA prunes the final unused permute's consumer)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = lax.scan(step, (m, l, acc, k, v), jnp.arange(n))
+    # fully-masked rows (causal, early shards) have l == 0; guard division
+    l = jnp.maximum(l, 1e-20)
+    return (acc / l).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):
+    """Convenience wrapper: shard (B,H,S,D) arrays over `axis_name` on the
+    seq dim and run ring_attention under shard_map."""
+    from jax.sharding import PartitionSpec as P
+    spec = P(None, None, axis_name, None)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def run(q_, k_, v_):
+        return ring_attention(q_, k_, v_, axis_name, causal)
+
+    return run(q, k, v)

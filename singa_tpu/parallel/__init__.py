@@ -14,3 +14,7 @@ from .mesh import (  # noqa: F401
     make_mesh, data_parallel_mesh, factor_mesh, local_device_count,
 )
 from .communicator import Communicator  # noqa: F401
+from .tp import (  # noqa: F401
+    column_parallel, row_parallel, shard_columns, shard_rows, tp_mlp,
+)
+from .pipeline import gpipe, last_stage_value  # noqa: F401

@@ -1,0 +1,65 @@
+"""Pipeline parallelism: GPipe-style SPMD pipeline over a mesh axis (no
+reference counterpart — SURVEY.md §2.3).
+
+`gpipe` runs inside shard_map: every device holds ONE stage's params; the
+microbatch stream flows through the ring with `lax.ppermute` (the jax-level
+form of the inter-chip RDMA ring in /opt/skills/guides/pallas_guide.md §18).
+The whole schedule is a lax.scan, so jax.grad differentiates through it —
+backward replays the scan reversed with ppermute transposed, giving the
+reverse pipeline for free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(stage_fn, stage_params, x_micro, axis_name):
+    """Run the pipeline.
+
+    stage_fn(params, x) -> y: one stage's computation; activation shape
+        must be the same for every stage (classic GPipe constraint).
+    stage_params: this device's stage params (pytree of arrays).
+    x_micro: (n_micro, mb, ...) microbatched input, same value on every
+        device (only stage 0 consumes it).
+    Returns (n_micro, mb, ...) outputs — valid on the LAST stage; other
+        stages hold zeros (psum/select on the caller side if needed).
+    """
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    steps = n_micro + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    buf = jnp.zeros_like(x_micro[0])
+    outs = jnp.zeros_like(x_micro)
+
+    def step(carry, t):
+        buf, outs = carry
+        mb = jnp.clip(t, 0, n_micro - 1)
+        inp = jnp.where(stage == 0,
+                        lax.dynamic_index_in_dim(x_micro, mb, 0,
+                                                 keepdims=False),
+                        buf)
+        y = stage_fn(stage_params, inp)
+        out_idx = t - (n - 1)
+        write = jnp.logical_and(stage == n - 1, out_idx >= 0)
+        safe_idx = jnp.maximum(out_idx, 0)
+        cur = lax.dynamic_index_in_dim(outs, safe_idx, 0, keepdims=False)
+        upd = jnp.where(write, y, cur)
+        outs = lax.dynamic_update_index_in_dim(outs, upd, safe_idx, 0)
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, outs), None
+
+    (buf, outs), _ = lax.scan(step, (buf, outs), jnp.arange(steps))
+    return outs
+
+
+def last_stage_value(x, axis_name):
+    """Broadcast the last stage's value to every device (psum of a one-hot
+    mask — cheap for scalars/small outputs like a loss)."""
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    mask = (stage == n - 1).astype(x.dtype)
+    return lax.psum(x * mask, axis_name)

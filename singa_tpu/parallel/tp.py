@@ -1,0 +1,53 @@
+"""Tensor parallelism: Megatron-style column/row parallel matmuls over a
+mesh axis (no reference counterpart — SINGA is data-parallel only,
+SURVEY.md §2.3; TP is first-class here).
+
+These are shard_map-side functions: weights arrive already sharded (the
+caller partitions with `shard_columns/shard_rows` specs), activations are
+replicated on entry. The canonical pairing for an MLP block is
+column-parallel fc1 (output sharded, no comm) followed by row-parallel fc2
+(one psum over the axis) — a single all-reduce per block riding ICI.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+
+def column_parallel(x, W, axis_name, b=None):
+    """x replicated, W column-sharded: y_shard = x @ W_shard (+ b_shard).
+    Output stays sharded on the feature dim — feed into row_parallel."""
+    y = jnp.dot(x, W)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def row_parallel(x_shard, W, axis_name, b=None):
+    """x feature-sharded, W row-sharded: full y = psum(x_shard @ W_shard).
+    Bias is added once (post-reduction)."""
+    y = lax.psum(jnp.dot(x_shard, W), axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def shard_columns(mesh, axis_name):
+    """NamedSharding for a (in, out) weight split on the output dim."""
+    return NamedSharding(mesh, P(None, axis_name))
+
+
+def shard_rows(mesh, axis_name):
+    """NamedSharding for a (in, out) weight split on the input dim."""
+    return NamedSharding(mesh, P(axis_name, None))
+
+
+def tp_mlp(x, W1, b1, W2, b2, axis_name, act=None):
+    """Two-layer MLP with exactly one collective: column-parallel W1,
+    activation, row-parallel W2, psum."""
+    import jax
+    h = column_parallel(x, W1, axis_name, b1)
+    h = (act or jax.nn.gelu)(h)
+    return row_parallel(h, W2, axis_name, b2)

@@ -1,0 +1,41 @@
+"""sonnx: ONNX interop (ref python/singa/sonnx.py).
+
+- `prepare(model_proto, device)` -> SingaRep with .run(inputs)  (import)
+- `export(model, inputs, path)` / `to_onnx_model(...)`          (export)
+- `SONNXModel` wraps an imported graph as a trainable Model      (retrain)
+- `load_model/save_model` on the self-contained protobuf codec (onnx_pb)
+"""
+
+from __future__ import annotations
+
+from .. import model as model_module
+from ..tensor import Tensor
+from . import onnx_pb
+from .onnx_pb import load_model, save_model  # noqa: F401
+from .backend import SingaBackend, SingaRep, prepare  # noqa: F401
+from .frontend import to_onnx_model, export  # noqa: F401
+
+
+class SONNXModel(model_module.Model):
+    """Re-trainable wrapper over an imported ONNX graph
+    (ref sonnx.py:2196). Subclass and define train_one_batch; forward
+    returns the graph outputs (a single Tensor if there is exactly one)."""
+
+    def __init__(self, onnx_model: "onnx_pb.ModelProto", device=None,
+                 name=None):
+        super().__init__(name)
+        self.backend = SingaBackend(onnx_model, device)
+        # surface imported weights as this Model's params so compile /
+        # optimizers / checkpointing see them
+        for pname, t in self.backend.params.items():
+            attr = "onnx__" + pname.replace(".", "_").replace("/", "_") \
+                .replace(":", "_")
+            self._register_param(attr, t)
+        for sname, t in self.backend.states.items():
+            attr = "onnxs__" + sname.replace(".", "_").replace("/", "_") \
+                .replace(":", "_")
+            self._register_state(attr, t)
+
+    def forward(self, *x):
+        outs = self.backend.run(list(x))
+        return outs[0] if len(outs) == 1 else outs

@@ -1,0 +1,508 @@
+"""ONNX import: ModelProto -> runnable/retrainable singa_tpu graph.
+
+Reference parity: SingaBackend (python/singa/sonnx.py:1037-1951) maps ONNX
+nodes through `_rename_operators`/`_special_operators` onto autograd ops and
+layers; `SingaRep.run(inputs)` executes them; `SONNXModel` (sonnx.py:2196)
+wraps an import for re-training.
+
+TPU-native redesign: each node handler is a closure over our autograd
+functional ops, so an imported graph records on the tape (trainable) and
+traces under jit (graph mode) exactly like hand-written layers. Initializer
+tensors become parameter Tensors; constant-foldable inputs (shapes, axes)
+are evaluated host-side at build time, keeping the traced program static.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import autograd
+from ..device import get_default_device
+from ..tensor import Tensor, from_numpy
+from . import onnx_pb as pb
+
+
+def _attr(node, name, default=None):
+    a = node.attrs()
+    return a.get(name, default)
+
+
+class OnnxNode:
+    def __init__(self, node: pb.NodeProto):
+        self.proto = node
+        self.op_type = node.op_type
+        self.name = node.name or (node.output[0] + "_" + node.op_type)
+        self.inputs = list(node.input)
+        self.outputs = list(node.output)
+        self.attrs = node.attrs()
+
+
+def _np_const(env, name):
+    """Host-side value of a constant-foldable input, else None."""
+    v = env.get(name)
+    if isinstance(v, np.ndarray):
+        return v
+    return None
+
+
+class SingaBackend:
+    """Builds an executable op list from a ModelProto."""
+
+    def __init__(self, model: pb.ModelProto, device=None):
+        self.device = device or get_default_device()
+        self.graph = model.graph
+        self.params = {}      # name -> Tensor (trainable weights)
+        self.consts = {}      # name -> np.ndarray (non-trainable constants)
+        self.nodes = [OnnxNode(n) for n in self.graph.node]
+        self.input_names = []
+        init_names = {t.name for t in self.graph.initializer}
+        for vi in self.graph.input:
+            if vi.name not in init_names:
+                self.input_names.append(vi.name)
+        self.output_names = [vi.name for vi in self.graph.output]
+        # BN running stats are state, not trainable weights
+        bn_stats = set()
+        for n in self.nodes:
+            if n.op_type == "BatchNormalization" and len(n.inputs) >= 5:
+                bn_stats.update(n.inputs[3:5])
+        self.states = {}      # name -> Tensor (mutable, non-trainable)
+        for t in self.graph.initializer:
+            arr = pb.tensor_to_numpy(t)
+            if not np.issubdtype(arr.dtype, np.floating):
+                self.consts[t.name] = arr
+            elif t.name in bn_stats:
+                s = from_numpy(arr.astype(np.float32), device=self.device)
+                s.name = t.name
+                self.states[t.name] = s
+            else:
+                p = from_numpy(arr.astype(np.float32), device=self.device)
+                p.requires_grad = True
+                p.stores_grad = True
+                p.name = t.name
+                self.params[t.name] = p
+
+    # -- execution ---------------------------------------------------------
+    def run(self, inputs, env=None):
+        """inputs: list of Tensors aligned with graph inputs (or dict)."""
+        env = dict(env or {})
+        env.update(self.consts)
+        env.update(self.params)
+        env.update(self.states)
+        if isinstance(inputs, dict):
+            env.update(inputs)
+        else:
+            for name, t in zip(self.input_names, inputs):
+                env[name] = t
+        for node in self.nodes:
+            handler = getattr(self, "op_" + node.op_type, None)
+            if handler is None:
+                raise NotImplementedError(
+                    f"ONNX op {node.op_type} not supported "
+                    f"(node {node.name})")
+            outs = handler(node, env)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            for name, v in zip(node.outputs, outs):
+                env[name] = v
+        return [env[n] for n in self.output_names]
+
+    # -- helpers -----------------------------------------------------------
+    def _t(self, env, name):
+        """Fetch input as Tensor (promote host constants on demand)."""
+        v = env[name]
+        if isinstance(v, np.ndarray):
+            v = from_numpy(v, device=self.device)
+            env[name] = v
+        return v
+
+    def _const(self, env, node, idx, attr=None, default=None):
+        """Constant-foldable operand: from attrs (old opsets) or inputs."""
+        if attr is not None and attr in node.attrs:
+            return np.asarray(node.attrs[attr])
+        if idx < len(node.inputs) and node.inputs[idx]:
+            name = node.inputs[idx]
+            v = env[name]
+            if isinstance(v, np.ndarray):
+                return v
+            if isinstance(v, Tensor):
+                return v.numpy()  # forces host sync; fine at build/run time
+        return default
+
+    # ==== elementwise / unary ============================================
+    def _unary(fn):  # noqa: N805
+        def h(self, node, env):
+            return fn(self._t(env, node.inputs[0]))
+        return h
+
+    op_Relu = _unary(autograd.relu)
+    op_Sigmoid = _unary(autograd.sigmoid)
+    op_Tanh = _unary(autograd.tanh)
+    op_Softplus = _unary(autograd.softplus)
+    op_Softsign = _unary(autograd.softsign)
+    op_Exp = _unary(autograd.exp)
+    op_Log = _unary(autograd.log)
+    op_Sqrt = _unary(autograd.sqrt)
+    op_Abs = _unary(autograd.abs)
+    op_Neg = _unary(autograd.negative)
+    op_Reciprocal = _unary(autograd.reciprocal)
+    op_Sign = _unary(autograd.sign)
+    op_Erf = _unary(autograd.erf)
+    op_Identity = _unary(autograd.identity)
+    op_Sin = _unary(autograd.sin)
+    op_Sinh = _unary(autograd.sinh)
+    op_Asin = _unary(autograd.asin)
+    op_Asinh = _unary(autograd.asinh)
+    op_Cos = _unary(autograd.cos)
+    op_Cosh = _unary(autograd.cosh)
+    op_Acos = _unary(autograd.acos)
+    op_Acosh = _unary(autograd.acosh)
+    op_Tan = _unary(autograd.tan)
+    op_Atan = _unary(autograd.atan)
+    op_Atanh = _unary(autograd.atanh)
+    op_Ceil = _unary(lambda x: autograd.Ceil()(x))
+    op_Floor = _unary(lambda x: autograd.Floor()(x))
+    op_Round = _unary(lambda x: autograd.Round()(x))
+    op_Not = _unary(lambda x: autograd.Not()(x))
+
+    def op_LeakyRelu(self, node, env):
+        return autograd.leakyrelu(self._t(env, node.inputs[0]),
+                                  _attr(node.proto, "alpha", 0.01))
+
+    def op_Elu(self, node, env):
+        return autograd.elu(self._t(env, node.inputs[0]),
+                            _attr(node.proto, "alpha", 1.0))
+
+    def op_Selu(self, node, env):
+        return autograd.selu(self._t(env, node.inputs[0]),
+                             _attr(node.proto, "alpha", 1.67326),
+                             _attr(node.proto, "gamma", 1.0507))
+
+    def op_HardSigmoid(self, node, env):
+        return autograd.hardsigmoid(self._t(env, node.inputs[0]),
+                                    _attr(node.proto, "alpha", 0.2),
+                                    _attr(node.proto, "beta", 0.5))
+
+    def op_PRelu(self, node, env):
+        return autograd.prelu(self._t(env, node.inputs[0]),
+                              self._t(env, node.inputs[1]))
+
+    def op_Softmax(self, node, env):
+        return autograd.softmax(self._t(env, node.inputs[0]),
+                                int(_attr(node.proto, "axis", -1)))
+
+    def op_Clip(self, node, env):
+        lo = self._const(env, node, 1, attr="min")
+        hi = self._const(env, node, 2, attr="max")
+        return autograd.clip(self._t(env, node.inputs[0]),
+                             None if lo is None else float(lo),
+                             None if hi is None else float(hi))
+
+    def op_Cast(self, node, env):
+        to = int(node.attrs["to"])
+        np_dt = pb._ONNX2NP.get(to, np.float32)
+        return autograd.cast(self._t(env, node.inputs[0]), np.dtype(np_dt).name)
+
+    # ==== binary =========================================================
+    def _binary(fn):  # noqa: N805
+        def h(self, node, env):
+            return fn(self._t(env, node.inputs[0]),
+                      self._t(env, node.inputs[1]))
+        return h
+
+    op_Add = _binary(autograd.add)
+    op_Sub = _binary(autograd.sub)
+    op_Mul = _binary(autograd.mul)
+    op_Div = _binary(autograd.div)
+    op_MatMul = _binary(autograd.matmul)
+    op_Pow = _binary(autograd.pow)
+    op_Less = _binary(autograd.less)
+    op_Greater = _binary(autograd.greater)
+    op_Equal = _binary(autograd.equal)
+    op_Min = _binary(autograd.min)
+    op_Max = _binary(autograd.max)
+    op_And = _binary(lambda a, b: autograd.And()(a, b))
+    op_Or = _binary(lambda a, b: autograd.Or()(a, b))
+    op_Xor = _binary(lambda a, b: autograd.Xor()(a, b))
+
+    def op_Sum(self, node, env):
+        return autograd.Sum()(*[self._t(env, n) for n in node.inputs])
+
+    def op_Mean(self, node, env):
+        return autograd.mean(*[self._t(env, n) for n in node.inputs])
+
+    def op_Where(self, node, env):
+        cond = self._t(env, node.inputs[0])
+        return autograd.where(cond, self._t(env, node.inputs[1]),
+                              self._t(env, node.inputs[2]))
+
+    def op_Gemm(self, node, env):
+        A = self._t(env, node.inputs[0])
+        B = self._t(env, node.inputs[1])
+        C = self._t(env, node.inputs[2]) if len(node.inputs) > 2 else None
+        return autograd.gemm(A, B, C,
+                             _attr(node.proto, "alpha", 1.0),
+                             _attr(node.proto, "beta", 1.0),
+                             int(_attr(node.proto, "transA", 0)),
+                             int(_attr(node.proto, "transB", 0)))
+
+    # ==== shape ==========================================================
+    def op_Reshape(self, node, env):
+        shape = self._const(env, node, 1, attr="shape")
+        x = self._t(env, node.inputs[0])
+        shape = [int(s) if s != 0 else x.shape[i]
+                 for i, s in enumerate(np.asarray(shape).tolist())]
+        return autograd.reshape(x, shape)
+
+    def op_Flatten(self, node, env):
+        return autograd.flatten(self._t(env, node.inputs[0]),
+                                int(_attr(node.proto, "axis", 1)))
+
+    def op_Transpose(self, node, env):
+        return autograd.transpose(self._t(env, node.inputs[0]),
+                                  _attr(node.proto, "perm"))
+
+    def op_Squeeze(self, node, env):
+        axes = self._const(env, node, 1, attr="axes")
+        axes = tuple(int(a) for a in np.atleast_1d(axes)) if axes is not None \
+            else None
+        return autograd.squeeze(self._t(env, node.inputs[0]), axes)
+
+    def op_Unsqueeze(self, node, env):
+        axes = self._const(env, node, 1, attr="axes")
+        return autograd.unsqueeze(self._t(env, node.inputs[0]),
+                                  [int(a) for a in np.atleast_1d(axes)])
+
+    def op_Concat(self, node, env):
+        return autograd.cat([self._t(env, n) for n in node.inputs],
+                            int(_attr(node.proto, "axis", 0)))
+
+    def op_Slice(self, node, env):
+        starts = self._const(env, node, 1, attr="starts")
+        ends = self._const(env, node, 2, attr="ends")
+        axes = self._const(env, node, 3, attr="axes")
+        steps = self._const(env, node, 4)
+        x = self._t(env, node.inputs[0])
+        starts = [int(v) for v in np.atleast_1d(starts)]
+        ends = [int(min(v, np.iinfo(np.int32).max)) for v in np.atleast_1d(ends)]
+        axes = [int(v) for v in np.atleast_1d(axes)] if axes is not None \
+            else list(range(len(starts)))
+        steps = [int(v) for v in np.atleast_1d(steps)] if steps is not None \
+            else None
+        return autograd.slice(x, starts, ends, axes, steps)
+
+    def op_Split(self, node, env):
+        x = self._t(env, node.inputs[0])
+        axis = int(_attr(node.proto, "axis", 0))
+        parts = self._const(env, node, 1, attr="split")
+        if parts is None:
+            n = len(node.outputs)
+            d = x.shape[axis] // n
+            parts = [d] * n
+        else:
+            parts = [int(p) for p in np.atleast_1d(parts)]
+        return autograd.split(x, axis, parts)
+
+    def op_Gather(self, node, env):
+        idx = self._const(env, node, 1)
+        x = self._t(env, node.inputs[0])
+        axis = int(_attr(node.proto, "axis", 0))
+        if idx is not None:
+            return autograd.gather(x, axis, idx.astype(np.int32))
+        # dynamic indices (e.g. token ids at runtime): embedding-style gather
+        ids = self._t(env, node.inputs[1])
+        if axis == 0:
+            return autograd.embedding(ids, x)
+        return autograd.Gather(axis, ids.data.astype(np.int32))(x)
+
+    def op_Tile(self, node, env):
+        reps = self._const(env, node, 1, attr="repeats")
+        return autograd.tile(self._t(env, node.inputs[0]),
+                             [int(r) for r in np.atleast_1d(reps)])
+
+    def op_Expand(self, node, env):
+        shape = self._const(env, node, 1)
+        return autograd.expand(self._t(env, node.inputs[0]),
+                               [int(s) for s in np.atleast_1d(shape)])
+
+    def op_Pad(self, node, env):
+        mode = _attr(node.proto, "mode", "constant")
+        if isinstance(mode, bytes):
+            mode = mode.decode()
+        pads = self._const(env, node, 1, attr="pads")
+        cval = self._const(env, node, 2, attr="value", default=0.0)
+        return autograd.pad(self._t(env, node.inputs[0]), mode,
+                            [int(p) for p in np.atleast_1d(pads)],
+                            float(np.asarray(cval).ravel()[0]))
+
+    def op_Shape(self, node, env):
+        x = env[node.inputs[0]]
+        shape = x.shape if isinstance(x, (Tensor, np.ndarray)) else ()
+        return np.asarray(shape, np.int64)  # host constant, foldable
+
+    def op_ConstantOfShape(self, node, env):
+        shape = self._const(env, node, 0)
+        val = node.attrs.get("value", np.zeros(1, np.float32))
+        arr = np.full([int(s) for s in np.atleast_1d(shape)],
+                      np.asarray(val).ravel()[0])
+        return arr.astype(np.asarray(val).dtype)
+
+    def op_Constant(self, node, env):
+        return node.attrs["value"]
+
+    def op_OneHot(self, node, env):
+        depth = int(np.asarray(self._const(env, node, 1)).ravel()[0])
+        values = self._const(env, node, 2, default=np.array([0.0, 1.0]))
+        ids = self._t(env, node.inputs[0])
+        return autograd.onehot(depth, ids, tuple(np.asarray(values).tolist()),
+                               int(_attr(node.proto, "axis", -1)))
+
+    def op_DepthToSpace(self, node, env):
+        mode = _attr(node.proto, "mode", "DCR")
+        if isinstance(mode, bytes):
+            mode = mode.decode()
+        return autograd.depth_to_space(self._t(env, node.inputs[0]),
+                                       int(node.attrs["blocksize"]), mode)
+
+    def op_SpaceToDepth(self, node, env):
+        return autograd.space_to_depth(self._t(env, node.inputs[0]),
+                                       int(node.attrs["blocksize"]))
+
+    def op_Upsample(self, node, env):
+        scales = self._const(env, node, 1, attr="scales")
+        return autograd.upsample(self._t(env, node.inputs[0]), "nearest",
+                                 [float(s) for s in np.atleast_1d(scales)])
+
+    def op_Resize(self, node, env):
+        # nearest-neighbor integer upscaling only (covers yolo-style usage)
+        scales = self._const(env, node, 2)
+        if scales is None or len(np.atleast_1d(scales)) == 0:
+            sizes = np.atleast_1d(self._const(env, node, 3))
+            x = self._t(env, node.inputs[0])
+            scales = [s / d for s, d in zip(sizes, x.shape)]
+        return autograd.upsample(self._t(env, node.inputs[0]), "nearest",
+                                 [float(s) for s in np.atleast_1d(scales)])
+
+    # ==== reductions =====================================================
+    def op_ReduceSum(self, node, env):
+        axes = self._const(env, node, 1, attr="axes")
+        axes = tuple(int(a) for a in np.atleast_1d(axes)) if axes is not None \
+            else None
+        return autograd.reduce_sum(self._t(env, node.inputs[0]), axes,
+                                   bool(_attr(node.proto, "keepdims", 1)))
+
+    def op_ReduceMean(self, node, env):
+        axes = self._const(env, node, 1, attr="axes")
+        axes = tuple(int(a) for a in np.atleast_1d(axes)) if axes is not None \
+            else None
+        return autograd.reduce_mean(self._t(env, node.inputs[0]), axes,
+                                    bool(_attr(node.proto, "keepdims", 1)))
+
+    # ==== NN =============================================================
+    def op_Conv(self, node, env):
+        x = self._t(env, node.inputs[0])
+        W = self._t(env, node.inputs[1])
+        b = self._t(env, node.inputs[2]) if len(node.inputs) > 2 else None
+        strides = _attr(node.proto, "strides", [1, 1])
+        pads = _attr(node.proto, "pads", [0, 0, 0, 0])
+        group = int(_attr(node.proto, "group", 1))
+        dil = _attr(node.proto, "dilations", [1, 1])
+        auto_pad = _attr(node.proto, "auto_pad", "NOTSET")
+        if isinstance(auto_pad, bytes):
+            auto_pad = auto_pad.decode()
+        assert list(dil) == [1] * len(dil), "dilation != 1 unsupported"
+        if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+            from ..utils import get_padding_shape
+            pp = get_padding_shape(auto_pad, x.shape[2:], W.shape[2:], strides)
+            pad, odd = (pp[0][0], pp[1][0]), None
+            if pp[0][0] != pp[0][1] or pp[1][0] != pp[1][1]:
+                pad = (0, 0)
+                odd = (pp[1][0], pp[1][1], pp[0][0], pp[0][1])  # l r t b
+        else:
+            assert pads[0] == pads[2] and pads[1] == pads[3], \
+                "asymmetric explicit pads unsupported"
+            pad, odd = (int(pads[0]), int(pads[1])), None
+
+        class H:  # geometry carrier, see layer._ConvGeometry
+            pass
+        h = H()
+        h.stride = tuple(int(s) for s in strides)
+        h.padding = pad
+        h.group = group
+        h.odd_padding = odd
+        return autograd.conv2d(h, x, W, b)
+
+    def op_BatchNormalization(self, node, env):
+        x = self._t(env, node.inputs[0])
+        gamma = self._t(env, node.inputs[1])
+        beta = self._t(env, node.inputs[2])
+        mean = self._t(env, node.inputs[3])
+        var = self._t(env, node.inputs[4])
+        eps = _attr(node.proto, "epsilon", 1e-5)
+        momentum = _attr(node.proto, "momentum", 0.9)
+        y, new_m, new_v = autograd.batchnorm_2d(
+            x, gamma, beta, mean, var, momentum, eps,
+            train=autograd.training)
+        mean.data = new_m
+        var.data = new_v
+        return y
+
+    def _pool(self, node, env, is_max):
+        x = self._t(env, node.inputs[0])
+        kernel = [int(k) for k in node.attrs["kernel_shape"]]
+        strides = [int(s) for s in _attr(node.proto, "strides", [1, 1])]
+        pads = _attr(node.proto, "pads", [0, 0, 0, 0])
+        auto_pad = _attr(node.proto, "auto_pad", "NOTSET")
+        if isinstance(auto_pad, bytes):
+            auto_pad = auto_pad.decode()
+        odd = None
+        if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+            from ..utils import get_padding_shape
+            pp = get_padding_shape(auto_pad, x.shape[2:], kernel, strides)
+            pad = (0, 0)
+            odd = (pp[1][0], pp[1][1], pp[0][0], pp[0][1])
+        else:
+            pad = (int(pads[0]), int(pads[1]))
+        return autograd.pooling_2d(x, tuple(kernel), tuple(strides), pad,
+                                   is_max, odd_padding=odd)
+
+    def op_MaxPool(self, node, env):
+        return self._pool(node, env, True)
+
+    def op_AveragePool(self, node, env):
+        return self._pool(node, env, False)
+
+    def op_GlobalAveragePool(self, node, env):
+        return autograd.globalaveragepool(self._t(env, node.inputs[0]))
+
+    def op_Dropout(self, node, env):
+        ratio = self._const(env, node, 1, attr="ratio", default=0.5)
+        out = autograd.dropout(self._t(env, node.inputs[0]),
+                               float(np.asarray(ratio).ravel()[0]))
+        if len(node.outputs) > 1:
+            return out, out  # mask output unused downstream in real models
+        return out
+
+    def op_ScatterElements(self, node, env):
+        idx = self._const(env, node, 1)
+        axis = int(_attr(node.proto, "axis", 0))
+        return autograd.ScatterElements(idx.astype(np.int32), axis)(
+            self._t(env, node.inputs[0]), self._t(env, node.inputs[2]))
+
+    def op_NonZero(self, node, env):
+        return autograd.NonZero()(self._t(env, node.inputs[0]))
+
+
+class SingaRep:
+    """Executable representation (ref sonnx.py:1951)."""
+
+    def __init__(self, backend: SingaBackend):
+        self.backend = backend
+        self.params = backend.params
+
+    def run(self, inputs):
+        outs = self.backend.run(inputs)
+        return outs
+
+
+def prepare(model: pb.ModelProto, device=None) -> SingaRep:
+    return SingaRep(SingaBackend(model, device))

@@ -1,0 +1,298 @@
+"""ONNX export: trace the autograd tape of a forward pass into a ModelProto.
+
+Reference parity: SingaFrontend (python/singa/sonnx.py:86-1035) walks the
+buffered op list and renames ops to ONNX. Here the source of truth is the
+creator graph recorded by one training-mode forward — each Operator maps to
+one ONNX node (plus initializers for params/attr tensors).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import autograd
+from ..tensor import Tensor
+from . import onnx_pb as pb
+
+OPSET_VERSION = 13
+
+
+class _Ctx:
+    def __init__(self, param_names=None):
+        self.names = {}        # (op, out_idx) -> tensor name
+        self.nodes = []        # NodeProto list (topo order)
+        self.initializers = []  # TensorProto list
+        self.graph_inputs = []  # ValueInfoProto
+        self.counter = 0
+        self._init_names = set()
+        self.param_names = param_names or {}  # id(Tensor) -> scoped name
+        self._tensor_names = {}               # id(Tensor) -> init name
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def add_initializer(self, name, arr):
+        if name in self._init_names:
+            return name
+        self._init_names.add(name)
+        self.initializers.append(pb.numpy_to_tensor(np.asarray(arr), name))
+        return name
+
+    def init_name_for(self, t, hint="param"):
+        """Stable unique initializer name for a param Tensor (scoped model
+        name preferred; collisions like two layers both naming their weight
+        'W' get a numeric suffix)."""
+        key = id(t)
+        if key in self._tensor_names:
+            return self._tensor_names[key]
+        name = self.param_names.get(key) or t.name or hint
+        while name in self._init_names:
+            name = self.fresh(name)
+        self._tensor_names[key] = name
+        self.add_initializer(name, t.numpy())
+        return name
+
+
+def _input_name(ctx: _Ctx, op, idx, input_ids):
+    """Name of the idx-th input of `op` (follows the tape edge)."""
+    src_op, x_id, x_tensor, _ = op.src[idx]
+    if isinstance(src_op, autograd.Dummy):
+        key = (src_op, 0)
+        if key not in ctx.names:
+            if x_id in input_ids:
+                name = f"input_{input_ids[x_id]}"
+                ctx.graph_inputs.append(pb.make_value_info(
+                    name, pb.TensorProto.FLOAT, x_tensor.shape))
+            else:
+                name = ctx.init_name_for(x_tensor)
+            ctx.names[key] = name
+        return ctx.names[key]
+    y_idx = src_op.y_id2idx[x_id]
+    return ctx.names[(src_op, y_idx)]
+
+
+def _out_names(ctx: _Ctx, op):
+    return [ctx.names.setdefault((op, i), ctx.fresh(op.name))
+            for i in range(op._n_out)]
+
+
+def _emit(ctx, op, ins, outs):
+    """Map one Operator instance to ONNX node(s)."""
+    t = type(op).__name__
+    mk = pb.make_node
+
+    simple = {
+        "Add": "Add", "Sub": "Sub", "Mul": "Mul", "Div": "Div", "Pow": "Pow",
+        "Matmul": "MatMul", "ReLU": "Relu", "Sigmoid": "Sigmoid",
+        "Tanh": "Tanh", "SoftPlus": "Softplus", "SoftSign": "Softsign",
+        "Exp": "Exp", "Log": "Log", "Sqrt": "Sqrt", "Abs": "Abs",
+        "Negative": "Neg", "Reciprocal": "Reciprocal", "Sign": "Sign",
+        "Erf": "Erf", "Identity": "Identity", "Less": "Less",
+        "Greater": "Greater", "Equal": "Equal", "Min": "Min", "Max": "Max",
+        "And": "And", "Or": "Or", "Xor": "Xor", "Not": "Not",
+        "Cos": "Cos", "Cosh": "Cosh", "Sin": "Sin", "Sinh": "Sinh",
+        "Tan": "Tan", "Atan": "Atan", "Atanh": "Atanh", "Acos": "Acos",
+        "Acosh": "Acosh", "Asin": "Asin", "Asinh": "Asinh",
+        "Ceil": "Ceil", "Floor": "Floor", "Round": "Round",
+        "GlobalAveragePool": "GlobalAveragePool", "PRelu": "PRelu",
+        "Sum": "Sum", "Mean": "Mean",
+    }
+    if t in simple:
+        return [mk(simple[t], ins, outs)]
+    if t == "AddBias":
+        return [mk("Add", ins, outs)]
+    if t == "SoftMax":
+        return [mk("Softmax", ins, outs, axis=op.axis)]
+    if t == "LeakyRelu":
+        return [mk("LeakyRelu", ins, outs, alpha=op.a)]
+    if t == "Elu":
+        return [mk("Elu", ins, outs, alpha=op.alpha)]
+    if t == "SeLU":
+        return [mk("Selu", ins, outs, alpha=op.alpha, gamma=op.gamma)]
+    if t == "HardSigmoid":
+        return [mk("HardSigmoid", ins, outs, alpha=op.alpha, beta=op.gamma)]
+    if t == "Clip":
+        extra = []
+        for v, nm in ((op.min, "min"), (op.max, "max")):
+            if v is None:
+                extra.append("")
+            else:
+                extra.append(_const_input(ctx, nm, np.float32(v)))
+        return [mk("Clip", ins + extra, outs)]
+    if t == "Reshape":
+        shape_in = _const_input(ctx, "shape", np.asarray(op.shape, np.int64))
+        return [mk("Reshape", ins + [shape_in], outs)]
+    if t == "Flatten":
+        return [mk("Flatten", ins, outs, axis=op.axis)]
+    if t == "Squeeze":
+        axes = op.axis if op.axis is not None else []
+        axes = list(axes) if isinstance(axes, (list, tuple)) else [axes]
+        return [mk("Squeeze",
+                   ins + [_const_input(ctx, "axes",
+                                       np.asarray(axes, np.int64))], outs)]
+    if t == "Unsqueeze":
+        return [mk("Unsqueeze",
+                   ins + [_const_input(ctx, "axes",
+                                       np.asarray(op.axis, np.int64))], outs)]
+    if t == "Transpose":
+        return [mk("Transpose", ins, outs, perm=list(op.perm)
+                   if op.perm else None)]
+    if t == "Concat":
+        return [mk("Concat", ins, outs, axis=op.axis)]
+    if t == "Slice":
+        return [mk("Slice", ins + [
+            _const_input(ctx, "starts", np.asarray(op.starts, np.int64)),
+            _const_input(ctx, "ends", np.asarray(op.ends, np.int64)),
+            _const_input(ctx, "axes", np.asarray(op.axes, np.int64)),
+            _const_input(ctx, "steps", np.asarray(op.steps, np.int64)),
+        ], outs)]
+    if t == "Split":
+        return [mk("Split", ins + [
+            _const_input(ctx, "split", np.asarray(op.parts, np.int64))],
+            outs, axis=op.axis)]
+    if t == "Gather":
+        idx_in = _const_input(ctx, "indices",
+                              np.asarray(op.indices, np.int64))
+        return [mk("Gather", ins + [idx_in], outs, axis=op.axis)]
+    if t == "Embedding":
+        idx_in = _const_input(ctx, "ids", np.asarray(op.indices, np.int64))
+        return [mk("Gather", [ins[0], idx_in], outs, axis=0)]
+    if t == "Tile":
+        return [mk("Tile", ins + [
+            _const_input(ctx, "repeats",
+                         np.asarray(op.repeats, np.int64))], outs)]
+    if t == "Expand":
+        return [mk("Expand", ins + [
+            _const_input(ctx, "shape", np.asarray(op.shape, np.int64))], outs)]
+    if t == "Gemm":
+        return [mk("Gemm", ins, outs, alpha=op.alpha, beta=op.beta,
+                   transA=op.transA, transB=op.transB)]
+    if t == "ReduceSum":
+        axes = np.asarray(op.axes if op.axes is not None else [], np.int64)
+        return [mk("ReduceSum", ins + [_const_input(ctx, "axes", axes)],
+                   outs, keepdims=int(op.keepdims))]
+    if t == "ReduceMean":
+        return [mk("ReduceMean", ins, outs,
+                   axes=list(op.axes) if op.axes else None,
+                   keepdims=int(op.keepdims))]
+    if t == "_Conv2d":
+        ph, pw = op.padding
+        pads = [ph, pw, ph, pw]
+        if op.odd_padding is not None:
+            l, r, tt, b = op.odd_padding
+            pads = [ph + tt, pw + l, ph + b, pw + r]
+        return [mk("Conv", ins, outs, strides=list(op.stride), pads=pads,
+                   group=op.group)]
+    if t == "_Pooling2d":
+        ph, pw = op.padding
+        pads = [ph, pw, ph, pw]
+        if op.odd_padding is not None:
+            l, r, tt, b = op.odd_padding
+            pads = [ph + tt, pw + l, ph + b, pw + r]
+        return [mk("MaxPool" if op.is_max else "AveragePool", ins, outs,
+                   kernel_shape=list(op.kernel), strides=list(op.stride),
+                   pads=pads)]
+    if t in ("_BatchNorm2d", "_BatchNorm2dInfer"):
+        if t == "_BatchNorm2d":
+            rm, rv = op._bn_extras
+            mean_in = ctx.init_name_for(rm, "bn_mean")
+            var_in = ctx.init_name_for(rv, "bn_var")
+            ins = ins + [mean_in, var_in]
+            momentum = op._bn_momentum
+        else:
+            momentum = 0.9
+        return [mk("BatchNormalization", ins, outs, epsilon=op.eps,
+                   momentum=momentum)]
+    if t == "SoftMaxCrossEntropy":
+        # opset-12 SoftmaxCrossEntropyLoss; targets exported as int64 input
+        return [mk("SoftmaxCrossEntropyLoss", ins, outs, reduction="mean")]
+    if t == "Dropout":
+        return [mk("Dropout", ins[:1], outs,
+                   ratio=np.float32(op.ratio))]
+    if t == "Cast":
+        to = pb._NP2ONNX[np.dtype(op.to)]
+        return [mk("Cast", ins, outs, to=to)]
+    raise NotImplementedError(f"export of op {t} not supported yet")
+
+
+def _const_input(ctx: _Ctx, hint, arr):
+    name = ctx.fresh(hint)
+    ctx.add_initializer(name, arr)
+    return name
+
+
+def to_onnx_model(inputs, outputs, model_name="singa_tpu",
+                  param_names=None) -> pb.ModelProto:
+    """Build a ModelProto from traced outputs.
+
+    inputs: list[Tensor] fed to forward (tape leaves -> graph inputs);
+    outputs: list[Tensor] produced by a training-mode forward (so .creator
+    chains exist); param_names: optional {id(Tensor): scoped name}.
+    """
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    input_ids = {id(t): i for i, t in enumerate(inputs)}
+    ctx = _Ctx(param_names)
+
+    # topo order: DFS postorder over creator edges
+    order, seen = [], set()
+
+    def visit(op):
+        if op is None or id(op) in seen or isinstance(op, autograd.Dummy):
+            return
+        seen.add(id(op))
+        for src_op, _, _, _ in op.src:
+            visit(src_op)
+        order.append(op)
+
+    for y in outputs:
+        assert y.creator is not None, \
+            "trace with autograd.training=True before export"
+        visit(y.creator)
+
+    for op in order:
+        outs = _out_names(ctx, op)
+        ins = [_input_name(ctx, op, i, input_ids) for i in range(len(op.src))]
+        ctx.nodes.extend(_emit(ctx, op, ins, outs))
+
+    graph_outputs = []
+    for i, y in enumerate(outputs):
+        name = ctx.names[(y.creator, y.creator.y_id2idx[id(y)])]
+        graph_outputs.append(pb.make_value_info(
+            name, pb.TensorProto.FLOAT, y.shape))
+
+    graph = pb.GraphProto(name=model_name, node=ctx.nodes,
+                          initializer=ctx.initializers,
+                          input=ctx.graph_inputs, output=graph_outputs)
+    return pb.ModelProto(
+        ir_version=8, producer_name="singa_tpu", producer_version="0.1.0",
+        graph=graph,
+        opset_import=[pb.OperatorSetIdProto(domain="", version=OPSET_VERSION)])
+
+
+def export(model, inputs, fpath: str, model_name="singa_tpu"):
+    """Trace `model.forward(*inputs)` and write an .onnx file."""
+    # snapshot states: the training-mode trace mutates BN running stats,
+    # which must neither leak into the exported initializers nor corrupt
+    # the live model
+    snapshot = None
+    if hasattr(model, "get_states"):
+        snapshot = {k: np.array(t.numpy())
+                    for k, t in model.get_states().items()}
+    prev = autograd.training
+    autograd.training = True
+    try:
+        out = model.forward(*inputs)
+    finally:
+        autograd.training = prev
+        if snapshot is not None:
+            model.set_states(snapshot)
+    if isinstance(out, Tensor):
+        out = [out]
+    param_names = None
+    if hasattr(model, "get_states"):
+        param_names = {id(t): k for k, t in model.get_states().items()}
+    m = to_onnx_model(list(inputs), list(out), model_name, param_names)
+    pb.save_model(m, fpath)
+    return m

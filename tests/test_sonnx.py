@@ -1,0 +1,142 @@
+"""sonnx tests: protobuf codec roundtrip, export->import numeric parity,
+SONNXModel retraining (ref test/python/test_onnx.py strategy)."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import autograd, layer, models, opt, tensor
+from singa_tpu import sonnx
+from singa_tpu.sonnx import onnx_pb as pb
+
+
+def test_codec_roundtrip():
+    w = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    node = pb.make_node("Gemm", ["x", "w"], ["y"], alpha=1.0, transB=1,
+                        pads=[1, 1], mode="constant")
+    graph = pb.GraphProto(
+        name="g", node=[node],
+        initializer=[pb.numpy_to_tensor(w, "w")],
+        input=[pb.make_value_info("x", pb.TensorProto.FLOAT, (2, 3))],
+        output=[pb.make_value_info("y", pb.TensorProto.FLOAT, (2, 4))])
+    m = pb.ModelProto(ir_version=8, producer_name="t", graph=graph,
+                      opset_import=[pb.OperatorSetIdProto(domain="",
+                                                          version=13)])
+    m2 = pb.ModelProto.FromString(m.SerializeToString())
+    assert m2.ir_version == 8
+    assert m2.graph.node[0].op_type == "Gemm"
+    attrs = m2.graph.node[0].attrs()
+    assert attrs["alpha"] == 1.0 and attrs["transB"] == 1
+    assert attrs["pads"] == [1, 1] and attrs["mode"] == "constant"
+    np.testing.assert_array_equal(
+        pb.tensor_to_numpy(m2.graph.initializer[0]), w)
+    vi = m2.graph.input[0]
+    assert vi.name == "x"
+    assert [d.dim_value for d in vi.type.tensor_type.shape.dim] == [2, 3]
+
+
+def test_codec_negative_and_dtypes():
+    t = pb.numpy_to_tensor(np.array([-5, 7], np.int64), "i")
+    t2 = pb.TensorProto.FromString(t.SerializeToString())
+    np.testing.assert_array_equal(pb.tensor_to_numpy(t2),
+                                  np.array([-5, 7], np.int64))
+    a = pb.make_attribute("axis", -1)
+    a2 = pb.AttributeProto.FromString(a.SerializeToString())
+    assert a2.value() == -1
+
+
+def _trace_and_roundtrip(m, x_np, dev, tmp_path):
+    tx = tensor.Tensor(data=x_np, device=dev)
+    m.compile([tx], is_train=False, use_graph=False)
+    # reference output in eval mode
+    m.eval()
+    ref = m.forward(tx).numpy()
+    proto = sonnx.export(m, [tx], str(tmp_path / "m.onnx"))
+    loaded = sonnx.load_model(str(tmp_path / "m.onnx"))
+    assert len(loaded.graph.node) == len(proto.graph.node)
+    rep = sonnx.prepare(loaded, dev)
+    prev = autograd.training
+    autograd.training = False
+    try:
+        out = rep.run([tensor.Tensor(data=x_np, device=dev)])[0]
+    finally:
+        autograd.training = prev
+    return ref, out.numpy()
+
+
+def test_mlp_export_import_parity(dev, tmp_path):
+    x = np.random.RandomState(0).randn(4, 10).astype(np.float32)
+    m = models.create_model("mlp", data_size=10, num_classes=3)
+    ref, got = _trace_and_roundtrip(m, x, dev, tmp_path)
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-5)
+
+
+def test_cnn_export_import_parity(dev, tmp_path):
+    x = np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32)
+    m = models.create_model("cnn")
+    ref, got = _trace_and_roundtrip(m, x, dev, tmp_path)
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-4)
+
+
+def test_sonnx_model_retrains(dev, tmp_path, train_mode):
+    x_np = np.random.RandomState(0).randn(16, 10).astype(np.float32)
+    y_np = (x_np.sum(1) > 0).astype(np.int32)
+    m = models.create_model("mlp", data_size=10, num_classes=2)
+    tx = tensor.Tensor(data=x_np, device=dev)
+    m.compile([tx], is_train=False, use_graph=False)
+    sonnx.export(m, [tx], str(tmp_path / "mlp.onnx"))
+
+    loaded = sonnx.load_model(str(tmp_path / "mlp.onnx"))
+
+    class Retrain(sonnx.SONNXModel):
+        def __init__(self, proto):
+            super().__init__(proto, dev)
+            self.sce = layer.SoftMaxCrossEntropy()
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = self.sce(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    rm = Retrain(loaded)
+    rm.set_optimizer(opt.SGD(lr=0.1))
+    ty = tensor.from_numpy(y_np, device=dev)
+    rm.compile([tx], is_train=True, use_graph=True)
+    losses = []
+    for _ in range(6):
+        _, loss = rm(tx, ty)
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_backend_raises_on_unknown_op(dev):
+    node = pb.make_node("TotallyFakeOp", ["x"], ["y"])
+    graph = pb.GraphProto(
+        name="g", node=[node],
+        input=[pb.make_value_info("x", pb.TensorProto.FLOAT, (1,))],
+        output=[pb.make_value_info("y", pb.TensorProto.FLOAT, (1,))])
+    m = pb.ModelProto(ir_version=8, graph=graph)
+    rep = sonnx.prepare(m, dev)
+    with pytest.raises(NotImplementedError):
+        rep.run([tensor.from_numpy(np.zeros(1, np.float32), device=dev)])
+
+
+def test_backend_handcrafted_graph(dev):
+    """Run a hand-built graph: y = relu(x @ W + b)."""
+    rng = np.random.RandomState(0)
+    W = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    nodes = [pb.make_node("MatMul", ["x", "W"], ["xw"]),
+             pb.make_node("Add", ["xw", "b"], ["z"]),
+             pb.make_node("Relu", ["z"], ["y"])]
+    graph = pb.GraphProto(
+        name="g", node=nodes,
+        initializer=[pb.numpy_to_tensor(W, "W"), pb.numpy_to_tensor(b, "b")],
+        input=[pb.make_value_info("x", pb.TensorProto.FLOAT, (2, 3))],
+        output=[pb.make_value_info("y", pb.TensorProto.FLOAT, (2, 4))])
+    m = pb.ModelProto(ir_version=8, graph=graph)
+    rep = sonnx.prepare(m, dev)
+    x = rng.randn(2, 3).astype(np.float32)
+    out = rep.run([tensor.from_numpy(x, device=dev)])[0]
+    np.testing.assert_allclose(out.numpy(), np.maximum(x @ W + b, 0),
+                               rtol=1e-5, atol=1e-6)
