@@ -1,0 +1,17 @@
+"""Distributed ResNet-50 throughput benchmark (ref
+examples/cifar_distributed_cnn/benchmark.py). Wrapper over
+examples/cnn/benchmark.py with --dist forced; scaling efficiency =
+throughput(N) / (N * throughput(1))."""
+
+import os
+import runpy
+import sys
+
+if __name__ == "__main__":
+    cnn_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "..", "cnn")
+    sys.path.insert(0, cnn_dir)
+    if "--dist" not in sys.argv:
+        sys.argv.append("--dist")
+    sys.argv[0] = os.path.join(cnn_dir, "benchmark.py")
+    runpy.run_path(sys.argv[0], run_name="__main__")

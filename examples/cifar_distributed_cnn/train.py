@@ -1,0 +1,20 @@
+"""Distributed CIFAR training (ref examples/cifar_distributed_cnn/ — the
+reference duplicates the cnn example and launches it under mpirun; here
+distribution is one process with a device mesh, so this wrapper runs
+examples/cnn/train_cnn.py with --dist forced).
+
+Usage: python train.py resnet cifar10 --epochs 10
+"""
+
+import os
+import runpy
+import sys
+
+if __name__ == "__main__":
+    cnn_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "..", "cnn")
+    sys.path.insert(0, cnn_dir)
+    if "--dist" not in sys.argv:
+        sys.argv.append("--dist")
+    sys.argv[0] = os.path.join(cnn_dir, "train_cnn.py")
+    runpy.run_path(sys.argv[0], run_name="__main__")

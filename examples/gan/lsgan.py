@@ -1,0 +1,9 @@
+"""Least-squares GAN (ref examples/gan/lsgan.py): vanilla.py with the
+MSE adversarial loss."""
+
+import sys
+
+if __name__ == "__main__":
+    sys.argv.append("--lsgan")
+    import vanilla
+    vanilla.main()

@@ -1,0 +1,109 @@
+"""Vanilla GAN on MNIST with MLP generator/discriminator (ref
+examples/gan/vanilla.py + model/gan_mlp.py). Two optimizers alternate, so
+training drives autograd directly instead of Model.train_one_batch."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from singa_tpu import autograd, device, layer, opt, tensor  # noqa: E402
+
+
+class Generator(layer.Layer):
+    def __init__(self, image_dim=784, hidden=256):
+        super().__init__()
+        self.fc1 = layer.Linear(hidden)
+        self.fc2 = layer.Linear(image_dim)
+
+    def forward(self, z):
+        h = autograd.relu(self.fc1(z))
+        return autograd.sigmoid(self.fc2(h))
+
+
+class Discriminator(layer.Layer):
+    def __init__(self, hidden=256):
+        super().__init__()
+        self.fc1 = layer.Linear(hidden)
+        self.fc2 = layer.Linear(1)
+
+    def forward(self, x):
+        h = autograd.relu(self.fc1(x))
+        return autograd.sigmoid(self.fc2(h))
+
+
+def load_real(batch, rng, train_x):
+    idx = rng.randint(0, train_x.shape[0], batch)
+    return train_x[idx]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--iters", type=int, default=200, help="iters per epoch")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--noise", type=int, default=100)
+    p.add_argument("--lsgan", action="store_true",
+                   help="least-squares loss (ref lsgan.py)")
+    args = p.parse_args()
+
+    dev = device.best_device()
+    rng = np.random.RandomState(0)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "cnn"))
+    from data import mnist
+    train_x, _, _, _ = mnist.load()
+    train_x = train_x.reshape(train_x.shape[0], -1).astype(np.float32)
+
+    G, D = Generator(train_x.shape[1]), Discriminator()
+    g_opt = opt.Adam(lr=2e-4)
+    d_opt = opt.Adam(lr=2e-4)
+    autograd.training = True
+
+    def d_loss(pred, is_real):
+        t = tensor.ones(pred.shape, device=dev) if is_real \
+            else tensor.zeros(pred.shape, device=dev)
+        t.requires_grad = False
+        if args.lsgan:
+            return autograd.mse_loss(pred, t)
+        return autograd.binary_cross_entropy(pred, t)
+
+    for epoch in range(args.epochs):
+        dl_sum = gl_sum = 0.0
+        for _ in range(args.iters):
+            # --- discriminator step ---
+            real = tensor.from_numpy(load_real(args.batch, rng, train_x),
+                                     device=dev)
+            z = tensor.gaussian(0, 1, (args.batch, args.noise), device=dev)
+            fake = G(z)
+            fake_detached = tensor.Tensor(data=fake.data, device=dev,
+                                          requires_grad=False)
+            loss_d = autograd.add(d_loss(D(real), True),
+                                  d_loss(D(fake_detached), False))
+            # fake is detached, so only D params receive grads here
+            for p_, g_ in autograd.backward(loss_d):
+                d_opt.apply(p_, g_)
+            d_opt.step()
+            dl_sum += float(loss_d.numpy())
+
+            # --- generator step ---
+            z = tensor.gaussian(0, 1, (args.batch, args.noise), device=dev)
+            loss_g = d_loss(D(G(z)), True)
+            d_params = {id(t) for t in D.get_params().values()}
+            for p_, g_ in autograd.backward(loss_g):
+                if id(p_) not in d_params:  # freeze D in the G step
+                    g_opt.apply(p_, g_)
+            g_opt.step()
+            gl_sum += float(loss_g.numpy())
+        print(f"epoch {epoch}: d_loss={dl_sum / args.iters:.4f} "
+              f"g_loss={gl_sum / args.iters:.4f}", flush=True)
+
+    out = G(tensor.gaussian(0, 1, (16, args.noise), device=dev))
+    np.save("/tmp/gan_samples.npy", out.numpy())
+    print("wrote /tmp/gan_samples.npy")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,167 @@
+"""Horizontal federated learning: socket-based FedAvg (ref
+examples/hfl/src/{server,client}.py, which use raw sockets + protobuf).
+
+Wire protocol here is length-prefixed pickled {name: ndarray} dicts — the
+reference's protobuf interface adds nothing on a trusted local link, and
+this sandbox ships no protoc-generated stubs. Each round: clients push
+weights, the server averages (FedAvg), clients pull and train locally.
+
+Demo (1 server + K clients as local processes, partitioned MNIST):
+  python fedavg.py --clients 2 --rounds 3
+"""
+
+import argparse
+import multiprocessing as mp
+import os
+import pickle
+import socket
+import struct
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def send_msg(conn, obj):
+    data = pickle.dumps(obj)
+    conn.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def recv_msg(conn):
+    raw = b""
+    while len(raw) < 8:
+        part = conn.recv(8 - len(raw))
+        if not part:
+            raise ConnectionError("peer closed")
+        raw += part
+    n = struct.unpack("<Q", raw)[0]
+    chunks = []
+    while n:
+        part = conn.recv(min(n, 1 << 20))
+        if not part:
+            raise ConnectionError("peer closed")
+        chunks.append(part)
+        n -= len(part)
+    return pickle.loads(b"".join(chunks))
+
+
+class Server:
+    """Accepts `num_clients` connections; each round pulls client weights,
+    FedAvg-aggregates, pushes the global weights back."""
+
+    def __init__(self, num_clients, host="127.0.0.1", port=12470):
+        self.num_clients = num_clients
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.sock.listen()
+        self.conns = [None] * num_clients
+
+    def start(self):
+        for _ in range(self.num_clients):
+            conn, _ = self.sock.accept()
+            rank = recv_msg(conn)
+            self.conns[rank] = conn
+        assert None not in self.conns
+
+    def round(self):
+        updates = [recv_msg(c) for c in self.conns]
+        avg = {k: np.mean([u[k] for u in updates], axis=0)
+               for k in updates[0]}
+        for c in self.conns:
+            send_msg(c, avg)
+
+    def close(self):
+        for c in self.conns:
+            c.close()
+        self.sock.close()
+
+
+class Client:
+    def __init__(self, rank, host="127.0.0.1", port=12470, retries=50):
+        self.sock = socket.socket()
+        for _ in range(retries):
+            try:
+                self.sock.connect((host, port))
+                break
+            except ConnectionRefusedError:
+                time.sleep(0.2)
+        send_msg(self.sock, rank)
+
+    def push(self, weights):
+        send_msg(self.sock, weights)
+
+    def pull(self):
+        return recv_msg(self.sock)
+
+    def close(self):
+        self.sock.close()
+
+
+# ---------------- demo: K clients training partitioned MNIST -------------
+
+def run_server(num_clients, rounds, port):
+    s = Server(num_clients, port=port)
+    s.start()
+    for r in range(rounds):
+        s.round()
+        print(f"[server] round {r} aggregated", flush=True)
+    s.close()
+
+
+def run_client(rank, world, rounds, port):
+    from singa_tpu import device, models, opt, tensor
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "cnn"))
+    from data import mnist
+
+    dev = device.best_device()
+    tx_all, ty_all, vx, vy = mnist.load()
+    n = len(tx_all) // world
+    x = tx_all[rank * n:(rank + 1) * n].reshape(n, -1)
+    y = ty_all[rank * n:(rank + 1) * n]
+
+    m = models.create_model("mlp", data_size=x.shape[1], num_classes=10)
+    m.set_optimizer(opt.SGD(lr=0.05, momentum=0.9))
+    bs = 64
+    tx = tensor.Tensor(data=x[:bs].astype(np.float32), device=dev)
+    ty = tensor.from_numpy(y[:bs], device=dev)
+    m.compile([tx], is_train=True, use_graph=True)
+
+    c = Client(rank, port=port)
+    for r in range(rounds):
+        # local epoch
+        m.train()
+        for b in range(len(x) // bs):
+            tx.copy_from_numpy(x[b * bs:(b + 1) * bs].astype(np.float32))
+            ty.copy_from_numpy(y[b * bs:(b + 1) * bs])
+            out, loss = m(tx, ty)
+        # FedAvg exchange
+        c.push({k: np.asarray(t.numpy())
+                for k, t in m.get_params().items()})
+        m.set_params(c.pull())
+        if rank == 0:
+            print(f"[client0] round {r} local loss={float(loss.numpy()):.4f}",
+                  flush=True)
+    c.close()
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--clients", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--port", type=int, default=12470)
+    args = p.parse_args()
+
+    procs = [mp.Process(target=run_server,
+                        args=(args.clients, args.rounds, args.port))]
+    for r in range(args.clients):
+        procs.append(mp.Process(target=run_client,
+                                args=(r, args.clients, args.rounds,
+                                      args.port)))
+    for pr in procs:
+        pr.start()
+    for pr in procs:
+        pr.join()
+    print("federated training complete")

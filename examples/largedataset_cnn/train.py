@@ -1,0 +1,84 @@
+"""Training from record files too large for memory (ref
+examples/largedataset_cnn/). Data is stored as crc-checked records
+(singa_tpu.io, C++ reader with threaded prefetch); each record is one
+(label, image) pair; the train loop streams batches off disk.
+
+Usage:
+  python train.py --make-data /tmp/cifar.rec   # build a record file
+  python train.py --data /tmp/cifar.rec --epochs 2
+"""
+
+import argparse
+import os
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from singa_tpu import device, io, models, opt, tensor  # noqa: E402
+
+
+def make_data(path, n=4096):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "cnn"))
+    from data import cifar10
+    tx, ty, _, _ = cifar10.load()
+    with io.RecordWriter(path) as w:
+        for i in range(min(n, len(tx))):
+            val = struct.pack("<i", int(ty[i])) + \
+                tx[i].astype(np.float32).tobytes()
+            w.write(f"img{i}", val)
+    print(f"wrote {min(n, len(tx))} records to {path} "
+          f"({os.path.getsize(path) / 1e6:.1f} MB)")
+
+
+def record_batches(path, batch_size, shape=(3, 32, 32)):
+    xs, ys = [], []
+    for _, val in io.RecordReader(path):
+        label = struct.unpack("<i", val[:4])[0]
+        img = np.frombuffer(val[4:], np.float32).reshape(shape)
+        xs.append(img)
+        ys.append(label)
+        if len(xs) == batch_size:
+            yield np.stack(xs), np.asarray(ys, np.int32)
+            xs, ys = [], []
+
+
+def train(args):
+    dev = device.best_device()
+    m = models.create_model("cnn", num_channels=3)
+    m.set_optimizer(opt.SGD(lr=args.lr, momentum=0.9))
+
+    first = next(record_batches(args.data, args.batch))
+    tx = tensor.Tensor(data=first[0], device=dev)
+    ty = tensor.from_numpy(first[1], device=dev)
+    m.compile([tx], is_train=True, use_graph=True)
+
+    for epoch in range(args.epochs):
+        n, correct, loss_sum = 0, 0, 0.0
+        for xb, yb in record_batches(args.data, args.batch):
+            tx.copy_from_numpy(xb)
+            ty.copy_from_numpy(yb)
+            out, loss = m(tx, ty)
+            loss_sum += float(loss.numpy())
+            correct += int((np.argmax(out.numpy(), 1) == yb).sum())
+            n += len(yb)
+        print(f"epoch {epoch}: loss={loss_sum / max(n // args.batch, 1):.4f} "
+              f"acc={correct / max(n, 1):.4f} ({n} imgs)", flush=True)
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--data", default="/tmp/cifar.rec")
+    p.add_argument("--make-data", dest="make", default=None, metavar="PATH")
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+    if args.make:
+        make_data(args.make)
+    else:
+        if not os.path.exists(args.data):
+            make_data(args.data)
+        train(args)

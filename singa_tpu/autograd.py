@@ -148,7 +148,11 @@ def backward(y: Tensor, dy=None):
         dy = _raw(dy)
 
     not_ready = {}  # op -> [grad per output]
-    ready = deque([(y.creator, [dy])])
+    # seed the cotangent into the slot of THIS output (a multi-output op's
+    # backward may start from any of its outputs)
+    seed = [None] * y.creator._n_out
+    seed[y.creator.y_id2idx.get(id(y), 0)] = dy
+    ready = deque([(y.creator, seed)])
     visited = {y.creator}
 
     while ready:

@@ -623,6 +623,101 @@ class TransformerBlock(Layer):
         return autograd.add(x, self.fc2(h))
 
 
+class MoE(Layer):
+    """Switch-style mixture-of-experts FFN over (..., D) activations.
+
+    `ep_axis` shards experts over that mesh axis (all_to_all dispatch,
+    parallel/moe.py); out of mesh scope it falls back to the dense path.
+    After forward, `self.aux_loss` holds the load-balancing loss as a tape
+    Tensor — add `autograd.mul(moe.aux_loss, weight)` into the training
+    loss INSIDE train_one_batch (it participates in the same trace; reading
+    it outside a jitted step is undefined). Under ep_axis, expert-param
+    gradients are pre-scaled so a mean-reduction over the axis (DistOpt
+    semantics) recovers the dense-equivalent gradient.
+    """
+
+    def __init__(self, num_experts, hidden=None, capacity_factor=1.25,
+                 ep_axis=None, name=None):
+        super().__init__(name)
+        self.num_experts = num_experts
+        self.hidden = hidden
+        self.capacity_factor = capacity_factor
+        self.ep_axis = ep_axis
+        self.aux_loss = None
+
+    def initialize(self, x):
+        d = x.shape[-1]
+        h = self.hidden or 4 * d
+        E = self.num_experts
+        Wg = Tensor((d, E), device=x.device, dtype=x.dtype)
+        initializer.glorot_uniform(Wg)
+        self._register_param("Wg", Wg)
+        W1 = Tensor((E, d, h), device=x.device, dtype=x.dtype)
+        W1.gaussian(0.0, (2.0 / d) ** 0.5)
+        self._register_param("W1", W1)
+        b1 = Tensor((E, h), device=x.device, dtype=x.dtype)
+        b1.set_value(0.0)
+        self._register_param("b1", b1)
+        W2 = Tensor((E, h, d), device=x.device, dtype=x.dtype)
+        W2.gaussian(0.0, (2.0 / h) ** 0.5)
+        self._register_param("W2", W2)
+        b2 = Tensor((E, d), device=x.device, dtype=x.dtype)
+        b2.set_value(0.0)
+        self._register_param("b2", b2)
+
+    def forward(self, x):
+        op = _MoEOp(self)
+        y, aux = op(x, self.Wg, self.W1, self.b1, self.W2, self.b2)
+        self.aux_loss = aux  # tape Tensor; see class docstring
+        return y
+
+
+def _grad_scale(x, factor):
+    """Identity whose cotangent is scaled by `factor` (compensates a later
+    mean-reduction over a mesh axis)."""
+    import jax
+
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    f.defvjp(lambda v: (v, None), lambda _, g: (g * factor,))
+    return f(x)
+
+
+class _MoEOp(autograd.Operator):
+    def __init__(self, layer_ref):
+        super().__init__("MoE")
+        self.layer_ref = layer_ref
+
+    def forward(self, x, Wg, W1, b1, W2, b2):
+        from .parallel.moe import moe_ffn, moe_ffn_ep
+        from jax import lax as _lax
+        lyr = self.layer_ref
+        shape = x.shape
+        flat = x.reshape(-1, shape[-1])
+        in_mesh = False
+        if lyr.ep_axis is not None:
+            try:
+                n = _lax.axis_size(lyr.ep_axis)  # probes mesh scope only
+                in_mesh = True
+            except NameError:
+                in_mesh = False
+        if in_mesh:
+            # params are replicated; each device computes only its expert
+            # slice; grad-scale by n so the step's pmean over ep_axis
+            # yields the dense-equivalent expert gradient
+            my = _lax.axis_index(lyr.ep_axis)
+            el = W1.shape[0] // n
+            sl = lambda a: _grad_scale(
+                _lax.dynamic_slice_in_dim(a, my * el, el, 0), n)
+            y, aux = moe_ffn_ep(flat, Wg, sl(W1), sl(b1), sl(W2), sl(b2),
+                                lyr.ep_axis, lyr.capacity_factor)
+        else:
+            y, aux = moe_ffn(flat, Wg, W1, b1, W2, b2, lyr.capacity_factor)
+        return y.reshape(shape), aux
+
+
 # ---- recurrent (ref layer.py:1115-1347 + CudnnRNN:1550) ------------------
 
 

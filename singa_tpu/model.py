@@ -147,6 +147,9 @@ class Model(Layer, metaclass=ModelMeta):
     def __call__(self, *args, **kwargs):
         if self.training:
             return self.train_one_batch(*args, **kwargs)
+        if self.graph_mode and self._device is not None and not kwargs \
+                and all(isinstance(a, Tensor) for a in args):
+            return self._eval_step(args)
         return self.forward(*args, **kwargs)
 
     # ---- the jitted step -------------------------------------------------
@@ -289,6 +292,42 @@ class Model(Layer, metaclass=ModelMeta):
         tensors = [Tensor(data=a, device=dev, requires_grad=False)
                    for a in outs]
         return _rebuild_out(self._out_template_box["t"], tensors)
+
+    # ---- jitted inference (graph mode for eval; the reference replays its
+    # buffered graph for eval too, model.py:94-100) ------------------------
+    def _eval_step(self, args):
+        if getattr(self, "_compiled_eval", None) is None:
+            states = self.get_states()
+            eval_tensors = list(states.values())
+
+            def efwd(state_arrs, input_arrs):
+                for t, a in zip(eval_tensors, state_arrs):
+                    t.data = a
+                prev = autograd.training
+                autograd.training = False
+                try:
+                    out = self.forward(*[Tensor(data=a, device=self._device,
+                                                requires_grad=False)
+                                         for a in input_arrs])
+                finally:
+                    autograd.training = prev
+                leaves, template = _flatten_out(out)
+                self._eval_template = template
+                return [o.data for o in leaves]
+
+            self._eval_tensors = eval_tensors
+            self._compiled_eval = jax.jit(efwd)
+        concrete = [t.data for t in self._eval_tensors]
+        try:
+            outs = self._compiled_eval(concrete, [a.data for a in args])
+        finally:
+            # tracing assigns tracers into the state Tensors; put the real
+            # arrays back so later eager/train calls see concrete buffers
+            for t, a in zip(self._eval_tensors, concrete):
+                t.data = a
+        tensors = [Tensor(data=a, device=self._device, requires_grad=False)
+                   for a in outs]
+        return _rebuild_out(self._eval_template, tensors)
 
     # ---- checkpointing (ref model.py:244-354) ----------------------------
     def save_states(self, fpath: str, aux_states: dict | None = None):

@@ -1,0 +1,88 @@
+"""Mixture-of-Experts with expert parallelism over a mesh axis (no
+reference counterpart — SINGA has no MoE; EP is first-class here).
+
+Top-1 switch routing with capacity: tokens pick an expert by gate
+probability; each expert accepts at most `capacity` tokens per device
+(overflow tokens pass through with zero expert output, standard switch
+behavior). Under EP, experts are sharded over the 'ep' axis and token
+blocks move with TWO lax.all_to_all hops (dispatch + return) — the
+all-to-all rides ICI and XLA overlaps it with the expert matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_gating(x, Wg, capacity: int):
+    """x: (T, D) tokens; Wg: (D, E). Returns (dispatch (T,E,C) one-hot,
+    combine (T,E,C) gate-weighted, aux_loss scalar)."""
+    probs = jax.nn.softmax(jnp.dot(x, Wg), axis=-1)       # (T, E)
+    E = probs.shape[-1]
+    idx = jnp.argmax(probs, axis=-1)                      # (T,)
+    mask = jax.nn.one_hot(idx, E, dtype=x.dtype)          # (T, E)
+    gate = jnp.sum(probs * mask, axis=-1)                 # (T,)
+    # position of each token within its expert's queue
+    pos = (jnp.cumsum(mask, axis=0) - 1.0) * mask         # (T, E)
+    keep = mask * (pos < capacity).astype(x.dtype)
+    pos_idx = jnp.sum(pos * mask, axis=-1).astype(jnp.int32)  # (T,)
+    slot = jax.nn.one_hot(pos_idx, capacity, dtype=x.dtype)   # (T, C)
+    dispatch = keep[:, :, None] * slot[:, None, :]        # (T, E, C)
+    combine = dispatch * gate[:, None, None]
+    # switch-transformer load-balancing loss: E * sum(frac_tokens * frac_prob)
+    frac_tokens = jnp.mean(mask, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(blocks, W1, b1, W2, b2, act):
+    """blocks: (E, C, D); per-expert two-layer FFN, batched over E."""
+    h = act(jnp.einsum("ecd,edh->ech", blocks, W1) + b1[:, None, :])
+    return jnp.einsum("ech,ehd->ecd", h, W2) + b2[:, None, :]
+
+
+def moe_ffn(x, Wg, W1, b1, W2, b2, capacity_factor=1.25, act=None):
+    """Single-device MoE: x (T, D); W1 (E, D, H); W2 (E, H, D)."""
+    act = act or jax.nn.gelu
+    T = x.shape[0]
+    E = W1.shape[0]
+    capacity = max(1, int(T * capacity_factor / E))
+    dispatch, combine, aux = top1_gating(x, Wg, capacity)
+    blocks = jnp.einsum("tec,td->ecd", dispatch, x)       # (E, C, D)
+    out_blocks = _expert_ffn(blocks, W1, b1, W2, b2, act)
+    return jnp.einsum("tec,ecd->td", combine, out_blocks), aux
+
+
+def moe_ffn_ep(x, Wg, W1, b1, W2, b2, axis_name: str,
+               capacity_factor=1.25, act=None):
+    """Expert-parallel MoE inside shard_map.
+
+    x: (T_local, D) this device's tokens; Wg (D, E_global) replicated;
+    W1/b1/W2/b2 hold only the E_local = E_global/n experts this device
+    owns. Token blocks for remote experts travel via all_to_all.
+    """
+    act = act or jax.nn.gelu
+    n = lax.axis_size(axis_name)
+    T = x.shape[0]
+    E = Wg.shape[1]
+    e_local = E // n
+    capacity = max(1, int(T * capacity_factor / E))
+    dispatch, combine, aux = top1_gating(x, Wg, capacity)
+    blocks = jnp.einsum("tec,td->ecd", dispatch, x)       # (E, C, D)
+    # group by owning device and exchange: (n, E_local, C, D) -> each
+    # device receives its expert group from everyone -> (E_local, n, C, D)
+    grouped = blocks.reshape(n, e_local, capacity, -1)
+    received = lax.all_to_all(grouped, axis_name, split_axis=0,
+                              concat_axis=1)              # (e_local,n,C,D)
+    stacked = received.reshape(e_local, n * capacity, -1)
+    out = _expert_ffn(stacked, W1, b1, W2, b2, act)       # (e_local,nC,D)
+    out = out.reshape(e_local, n, capacity, -1)
+    returned = lax.all_to_all(out, axis_name, split_axis=1,
+                              concat_axis=0)              # (n,e_local,C,D)
+    out_blocks = returned.reshape(E, capacity, -1)
+    y = jnp.einsum("tec,ecd->td", combine, out_blocks)
+    aux = lax.pmean(aux, axis_name)
+    return y, aux

@@ -129,3 +129,42 @@ def test_optimizer_state_threaded_through_graph(dev, data):
     assert float(np.asarray(sgd.step_counter)) == 3.0
     bufs = [v for st in sgd._states.values() for v in st.values()]
     assert bufs and all(float(np.abs(np.asarray(b)).max()) > 0 for b in bufs)
+
+
+def test_eval_twice_and_interleave(dev):
+    """Regression: jitted eval must not leak tracers into state tensors
+    (second eval call used to fail with UnexpectedTracerError)."""
+    import numpy as np
+    from singa_tpu import layer, model, opt, tensor
+
+    class Net(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc = layer.Linear(4)
+            self.sce = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            return self.fc(x)
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = self.sce(out, y)
+            self.optimizer(loss)
+            return out, loss
+
+    m = Net()
+    m.set_optimizer(opt.SGD(lr=0.1))
+    x = tensor.Tensor(data=np.random.randn(8, 6).astype(np.float32),
+                      device=dev)
+    y = tensor.from_numpy(np.zeros(8, np.int32), device=dev)
+    m.compile([x], is_train=True, use_graph=True)
+    m(x, y)
+    m.eval()
+    a = m(x).numpy()
+    b = m(x).numpy()          # second jitted-eval call
+    np.testing.assert_array_equal(a, b)
+    m.train()
+    m(x, y)                   # training resumes on concrete buffers
+    m.eval()
+    c = m(x).numpy()
+    assert not np.allclose(a, c)  # params moved
