@@ -1,0 +1,70 @@
+"""2-process CPU demo of the multi-host bootstrap (singa_tpu.distributed).
+
+Mirrors the reference's multiprocess bootstrap demo
+(examples/cnn/train_multiprocess.py:100-111 — fork workers, share an
+NCCL id): here the shared secret is the coordinator address, and the
+collective is an XLA psum over a global mesh spanning both processes.
+
+Run: python examples/multihost/demo_2proc.py
+Each process contributes rank+1; both must print total == 3.
+"""
+
+import os
+import subprocess
+import sys
+
+WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["SINGA_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)  # 2 local devices per process
+
+from singa_tpu import distributed
+
+distributed.init()  # coordinator/nprocs/proc_id from SINGA_* env
+rank = distributed.process_index()
+assert distributed.process_count() == 2
+
+mesh = distributed.global_mesh()            # 4 devices across 2 processes
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+@jax.jit
+@lambda f: jax.shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P(),
+                         check_vma=False)
+def total(x):
+    return jax.lax.psum(jnp.sum(x), "data")
+
+# this process owns 2 of the 4 shards; fill them with rank+1
+local = np.full((2, 1), float(rank + 1), np.float32)
+arrs = [jax.device_put(local[i:i + 1], d)
+        for i, d in enumerate(mesh.local_devices)]
+import jax.sharding as jsh
+global_x = jax.make_array_from_single_device_arrays(
+    (4, 1), jsh.NamedSharding(mesh, P("data")), arrs)
+out = float(total(global_x))
+print(f"proc {rank}: global sum = {out}", flush=True)
+assert out == 6.0, out  # 2 shards * 1.0 + 2 shards * 2.0
+"""
+
+
+def main():
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                        "..", ".."))
+    env_base = {**os.environ, "SINGA_REPO": repo,
+                "SINGA_COORDINATOR": "127.0.0.1:29507",
+                "SINGA_NPROCS": "2", "JAX_PLATFORMS": "cpu"}
+    procs = []
+    for rank in range(2):
+        env = {**env_base, "SINGA_PROC_ID": str(rank)}
+        procs.append(subprocess.Popen([sys.executable, "-c", WORKER],
+                                      env=env))
+    rc = [p.wait(timeout=120) for p in procs]
+    assert rc == [0, 0], rc
+    print("2-process bootstrap + cross-process psum OK")
+
+
+if __name__ == "__main__":
+    main()

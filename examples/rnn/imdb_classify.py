@@ -3,7 +3,10 @@ examples/rnn/imdb_train.py / imdb_model.py, which use CudnnRNN). Reads an
 IMDB-style token file if present, else a synthetic separable dataset.
 
 The model is Embedding -> LSTM (lax.scan, one tape op) -> last hidden ->
-Linear, trained with softmax CE through Model graph mode.
+Linear, trained with softmax CE through Model graph mode. Sequences carry
+TRUE per-sample lengths through the variable-length scan path (parity with
+the reference's GpuRNNForwardTrainingEx, rnn.h:117-131): padding tokens
+never touch the recurrence.
 """
 
 import argparse
@@ -26,14 +29,14 @@ class LSTMClassifier(model.Model):
         self.fc = layer.Linear(num_classes)
         self.sce = layer.SoftMaxCrossEntropy()
 
-    def forward(self, x):
-        # x: (seq, batch) ids
+    def forward(self, x, lengths=None):
+        # x: (seq, batch) ids; lengths: (batch,) true sequence lengths
         e = self.embed(x)
-        hy, _, _ = self.lstm(e)
+        hy, _, _ = self.lstm(e, seq_lengths=lengths)
         return self.fc(hy)
 
-    def train_one_batch(self, x, y):
-        out = self.forward(x)
+    def train_one_batch(self, x, lengths, y):
+        out = self.forward(x, lengths)
         loss = self.sce(out, y)
         self.optimizer(loss)
         return out, loss
@@ -41,14 +44,17 @@ class LSTMClassifier(model.Model):
 
 def synthetic(vocab=200, seq=40, n=2048, seed=0):
     """Class 0 favors low token ids, class 1 high — linearly separable
-    through the embedding, so accuracy should exceed 90% quickly."""
+    through the embedding, so accuracy should exceed 90% quickly. Sample
+    lengths vary; tokens past a sample's length are zero padding."""
     rng = np.random.RandomState(seed)
     y = rng.randint(0, 2, n).astype(np.int32)
-    lo = rng.randint(0, vocab // 2, (n, seq))
+    lo = rng.randint(1, vocab // 2, (n, seq))
     hi = rng.randint(vocab // 2, vocab, (n, seq))
     mix = rng.rand(n, seq) < 0.7
     x = np.where(np.where(y[:, None] == 1, mix, ~mix), hi, lo)
-    return x.astype(np.int32), y
+    lengths = rng.randint(seq // 4, seq + 1, n).astype(np.int32)
+    x[np.arange(seq)[None, :] >= lengths[:, None]] = 0  # pad token
+    return x.astype(np.int32), lengths, y
 
 
 def main():
@@ -60,15 +66,16 @@ def main():
     args = p.parse_args()
 
     dev = device.best_device()
-    x, y = synthetic(args.vocab)
+    x, lengths, y = synthetic(args.vocab)
     n_train = int(0.9 * len(x))
 
     m = LSTMClassifier(args.vocab, args.hidden)
     m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
     bs = args.batch
     tx = tensor.from_numpy(x[:bs].T.copy(), device=dev)  # (seq, batch)
+    tl = tensor.from_numpy(lengths[:bs], device=dev)
     ty = tensor.from_numpy(y[:bs], device=dev)
-    m.compile([tx], is_train=True, use_graph=True)
+    m.compile([tx, tl], is_train=True, use_graph=True)
 
     for epoch in range(args.epochs):
         m.train()
@@ -77,8 +84,9 @@ def main():
         for b in range(n_train // bs):
             sel = order[b * bs:(b + 1) * bs]
             tx.copy_from_numpy(x[sel].T.copy())
+            tl.copy_from_numpy(lengths[sel])
             ty.copy_from_numpy(y[sel])
-            out, loss = m(tx, ty)
+            out, loss = m(tx, tl, ty)
             loss_sum += float(loss.numpy())
             correct += int((np.argmax(out.numpy(), 1) == y[sel]).sum())
             seen += bs
@@ -86,11 +94,12 @@ def main():
               f"acc={correct / seen:.4f}", flush=True)
 
     m.eval()
-    val_x, val_y = x[n_train:], y[n_train:]
+    val_x, val_l, val_y = x[n_train:], lengths[n_train:], y[n_train:]
     correct = 0
     for b in range(len(val_x) // bs):
         sel = slice(b * bs, (b + 1) * bs)
-        out = m(tensor.from_numpy(val_x[sel].T.copy(), device=dev))
+        out = m(tensor.from_numpy(val_x[sel].T.copy(), device=dev),
+                tensor.from_numpy(val_l[sel], device=dev))
         correct += int((np.argmax(out.numpy(), 1) == val_y[sel]).sum())
     print(f"val acc={correct / (len(val_x) // bs * bs):.4f}")
 

@@ -473,6 +473,19 @@ class Unsqueeze(Operator):
         return x
 
 
+class Flip(Operator):
+    def __init__(self, axis=0):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return jnp.flip(x, axis=self.axis)
+
+
+def flip(x, axis=0):
+    return Flip(axis)(x)
+
+
 class Transpose(Operator):
     def __init__(self, perm=None):
         super().__init__()
@@ -870,12 +883,14 @@ class _Conv2d(Operator):
     """Convolution; replaces CudnnConvHandle (convolution.h:105) with
     lax.conv_general_dilated which XLA tiles onto the MXU."""
 
-    def __init__(self, stride=(1, 1), padding=(0, 0), group=1, odd_padding=None):
+    def __init__(self, stride=(1, 1), padding=(0, 0), group=1,
+                 odd_padding=None, dilation=(1, 1)):
         super().__init__()
         self.stride = tuple(stride)
         self.padding = tuple(padding)
         self.group = group
         self.odd_padding = odd_padding  # (l, r, t, b) extra pad for "same"
+        self.dilation = tuple(dilation)
 
     def forward(self, x, W, b=None):
         ph, pw = self.padding
@@ -885,6 +900,7 @@ class _Conv2d(Operator):
             pad = [(ph + t, ph + bt), (pw + l, pw + r)]
         y = lax.conv_general_dilated(
             x, W, window_strides=self.stride, padding=pad,
+            rhs_dilation=self.dilation,
             feature_group_count=self.group,
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
@@ -981,14 +997,15 @@ class Dropout(Operator):
 
 class Embedding(Operator):
     """Row gather; vjp yields scatter-add grad for the table
-    (ref autograd.py:5648)."""
+    (ref autograd.py:5648).
 
-    def __init__(self, indices):
-        super().__init__()
-        self.indices = jnp.asarray(_raw(indices), dtype=jnp.int32)
+    The ids are a REAL tape input (int32, never differentiated), not a
+    captured constant — so ONNX export sees them as a graph edge and an
+    exported model takes its token ids as input instead of replaying the
+    trace batch."""
 
-    def forward(self, table):
-        return jnp.take(table, self.indices, axis=0)
+    def forward(self, ids, table):
+        return jnp.take(table, ids, axis=0)
 
 
 class LayerNorm(Operator):
@@ -1008,6 +1025,56 @@ class LayerNorm(Operator):
 class Gelu(Operator):
     def forward(self, x):
         return jax.nn.gelu(x)
+
+
+def axis_bound(name: str) -> bool:
+    """True iff mesh axis `name` is bound in the current trace (i.e. we
+    are inside a shard_map over it)."""
+    try:
+        lax.axis_size(name)
+        return True
+    except Exception:
+        return False
+
+
+class _TPCopy(Operator):
+    """Megatron's `f`: identity forward, psum backward over the TP axis.
+    Applied to the replicated input of a column-parallel matmul so dL/dx
+    sums each shard's contribution (tp.py docstring; no reference
+    counterpart — SINGA is data-parallel only, SURVEY.md §2.3)."""
+
+    def __init__(self, axis):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return x
+
+    def backward(self, dy):
+        return lax.psum(dy, self.axis)
+
+
+class _TPReduce(Operator):
+    """Megatron's `g`: psum forward over the TP axis, identity backward.
+    Applied to the partial output of a row-parallel matmul."""
+
+    def __init__(self, axis):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return lax.psum(x, self.axis)
+
+    def backward(self, dy):
+        return dy
+
+
+def tp_copy(x, axis):
+    return _TPCopy(axis)(x)
+
+
+def tp_reduce(x, axis):
+    return _TPReduce(axis)(x)
 
 
 class _FlashAttention(Operator):
@@ -1235,7 +1302,7 @@ def conv2d(handle, x, W, b=None):
     """handle: a layer-owned _Conv2d op-factory carrying geometry (parity
     with GpuConvForward(handle, ...), model_operation.i)."""
     op = _Conv2d(handle.stride, handle.padding, handle.group,
-                 handle.odd_padding)
+                 handle.odd_padding, getattr(handle, "dilation", (1, 1)))
     return op(x, W, b) if b is not None else op(x, W)
 
 
@@ -1278,7 +1345,13 @@ def dropout(x, ratio=0.5):
 
 
 def embedding(indices, table):
-    return Embedding(indices)(table)
+    if not isinstance(indices, Tensor):
+        indices = Tensor(data=jnp.asarray(_raw(indices), jnp.int32),
+                         device=table.device, requires_grad=False)
+    elif not jnp.issubdtype(indices.data.dtype, jnp.integer):
+        indices = Tensor(data=indices.data.astype(jnp.int32),
+                         device=indices.device, requires_grad=False)
+    return Embedding()(indices, table)
 
 
 def layernorm(x, gamma, beta, eps=1e-5):

@@ -153,9 +153,19 @@ class Layer(metaclass=LayerMeta):
 
 
 class Linear(Layer):
-    """y = x W + b (ref layer.py:287)."""
+    """y = x W + b (ref layer.py:287).
+
+    Tensor parallelism (no reference counterpart — SINGA is data-parallel
+    only, SURVEY.md §2.3): `tp_axis` names a mesh axis to shard the weight
+    over. `tp_mode="column"` splits the OUTPUT features (activations leave
+    sharded, zero comm, Megatron f on the input); `tp_mode="row"` splits
+    the INPUT features (one psum on the output, Megatron g). Params carry
+    their PartitionSpec in `.spec`, which Model's shard_mapped step uses
+    as the in/out sharding. Outside a mesh (eval / single device) the same
+    layer runs the dense math on the full weight."""
 
     def __init__(self, out_features: int, *args, bias: bool = True, name=None,
+                 tp_axis: str | None = None, tp_mode: str = "column",
                  **kwargs):
         super().__init__(name)
         # legacy call style Linear(in_features, out_features) (ref layer.py:294)
@@ -163,20 +173,35 @@ class Linear(Layer):
             out_features = args[0]
         self.out_features = out_features
         self.bias = bias
+        assert tp_mode in ("column", "row"), tp_mode
+        self.tp_axis = tp_axis
+        self.tp_mode = tp_mode
 
     def initialize(self, x):
         in_features = x.shape[-1]
         W = Tensor((in_features, self.out_features), device=x.device,
                    dtype=x.dtype)
         initializer.he_uniform(W)
+        if self.tp_axis is not None:
+            from jax.sharding import PartitionSpec as P
+            W.spec = P(None, self.tp_axis) if self.tp_mode == "column" \
+                else P(self.tp_axis, None)
         self._register_param("W", W)
         if self.bias:
             b = Tensor((self.out_features,), device=x.device, dtype=x.dtype)
             b.set_value(0.0)
+            if self.tp_axis is not None and self.tp_mode == "column":
+                from jax.sharding import PartitionSpec as P
+                b.spec = P(self.tp_axis)
             self._register_param("b", b)
 
     def forward(self, x):
+        tp = self.tp_axis is not None and autograd.axis_bound(self.tp_axis)
+        if tp and self.tp_mode == "column":
+            x = autograd.tp_copy(x, self.tp_axis)
         y = autograd.matmul(x, self.W)
+        if tp and self.tp_mode == "row":
+            y = autograd.tp_reduce(y, self.tp_axis)
         if self.bias:
             y = autograd.add_bias(y, self.b, axis=0)
         return y
@@ -240,11 +265,13 @@ class _ConvGeometry:
     """Carries conv geometry; plays the role of ConvHandle
     (src/model/operation/convolution.h:43) minus the cuDNN descriptors."""
 
-    def __init__(self, stride, padding, group, odd_padding=None):
+    def __init__(self, stride, padding, group, odd_padding=None,
+                 dilation=(1, 1)):
         self.stride = stride
         self.padding = padding
         self.group = group
         self.odd_padding = odd_padding
+        self.dilation = dilation
 
 
 def _pair(v):
@@ -272,8 +299,8 @@ class Conv2d(Layer):
         self.kernel_size = _pair(kernel_size)
         self.stride = _pair(stride)
         self.padding = _pair(padding)
-        self.dilation = _pair(dilation)
-        assert self.dilation == (1, 1), "dilation > 1 not yet supported"
+        self.dilation = _pair(dilation)  # rhs_dilation (atrous conv),
+        # parity with ConvHandle dilation (convolution.h:43)
         self.group = group
         self.bias = bias
         self.pad_mode = pad_mode
@@ -281,8 +308,11 @@ class Conv2d(Layer):
 
     def _same_odd_padding(self, x):
         # ONNX SAME_UPPER/SAME_LOWER: compute per-side pads (l, r, t, b)
+        # from the EFFECTIVE (dilated) kernel extent
         ih, iw = x.shape[2], x.shape[3]
-        kh, kw = self.kernel_size
+        dh, dw = self.dilation
+        kh = (self.kernel_size[0] - 1) * dh + 1
+        kw = (self.kernel_size[1] - 1) * dw + 1
         sh, sw = self.stride
         oh, ow = -(-ih // sh), -(-iw // sw)
         ph = max((oh - 1) * sh + kh - ih, 0)
@@ -306,7 +336,8 @@ class Conv2d(Layer):
         odd = None
         if self.pad_mode in ("SAME_UPPER", "SAME_LOWER"):
             odd = self._same_odd_padding(x)
-        self.handle = _ConvGeometry(self.stride, self.padding, self.group, odd)
+        self.handle = _ConvGeometry(self.stride, self.padding, self.group,
+                                    odd, self.dilation)
 
     def forward(self, x):
         y = autograd.conv2d(self.handle, x, self.W,
@@ -567,55 +598,82 @@ class LayerNorm(Layer):
 
 class MultiHeadAttention(Layer):
     """Self-attention over (B, S, E); the core runs as ONE fused tape op
-    (flash attention / ring attention when seq_axis is a mesh axis)."""
+    (flash attention / ring attention when seq_axis is a mesh axis).
 
-    def __init__(self, num_heads, causal=False, seq_axis=None, name=None):
+    `tp_axis` shards the heads Megatron-style: Wq/Wk/Wv column-parallel
+    (each device computes num_heads/tp local heads, zero comm), Wo
+    row-parallel (one psum). Composes with `seq_axis` ring attention."""
+
+    def __init__(self, num_heads, causal=False, seq_axis=None, tp_axis=None,
+                 name=None):
         super().__init__(name)
         self.num_heads = num_heads
         self.causal = causal
         self.seq_axis = seq_axis
+        self.tp_axis = tp_axis
 
     def initialize(self, x):
         e = x.shape[-1]
         assert e % self.num_heads == 0
+        spec_col = spec_row = None
+        if self.tp_axis is not None:
+            from jax.sharding import PartitionSpec as P
+            spec_col = P(None, self.tp_axis)
+            spec_row = P(self.tp_axis, None)
         for attr in ("Wq", "Wk", "Wv", "Wo"):
             W = Tensor((e, e), device=x.device, dtype=x.dtype)
             initializer.glorot_uniform(W)
+            W.spec = spec_row if attr == "Wo" else spec_col
             self._register_param(attr, W)
 
-    def _split(self, t, B, S):
-        h = self.num_heads
-        t = autograd.reshape(t, (B, S, h, -1))
+    def _split(self, t, B, S, heads):
+        t = autograd.reshape(t, (B, S, heads, -1))
         return autograd.transpose(t, (0, 2, 1, 3))  # (B,H,S,D)
 
     def forward(self, x):
         B, S, E = x.shape
-        q = self._split(autograd.matmul(x, self.Wq), B, S)
-        k = self._split(autograd.matmul(x, self.Wk), B, S)
-        v = self._split(autograd.matmul(x, self.Wv), B, S)
+        tp = self.tp_axis is not None and autograd.axis_bound(self.tp_axis)
+        heads = self.num_heads
+        if tp:
+            import jax
+            tp_size = jax.lax.axis_size(self.tp_axis)
+            assert heads % tp_size == 0, \
+                f"{heads} heads not divisible by tp={tp_size}"
+            heads //= tp_size
+            x = autograd.tp_copy(x, self.tp_axis)
+        q = self._split(autograd.matmul(x, self.Wq), B, S, heads)
+        k = self._split(autograd.matmul(x, self.Wk), B, S, heads)
+        v = self._split(autograd.matmul(x, self.Wv), B, S, heads)
         o = autograd.attention(q, k, v, causal=self.causal,
                                seq_axis=self.seq_axis)
         o = autograd.transpose(o, (0, 2, 1, 3))
-        o = autograd.reshape(o, (B, S, E))
-        return autograd.matmul(o, self.Wo)
+        o = autograd.reshape(o, (B, S, -1))
+        y = autograd.matmul(o, self.Wo)
+        if tp:
+            y = autograd.tp_reduce(y, self.tp_axis)
+        return y
 
 
 class TransformerBlock(Layer):
-    """Pre-LN block: x + MHA(LN(x)); x + MLP(LN(x))."""
+    """Pre-LN block: x + MHA(LN(x)); x + MLP(LN(x)). `tp_axis` makes the
+    attention head-parallel and the MLP column→row parallel (two psums per
+    block total, the Megatron layout)."""
 
     def __init__(self, num_heads, mlp_ratio=4, causal=True, seq_axis=None,
-                 name=None):
+                 tp_axis=None, name=None):
         super().__init__(name)
         self.ln1 = LayerNorm()
         self.attn = MultiHeadAttention(num_heads, causal=causal,
-                                       seq_axis=seq_axis)
+                                       seq_axis=seq_axis, tp_axis=tp_axis)
         self.ln2 = LayerNorm()
         self.mlp_ratio = mlp_ratio
+        self.tp_axis = tp_axis
 
     def initialize(self, x):
         e = x.shape[-1]
-        self.fc1 = Linear(e * self.mlp_ratio)
-        self.fc2 = Linear(e)
+        self.fc1 = Linear(e * self.mlp_ratio, tp_axis=self.tp_axis,
+                          tp_mode="column")
+        self.fc2 = Linear(e, tp_axis=self.tp_axis, tp_mode="row")
 
     def forward(self, x):
         x = autograd.add(x, self.attn(self.ln1(x)))
@@ -825,13 +883,14 @@ class CudnnRNN(Layer):
     API parity; `FusedRNN` is the honest alias."""
 
     def __init__(self, hidden_size, batch_first=False, name=None,
-                 return_sequences=True):
+                 return_sequences=True, bidirectional=False):
         super().__init__(name)
         self.hidden_size = hidden_size
         self.batch_first = batch_first
         self.return_sequences = return_sequences
+        self.bidirectional = bidirectional
 
-    def initialize(self, x, hx=None, cx=None):
+    def initialize(self, x, hx=None, cx=None, **kwargs):
         from .ops.rnn import init_lstm_params
         in_size = x.shape[2]  # feature axis is 2 in both layouts
         Wx, Wh, b = init_lstm_params(in_size, self.hidden_size, x.device,
@@ -839,9 +898,18 @@ class CudnnRNN(Layer):
         self._register_param("Wx", Wx)
         self._register_param("Wh", Wh)
         self._register_param("b", b)
+        if self.bidirectional:
+            Wx2, Wh2, b2 = init_lstm_params(in_size, self.hidden_size,
+                                            x.device, x.dtype)
+            self._register_param("Wx_r", Wx2)
+            self._register_param("Wh_r", Wh2)
+            self._register_param("b_r", b2)
 
-    def forward(self, x, hx=None, cx=None):
-        from .ops.rnn import lstm_scan
+    def forward(self, x, hx=None, cx=None, seq_lengths=None):
+        """seq_lengths (batch,) int32 enables the variable-length path
+        (parity with GpuRNNForwardTrainingEx, rnn.h:117-131): hy/cy are
+        each sample's state at its true last step, padded ys are zero."""
+        from .ops.rnn import lstm_scan, lstm_scan_ex
         if self.batch_first:
             x = autograd.transpose(x, (1, 0, 2))
         batch = x.shape[1]
@@ -850,7 +918,30 @@ class CudnnRNN(Layer):
             hx = Tensor((batch, self.hidden_size), device=dev, dtype=x.dtype)
         if cx is None:
             cx = Tensor((batch, self.hidden_size), device=dev, dtype=x.dtype)
-        ys, hy, cy = lstm_scan(x, hx, cx, self.Wx, self.Wh, self.b)
+        if seq_lengths is not None and not isinstance(seq_lengths, Tensor):
+            seq_lengths = tensor_module.from_numpy(
+                np.asarray(seq_lengths, np.int32), dev)
+
+        def run(xs, Wx, Wh, b):
+            if seq_lengths is not None:
+                return lstm_scan_ex(xs, seq_lengths, hx, cx, Wx, Wh, b)
+            return lstm_scan(xs, hx, cx, Wx, Wh, b)
+
+        ys, hy, cy = run(x, self.Wx, self.Wh, self.b)
+        if self.bidirectional:
+            from .ops.rnn import reverse_padded
+            if seq_lengths is not None:
+                xr = reverse_padded(x, seq_lengths)
+            else:
+                xr = autograd.flip(x, axis=0)
+            ys_r, hy_r, cy_r = run(xr, self.Wx_r, self.Wh_r, self.b_r)
+            if seq_lengths is not None:
+                ys_r = reverse_padded(ys_r, seq_lengths)
+            else:
+                ys_r = autograd.flip(ys_r, axis=0)
+            ys = autograd.cat((ys, ys_r), axis=2)
+            hy = autograd.cat((hy, hy_r), axis=1)
+            cy = autograd.cat((cy, cy_r), axis=1)
         if self.batch_first:
             ys = autograd.transpose(ys, (1, 0, 2))
         if self.return_sequences:

@@ -113,13 +113,19 @@ class Model(Layer, metaclass=ModelMeta):
         return self._optimizer
 
     def compile(self, inputs, is_train=True, use_graph=False,
-                sequential=False):
+                sequential=False, pipeline_axis=None, n_micro=1):
         """Dummy forward with concrete inputs to init all params
-        (ref model.py:156-184)."""
+        (ref model.py:156-184).
+
+        pipeline_axis/n_micro: mesh axis + microbatch count for GPipe
+        pipeline execution; consumed by pipeline-capable models (e.g.
+        models.transformer.PipelinedGPT) at param-init time."""
         assert len(inputs) > 0 and isinstance(inputs[0], Tensor)
         self._device = inputs[0].device
         self.graph_mode = use_graph
         self.sequential = sequential
+        self.pipeline_axis = pipeline_axis
+        self.n_micro = n_micro
         prev = autograd.training
         autograd.training = False  # init pass builds no tape
         try:
@@ -160,7 +166,11 @@ class Model(Layer, metaclass=ModelMeta):
         opt = self._optimizer
         if opt is not None:
             opt.setup(self.get_params().values())
-        dist = isinstance(opt, DistOpt) and opt.world_size > 1
+        # shard_map whenever a multi-device mesh is attached — the data
+        # axis may be size 1 when the mesh is carved for tp/pp only
+        dist = (isinstance(opt, DistOpt)
+                and opt.communicator.mesh is not None
+                and opt.communicator.mesh.size > 1)
 
         states = self.get_states()
         state_tensors = list(states.values())
@@ -177,62 +187,106 @@ class Model(Layer, metaclass=ModelMeta):
         self._static_args = static_args
         out_template_box = {}
 
-        def step(state_arrs, opt_arrs, rng, input_arrs):
-            if dist:
-                dev.rng_state = jax.random.fold_in(
-                    rng, lax.axis_index(opt.axis))
-            else:
-                dev.rng_state = rng
-            for t, a in zip(state_tensors, state_arrs):
-                t.data = a
-            if opt is not None and opt_arrs:
-                opt.load_state_arrays(opt_arrs)
-            call_args = []
-            j = 0
-            for i in range(len(example_args)):
-                if i in static_args:
-                    call_args.append(static_args[i])
+        def make_step(tag):
+            """Build + jit the step for one static step-tag. Tag 0 is the
+            only tag for ordinary optimizers; DistOpt's partial-update
+            strategy rotates tags so each compiled variant contains ONLY
+            its parameter partition's collectives (true bandwidth rotation,
+            unlike a runtime mask — resolves the opt.py partial NOTE)."""
+
+            def step(state_arrs, opt_arrs, rng, input_arrs):
+                if opt is not None:
+                    opt._partial_static_idx = tag
+                if dist:
+                    dev.rng_state = jax.random.fold_in(
+                        rng, lax.axis_index(opt.axis))
                 else:
-                    call_args.append(Tensor(data=input_arrs[j], device=dev,
-                                            requires_grad=False))
-                    j += 1
-            autograd.training = True
-            out = func(self, *call_args, **kwargs)
-            out_leaves, template = _flatten_out(out)
-            out_template_box["t"] = template
-            outs = [o.data for o in out_leaves]
+                    dev.rng_state = rng
+                for t, a in zip(state_tensors, state_arrs):
+                    t.data = a
+                if opt is not None and opt_arrs:
+                    opt.load_state_arrays(opt_arrs)
+                call_args = []
+                j = 0
+                for i in range(len(example_args)):
+                    if i in static_args:
+                        call_args.append(static_args[i])
+                    else:
+                        call_args.append(Tensor(data=input_arrs[j],
+                                                device=dev,
+                                                requires_grad=False))
+                        j += 1
+                autograd.training = True
+                out = func(self, *call_args, **kwargs)
+                out_leaves, template = _flatten_out(out)
+                out_template_box["t"] = template
+                outs = [o.data for o in out_leaves]
+                if dist:
+                    # scalars (loss): average across shards; batched
+                    # outputs: gather to global batch so callers see one
+                    # coherent result
+                    outs = [lax.pmean(o, opt.axis) if o.ndim == 0
+                            else lax.all_gather(o, opt.axis, axis=0,
+                                                tiled=True)
+                            for o in outs]
+                new_states = [t.data for t in state_tensors]
+                if dist:
+                    # non-param states (BN running stats) differ per shard:
+                    # average them (syncBN-style) so the replicated
+                    # out-spec holds
+                    for i in aux_idx:
+                        new_states[i] = lax.pmean(new_states[i], opt.axis)
+                new_opt = opt.state_arrays() if opt is not None else []
+                new_rng = jax.random.split(rng, 1)[0] if dist \
+                    else dev.rng_state
+                return new_states, new_opt, new_rng, outs
+
             if dist:
-                # scalars (loss): average across shards; batched outputs:
-                # gather to global batch so callers see one coherent result
-                outs = [lax.pmean(o, opt.axis) if o.ndim == 0
-                        else lax.all_gather(o, opt.axis, axis=0, tiled=True)
-                        for o in outs]
-            new_states = [t.data for t in state_tensors]
-            if dist:
-                # non-param states (BN running stats) differ per shard:
-                # average them (syncBN-style) so the replicated out-spec holds
-                for i in aux_idx:
-                    new_states[i] = lax.pmean(new_states[i], opt.axis)
-            new_opt = opt.state_arrays() if opt is not None else []
-            new_rng = jax.random.split(rng, 1)[0] if dist else dev.rng_state
-            return new_states, new_opt, new_rng, outs
+                from jax.sharding import PartitionSpec as P
+                mesh = opt.communicator.mesh
+                wrapped = jax.shard_map(
+                    step, mesh=mesh,
+                    in_specs=(state_in, opt_in, P(), P(opt.axis)),
+                    out_specs=(state_in, opt_in, P(), P()),
+                    check_vma=False)
+            else:
+                wrapped = step
+            return jax.jit(wrapped, donate_argnums=(0, 1))
 
         self._dist_shardings = None
+        state_in = opt_in = None
         if dist:
             from jax.sharding import PartitionSpec as P, NamedSharding
             mesh = opt.communicator.mesh
             assert mesh is not None, \
                 "DistOpt needs a mesh for multi-device training"
-            step = jax.shard_map(
-                step, mesh=mesh,
-                in_specs=(P(), P(), P(), P(opt.axis)),
-                out_specs=(P(), P(), P(), P()),
-                check_vma=False)
-            self._dist_shardings = (NamedSharding(mesh, P()),
-                                    NamedSharding(mesh, P(opt.axis)))
+            # TP-sharded params (Tensor.spec set by tp_axis layers) enter
+            # the shard_map partitioned; everything else is replicated. A
+            # plain P() prefix is kept in the no-TP case so strategies with
+            # dynamically growing optimizer state (sparse residuals) still
+            # pytree-match.
+            state_specs = [getattr(t, "spec", None) or P()
+                           for t in state_tensors]
+            has_tp = any(getattr(t, "spec", None) is not None
+                         for t in state_tensors)
+            if has_tp:
+                state_in = state_specs
+                opt_in = opt.state_specs()
+                self._dist_shardings = (
+                    NamedSharding(mesh, P()),
+                    NamedSharding(mesh, P(opt.axis)),
+                    [NamedSharding(mesh, s) for s in state_specs],
+                    [NamedSharding(mesh, s) for s in opt_in],
+                )
+            else:
+                state_in = opt_in = P()
+                self._dist_shardings = (NamedSharding(mesh, P()),
+                                        NamedSharding(mesh, P(opt.axis)),
+                                        None, None)
         self._state_tensors = state_tensors
         self._out_template_box = out_template_box
-        self._compiled_step = jax.jit(step, donate_argnums=(0, 1))
+        self._step_builder = make_step
+        self._compiled_step = {}   # step-tag -> jitted executable
         self._step_stats["compile_s"] = time.perf_counter() - t0
 
     def _invoke_step(self, args):
@@ -252,29 +306,35 @@ class Model(Layer, metaclass=ModelMeta):
         state_arrs = [t.data for t in self._state_tensors]
         opt_arrs = opt.state_arrays() if opt is not None else []
         input_arrs = [args[i].data for i in self._tensor_pos]
+        self._last_input_arrs = input_arrs
         rng = dev.rng_state
         if self._dist_shardings is not None:
-            # replicate states over the mesh, shard the batch on the data
-            # axis (a no-op after step 1: outputs already carry these
-            # shardings, so only fresh host batches actually move)
-            rep, shard = self._dist_shardings
-            state_arrs = [jax.device_put(a, rep) for a in state_arrs]
-            opt_arrs = [jax.device_put(a, rep) for a in opt_arrs]
+            # replicate (or TP-shard) states over the mesh, shard the batch
+            # on the data axis (a no-op after step 1: outputs already carry
+            # these shardings, so only fresh host batches actually move)
+            rep, shard, state_sh, opt_sh = self._dist_shardings
+            if state_sh is None:
+                state_arrs = [jax.device_put(a, rep) for a in state_arrs]
+                opt_arrs = [jax.device_put(a, rep) for a in opt_arrs]
+            else:
+                state_arrs = [jax.device_put(a, s)
+                              for a, s in zip(state_arrs, state_sh)]
+                opt_arrs = [jax.device_put(a, s)
+                            for a, s in zip(opt_arrs, opt_sh)]
             rng = jax.device_put(rng, rep)
             input_arrs = [jax.device_put(a, shard) for a in input_arrs]
+        tag = opt.step_tag() if opt is not None else 0
+        fn = self._compiled_step.get(tag)
+        if fn is None:
+            fn = self._compiled_step[tag] = self._step_builder(tag)
         profiling = (dev.verbosity > 0 and
                      self._step_stats["steps"] >= dev.skip_iteration)
         if profiling:
             if dev.cost_analysis is None and dev.verbosity >= 2:
-                try:
-                    ca = self._compiled_step.lower(
-                        state_arrs, opt_arrs, rng,
-                        input_arrs).compile().cost_analysis()
-                    dev.cost_analysis = ca[0] if isinstance(ca, list) else ca
-                except Exception:
-                    dev.cost_analysis = {}
+                dev.cost_analysis = self.step_cost_analysis() \
+                    if self._step_stats["steps"] > 0 else {}
             t0 = time.perf_counter()
-        new_states, new_opt, new_rng, outs = self._compiled_step(
+        new_states, new_opt, new_rng, outs = fn(
             state_arrs, opt_arrs, rng, input_arrs)
         if profiling:
             jax.block_until_ready(new_states)
@@ -292,6 +352,61 @@ class Model(Layer, metaclass=ModelMeta):
         tensors = [Tensor(data=a, device=dev, requires_grad=False)
                    for a in outs]
         return _rebuild_out(self._out_template_box["t"], tensors)
+
+    def lower_step(self, tag=0):
+        """Re-lower a compiled step variant for inspection (HLO text, cost
+        analysis). Lowering re-traces the step, which assigns tracers into
+        dev.rng_state and the state Tensors as a side effect — snapshot and
+        restore them so no tracer escapes into later eager work."""
+        if not self._compiled_step or \
+                getattr(self, "_last_input_arrs", None) is None:
+            return None
+        fn = self._compiled_step.get(tag)
+        if fn is None:
+            return None
+        opt = self._optimizer
+        dev = self._device
+        snap_state = [t.data for t in self._state_tensors]
+        snap_opt = list(opt.state_arrays()) if opt is not None else []
+        snap_rng = dev.rng_state
+        state_arrs, opt_arrs, rng = snap_state, snap_opt, snap_rng
+        if self._dist_shardings is not None:
+            rep, _, state_sh, opt_sh = self._dist_shardings
+            state_arrs = [jax.device_put(a, s) for a, s in
+                          zip(state_arrs, state_sh)] if state_sh else \
+                [jax.device_put(a, rep) for a in state_arrs]
+            opt_arrs = [jax.device_put(a, s) for a, s in
+                        zip(opt_arrs, opt_sh)] if opt_sh else \
+                [jax.device_put(a, rep) for a in opt_arrs]
+            rng = jax.device_put(rng, rep)
+        snap_training = autograd.training
+        try:
+            return fn.lower(state_arrs, opt_arrs, rng,
+                            self._last_input_arrs)
+        finally:
+            # restore the PRE-replication snapshots: leaving mesh-committed
+            # arrays in globally shared state would poison later
+            # single-device work
+            autograd.training = snap_training
+            dev.rng_state = snap_rng
+            for t, a in zip(self._state_tensors, snap_state):
+                t.data = a
+            if opt is not None and snap_opt:
+                opt.load_state_arrays(snap_opt)
+
+    def step_cost_analysis(self):
+        """XLA cost analysis of the compiled training step (flops, bytes
+        accessed, ...) — the TPU analog of the reference's per-node
+        profiling tables (scheduler.cc:240-295). Requires at least one
+        graph-mode train call. Returns {} if unavailable."""
+        try:
+            lowered = self.lower_step()
+            if lowered is None:
+                return {}
+            ca = lowered.compile().cost_analysis()
+            return ca[0] if isinstance(ca, list) else (ca or {})
+        except Exception:
+            return {}
 
     # ---- jitted inference (graph mode for eval; the reference replays its
     # buffered graph for eval too, model.py:94-100) ------------------------

@@ -22,6 +22,7 @@ _REGISTRY = {
     "resnet152": resnet.resnet152,
     "xceptionnet": xceptionnet.create_model,
     "gpt": transformer.create_model,
+    "gpt_pipe": transformer.create_pipelined,
 }
 
 

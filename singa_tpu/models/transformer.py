@@ -36,14 +36,15 @@ class _PosSlice(autograd.Operator):
 class GPT(model.Model):
 
     def __init__(self, vocab_size, max_seq=1024, dim=256, num_heads=8,
-                 num_layers=4, mlp_ratio=4, seq_axis=None, name=None):
+                 num_layers=4, mlp_ratio=4, seq_axis=None, tp_axis=None,
+                 name=None):
         super().__init__(name)
         self.vocab_size = vocab_size
         self.max_seq = max_seq
         self.dim = dim
         self.tok_embed = layer.Embedding(vocab_size, dim)
         blocks = [layer.TransformerBlock(num_heads, mlp_ratio, causal=True,
-                                         seq_axis=seq_axis)
+                                         seq_axis=seq_axis, tp_axis=tp_axis)
                   for _ in range(num_layers)]
         self.blocks = blocks
         self.register_layers(*blocks)
@@ -82,8 +83,168 @@ class GPT(model.Model):
         return logits, loss
 
 
+# ---------------- pipeline-parallel GPT ----------------------------------
+# Block params are STACKED (num_layers, ...) tensors with spec P(pp_axis):
+# Model's spec-aware shard_map gives each device its contiguous slice of
+# layers, and the whole GPipe schedule runs as ONE tape op whose vjp is the
+# reverse pipeline (backward ppermutes transposed) with microbatch gradient
+# accumulation via the scan cotangent.
+
+def _fn_layernorm(x, g, b, eps=1e-5):
+    import jax.numpy as jnp
+    from jax import lax
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.var(x, axis=-1, keepdims=True)
+    return (x - m) * lax.rsqrt(v + eps) * g + b
+
+
+def _fn_block(params, h, num_heads):
+    """Functional pre-LN transformer block; h (B, S, E)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.attention import flash_attention
+    (g1, b1, Wq, Wk, Wv, Wo, g2, b2, W1, bb1, W2, bb2) = params
+    B, S, E = h.shape
+    x = _fn_layernorm(h, g1, b1)
+    q = (x @ Wq).reshape(B, S, num_heads, -1).transpose(0, 2, 1, 3)
+    k = (x @ Wk).reshape(B, S, num_heads, -1).transpose(0, 2, 1, 3)
+    v = (x @ Wv).reshape(B, S, num_heads, -1).transpose(0, 2, 1, 3)
+    o = flash_attention(q, k, v, True)
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
+    h = h + o @ Wo
+    x = _fn_layernorm(h, g2, b2)
+    return h + jax.nn.gelu(x @ W1 + bb1) @ W2 + bb2
+
+
+class _PipelineBlocks(autograd.Operator):
+    """All transformer blocks as one tape op: GPipe scan inside shard_map
+    (parallel/pipeline.py gpipe), serial layer loop outside a mesh."""
+
+    def __init__(self, num_heads, axis=None, n_micro=1):
+        super().__init__("PipelineBlocks")
+        self.num_heads = num_heads
+        self.axis = axis
+        self.n_micro = n_micro
+
+    def forward(self, h, *stacks):
+        import jax.numpy as jnp
+        from ..parallel.pipeline import gpipe, bcast_from_last
+        nh = self.num_heads
+        if self.axis is not None and autograd.axis_bound(self.axis):
+            B = h.shape[0]
+            nm = self.n_micro
+            assert B % nm == 0, f"batch {B} not divisible by n_micro {nm}"
+            x_micro = h.reshape(nm, B // nm, *h.shape[1:])
+
+            def stage_fn(local_stacks, x):
+                # local_stacks: each (layers_per_stage, ...) — this
+                # device's contiguous slice of layers
+                for li in range(local_stacks[0].shape[0]):
+                    x = _fn_block([s[li] for s in local_stacks], x, nh)
+                return x
+
+            outs = gpipe(stage_fn, list(stacks), x_micro, self.axis)
+            outs = bcast_from_last(self.axis, outs)
+            return outs.reshape(B, *h.shape[1:])
+        # serial fallback (eval / single device): loop the full stacks
+        for li in range(stacks[0].shape[0]):
+            h = _fn_block([s[li] for s in stacks], h, nh)
+        return h
+
+
+class PipelinedGPT(model.Model):
+    """GPT with GPipe pipeline parallelism through the Model API: compile
+    with `pipeline_axis="pp", n_micro=M` on a mesh carrying a 'pp' axis
+    (plus a 'data' axis, possibly size 1) and train normally. Embedding and
+    head run replicated on every stage (cheap); the block stack — where the
+    FLOPs are — is sharded layer-wise over the pipeline."""
+
+    _STACK_ATTRS = ("g1", "b1", "Wq", "Wk", "Wv", "Wo",
+                    "g2", "b2", "W1", "bb1", "W2", "bb2")
+
+    def __init__(self, vocab_size, max_seq=1024, dim=256, num_heads=8,
+                 num_layers=4, mlp_ratio=4, name=None):
+        super().__init__(name)
+        self.vocab_size = vocab_size
+        self.max_seq = max_seq
+        self.dim = dim
+        self.num_heads = num_heads
+        self.num_layers = num_layers
+        self.mlp_ratio = mlp_ratio
+        self.tok_embed = layer.Embedding(vocab_size, dim)
+        self.ln_f = layer.LayerNorm()
+        self.head = layer.Linear(vocab_size, bias=False)
+        self.sce = layer.SoftMaxCrossEntropy()
+        self._stacks_init = False
+
+    def _init_stacks(self, dev):
+        import numpy as np
+        L, E, H = self.num_layers, self.dim, self.dim * self.mlp_ratio
+        rng = np.random.RandomState(0)
+
+        def mk(attr, shape, scale=None):
+            t = Tensor((L,) + shape, device=dev, dtype=float32)
+            if scale is None:   # layernorm gain/bias
+                t.set_value(1.0 if attr.startswith("g") else 0.0)
+            else:
+                t.copy_from_numpy((rng.standard_normal((L,) + shape)
+                                   * scale).astype(np.float32))
+            if self.pipeline_axis is not None:
+                from jax.sharding import PartitionSpec as P
+                t.spec = P(self.pipeline_axis)
+            self._register_param(attr, t)
+
+        mk("g1", (E,)), mk("b1", (E,))
+        for a in ("Wq", "Wk", "Wv", "Wo"):
+            mk(a, (E, E), scale=E ** -0.5)
+        mk("g2", (E,)), mk("b2", (E,))
+        mk("W1", (E, H), scale=E ** -0.5)
+        mk("bb1", (H,), scale=0.0)
+        mk("W2", (H, E), scale=H ** -0.5)
+        mk("bb2", (E,), scale=0.0)
+        self._stacks_init = True
+
+    def forward(self, ids):
+        h = self.tok_embed(ids)
+        if not self._stacks_init:
+            if not hasattr(self, "pipeline_axis"):
+                self.pipeline_axis, self.n_micro = None, 1
+            self._init_stacks(h.device)
+            p = Tensor((self.max_seq, self.dim), device=h.device,
+                       dtype=float32)
+            p.gaussian(0.0, 0.02)
+            self._register_param("pos_embed", p)
+        S = ids.shape[1]
+        pos = _PosSlice(S)(self.pos_embed)
+        h = autograd.add(h, autograd.expand(pos, h.shape))
+        if self.pipeline_axis is not None and \
+                autograd.axis_bound(self.pipeline_axis):
+            # Megatron-f on the pipeline input: dL/dh is nonzero only on
+            # stage 0 (the only stage that consumes h); the psum backward
+            # gives every device the full embedding gradient so replicated
+            # embed/pos params stay in sync
+            h = autograd.tp_copy(h, self.pipeline_axis)
+        op = _PipelineBlocks(self.num_heads, self.pipeline_axis,
+                             self.n_micro)
+        h = op(h, *[getattr(self, a) for a in self._STACK_ATTRS])
+        h = self.ln_f(h)
+        return self.head(h)
+
+    def train_one_batch(self, ids, targets):
+        logits = self.forward(ids)
+        flat = autograd.reshape(logits, (-1, self.vocab_size))
+        tflat = autograd.reshape(targets, (-1,))
+        loss = self.sce(flat, tflat)
+        self.optimizer(loss)
+        return logits, loss
+
+
 def create_model(vocab_size=256, **kwargs):
     return GPT(vocab_size, **kwargs)
 
 
-__all__ = ["GPT", "create_model"]
+def create_pipelined(vocab_size=256, **kwargs):
+    return PipelinedGPT(vocab_size, **kwargs)
+
+
+__all__ = ["GPT", "PipelinedGPT", "create_model", "create_pipelined"]

@@ -78,15 +78,30 @@ struct Reader {
       cv_get.notify_all();
       return;
     }
+    // File size bounds every length field: a corrupt/truncated record with
+    // a garbage length must surface as corrupt=true (OSError in Python),
+    // not throw bad_alloc in this thread and std::terminate the process.
+    long pos = ftell(f);
+    fseek(f, 0, SEEK_END);
+    const uint64_t fsize = (uint64_t)ftell(f);
+    fseek(f, pos, SEEK_SET);
     while (true) {
       uint32_t klen;
       if (fread(&klen, 4, 1, f) != 1) break;  // clean EOF
+      uint64_t remaining = fsize - (uint64_t)ftell(f);
       Record r;
-      r.key.resize(klen);
-      uint64_t vlen;
+      uint64_t vlen = 0;
       uint32_t crc;
-      bool bad = (klen && fread(&r.key[0], 1, klen, f) != klen) ||
-                 fread(&vlen, 8, 1, f) != 1;
+      bool bad = (uint64_t)klen > remaining;
+      if (!bad) {
+        r.key.resize(klen);
+        bad = (klen && fread(&r.key[0], 1, klen, f) != klen) ||
+              fread(&vlen, 8, 1, f) != 1;
+      }
+      if (!bad) {
+        remaining = fsize - (uint64_t)ftell(f);
+        bad = vlen > remaining;
+      }
       if (!bad) {
         r.val.resize(vlen);
         bad = (vlen && fread(&r.val[0], 1, vlen, f) != vlen) ||

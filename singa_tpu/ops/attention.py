@@ -55,53 +55,75 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                      block_k, seq_k, causal, scale, block_q):
-    """Grid: (batch*heads, q_blocks). Refs are (1, block_q, D) for q/o and
-    (1, seq_k, D) for k/v (whole K/V row per head in VMEM)."""
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale           # (Bq, D)
-    bq, d = q.shape
-    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
-    acc = jnp.zeros((bq, d), jnp.float32)
-
-    num_kb = seq_k // block_k
-
-    def body(kb, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        if causal:
-            s = s + _causal_mask(bq, block_k, q_off=qi * block_q,
-                                 k_off=kb * block_k)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jnp.dot(p, v_blk,
-                                   preferred_element_type=jnp.float32)
-        return m_new, l, acc
-
-    if causal:
-        # skip K blocks strictly above the diagonal
-        last = (qi + 1) * block_q  # first k index NOT needed
-        num_needed = pl.cdiv(last, block_k)
-        m, l, acc = lax.fori_loop(0, num_needed, body, (m, l, acc))
-    else:
-        m, l, acc = lax.fori_loop(0, num_kb, body, (m, l, acc))
-
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
-
-
 try:  # import here so CPU-only environments still import the module
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     _HAS_PALLAS = True
 except ImportError:  # pragma: no cover
     _HAS_PALLAS = False
+
+
+# TPU Pallas needs the last two block dims (sublane, lane) aligned; scalar
+# per-row stats (lse, delta, running m/l) are carried as (rows, _STAT_LANES)
+# with the value replicated across lanes — rows on sublanes means reading
+# [:, :1] yields the column vector with no relayout.
+_STAT_LANES = 8
+
+
+def _maybe_when(cond, fn):
+    """pl.when for traced predicates; plain call for static True."""
+    if cond is True:
+        fn()
+    else:
+        pl.when(cond)(fn)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref, *,
+                      nk, block_q, block_k, causal, scale):
+    """Grid: (batch*heads, q_blocks, k_blocks) — K/V blocks STREAM through
+    VMEM one (block_k, D) tile at a time (no whole-row residency, so
+    sequence length is bounded by HBM, not VMEM). The online-softmax state
+    (acc, m, l) lives in VMEM scratch, which persists across the k grid
+    dimension (TPU grid iteration is sequential, minor dim innermost)."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # causal: skip K blocks strictly above the diagonal of this Q block
+    needed = (kb * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    def _update():
+        q = q_ref[0].astype(jnp.float32) * scale       # (Bq, D)
+        k_blk = k_ref[0].astype(jnp.float32)           # (Bk, D)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = s + _causal_mask(block_q, block_k, q_off=qi * block_q,
+                                 k_off=kb * block_k)
+        m_prev = m_ref[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[...][:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, (block_q, _STAT_LANES))
+        l_ref[...] = jnp.broadcast_to(l_new, (block_q, _STAT_LANES))
+
+    _maybe_when(needed, _update)
+
+    @pl.when(kb == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[...][:, :1], 1e-20)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(m_ref[...][:, :1] + jnp.log(l),
+                                      (block_q, _STAT_LANES))
 
 
 def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -111,29 +133,176 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
     qf = q.reshape(bh, sq, d)
     kf = k.reshape(bh, sk, d)
     vf = v.reshape(bh, sk, d)
-    grid = (bh, sq // block_q)
+    nk = sk // block_k
+    grid = (bh, sq // block_q, nk)
     kernel = functools.partial(
-        _flash_fwd_kernel, block_k=block_k, seq_k=sk, causal=causal,
-        scale=scale, block_q=block_q)
+        _flash_fwd_kernel, nk=nk, block_q=block_q, block_k=block_k,
+        causal=causal, scale=scale)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_q, _STAT_LANES),
+                         lambda i, j, kb: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, _STAT_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+    return out.reshape(b, h, sq, d), lse[:, :, 0].reshape(b, h, sq)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, nk, block_q, block_k, causal,
+                         scale):
+    """Grid (bh, q_blocks, k_blocks): accumulate dQ over streamed K/V."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    needed = (kb * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    def _update():
+        qs = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jnp.dot(qs, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = s + _causal_mask(block_q, block_k, q_off=qi * block_q,
+                                 k_off=kb * block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dq_acc[...] += jnp.dot(ds, k_blk,
+                               preferred_element_type=jnp.float32)
+
+    _maybe_when(needed, _update)
+
+    @pl.when(kb == nk - 1)
+    def _finish():
+        dq_ref[0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, nq, block_q,
+                          block_k, causal, scale):
+    """Grid (bh, k_blocks, q_blocks): accumulate dK/dV over streamed Q."""
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    needed = (qi * block_q + block_q - 1 >= kb * block_k) if causal else True
+
+    def _update():
+        qs = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jnp.dot(qs, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = s + _causal_mask(block_q, block_k, q_off=qi * block_q,
+                                 k_off=kb * block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])                 # (Bq, Bk)
+        dv_acc[...] += jnp.dot(p.T, do,
+                               preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dk_acc[...] += jnp.dot(ds.T, qs,
+                               preferred_element_type=jnp.float32)
+
+    _maybe_when(needed, _update)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                      interpret):
+    """Pallas flash backward: dQ and dK/dV kernels with streamed tiles."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    qf, kf, vf = (a.reshape(bh, -1, d) for a in (q, k, v))
+    dof = do.reshape(bh, sq, d)
+    stat = (bh, sq, _STAT_LANES)
+    lsef = jnp.broadcast_to(lse.reshape(bh, sq)[:, :, None], stat)
+    # delta = rowsum(do * o): cheap elementwise, leave to XLA fusion
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1).reshape(bh, sq)[:, :, None], stat)
+    nq, nk = sq // block_q, sk // block_k
+    stat_spec_q = pl.BlockSpec((1, block_q, _STAT_LANES),
+                               lambda i, j, kb: (i, j, 0))
+    stat_spec_kq = pl.BlockSpec((1, block_q, _STAT_LANES),
+                                lambda i, kb, j: (i, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, nk=nk, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            stat_spec_q,
+            stat_spec_q,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, nq=nq, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0)),
+            stat_spec_kq,
+            stat_spec_kq,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
 def _flash_bwd_blockwise(q, k, v, o, lse, do, causal, scale, block_k):
@@ -191,23 +360,28 @@ def _resolve(scale, d, interpret):
     return scale, interpret
 
 
+def _resolve_blocks(sq, sk, block_q, block_k):
+    """(bq, bk, ok): shrink requested blocks to the sequence, require even
+    tiling and 8-sublane alignment (TPU lowering constraint). Used by BOTH
+    forward and backward so the two always agree on the tiling."""
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    ok = (sq % bq == 0 and sk % bk == 0 and bq % 8 == 0 and bk % 8 == 0)
+    return bq, bk, ok
+
+
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     d = q.shape[-1]
     scale, interpret = _resolve(scale, d, interpret)
     sq, sk = q.shape[2], k.shape[2]
-    # shrink blocks only to hardware-aligned sizes; anything that still
-    # doesn't tile falls back to the reference path
-    block_q = min(block_q, sq) if sq % min(block_q, sq) == 0 \
-        and min(block_q, sq) % 8 == 0 else block_q
-    block_k = min(block_k, sk) if sk % min(block_k, sk) == 0 \
-        and min(block_k, sk) % 8 == 0 else block_k
-    if (not _HAS_PALLAS or sq % block_q or sk % block_k):
+    bq, bk, ok = _resolve_blocks(sq, sk, block_q, block_k)
+    if not _HAS_PALLAS or not ok:
         out = attention_reference(q, k, v, causal, scale)
         lse = None
+        bq = bk = None
     else:
-        out, lse = _flash_fwd_pallas(q, k, v, causal, scale, block_q,
-                                     block_k, interpret)
-    return out, lse
+        out, lse = _flash_fwd_pallas(q, k, v, causal, scale, bq, bk,
+                                     interpret)
+    return out, lse, bq, bk
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -228,8 +402,13 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
         return ref_vjp(g)
     q, k, v, out, lse = saved
     d = q.shape[-1]
-    s, _ = _resolve(scale, d, interpret)
-    bk = min(block_k, k.shape[2])
+    s, interp = _resolve(scale, d, interpret)
+    sq, sk = q.shape[2], k.shape[2]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    if _HAS_PALLAS and sq % bq == 0 and sk % bk == 0 and bq % 8 == 0 \
+            and bk % 8 == 0:
+        return _flash_bwd_pallas(q, k, v, out, lse, g, causal, s, bq, bk,
+                                 interp)
     return _flash_bwd_blockwise(q, k, v, out, lse, g, causal, s, bk)
 
 

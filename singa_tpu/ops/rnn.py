@@ -64,6 +64,58 @@ def lstm_scan(x: Tensor, hx: Tensor, cx: Tensor, Wx: Tensor, Wh: Tensor,
     return _LSTMScan(Wh.shape[0])(x, hx, cx, Wx, Wh, b)
 
 
+class _LSTMScanEx(Operator):
+    """Variable-length batch LSTM — parity with the reference's
+    `GpuRNNForwardTrainingEx` packed-sequence API (rnn.h:117-131): padded
+    (seq, batch, feat) input + per-sample lengths. Steps beyond a sample's
+    length freeze its (h, c) carry and zero its output, so hy/cy are the
+    states at each sample's true last step, exactly like cuDNN's Ex
+    variants. Lengths ride the tape as a non-differentiable int input."""
+
+    def __init__(self, hidden: int):
+        super().__init__("LSTMScanEx")
+        self.hidden = hidden
+
+    def forward(self, x, lengths, hx, cx, Wx, Wh, b):
+        T = x.shape[0]
+
+        def body(carry, inp):
+            h, c = carry
+            xt, t = inp
+            (h2, c2), _ = _lstm_cell((h, c), xt, Wx, Wh, b, self.hidden)
+            mask = (t < lengths)[:, None]
+            h_new = jnp.where(mask, h2, h)
+            c_new = jnp.where(mask, c2, c)
+            y = jnp.where(mask, h2, jnp.zeros_like(h2))
+            return (h_new, c_new), y
+
+        (hy, cy), ys = lax.scan(
+            body, (hx, cx), (x, jnp.arange(T, dtype=jnp.int32)))
+        return ys, hy, cy
+
+
+def lstm_scan_ex(x: Tensor, lengths: Tensor, hx: Tensor, cx: Tensor,
+                 Wx: Tensor, Wh: Tensor, b: Tensor):
+    """Variable-length lstm_scan; lengths (batch,) int32."""
+    return _LSTMScanEx(Wh.shape[0])(x, lengths, hx, cx, Wx, Wh, b)
+
+
+class _ReversePadded(Operator):
+    """Reverse each sample's valid prefix along time (padding stays put) —
+    the input transform for the backward direction of a bidirectional RNN
+    over variable-length batches."""
+
+    def forward(self, x, lengths):
+        T = x.shape[0]
+        t = jnp.arange(T, dtype=jnp.int32)[:, None]          # (T, 1)
+        idx = jnp.where(t < lengths[None, :], lengths[None, :] - 1 - t, t)
+        return jnp.take_along_axis(x, idx[:, :, None], axis=0)
+
+
+def reverse_padded(x: Tensor, lengths: Tensor):
+    return _ReversePadded()(x, lengths)
+
+
 class _GRUScan(Operator):
     def __init__(self, hidden: int):
         super().__init__("GRUScan")

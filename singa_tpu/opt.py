@@ -70,6 +70,11 @@ class Optimizer:
         self._states = {}       # id(param) -> {name: array}
         self._state_order = []  # pids in creation order (checkpoint order)
 
+    def step_tag(self) -> int:
+        """Static step variant selector consumed by Model's per-tag
+        executable cache; plain optimizers have a single variant."""
+        return 0
+
     # -- state plumbing for Model's jitted step ---------------------------
     def state_arrays(self):
         """Flat list of state arrays (stable order) + the step counter."""
@@ -116,8 +121,24 @@ class Optimizer:
     def setup(self, params):
         """Pre-create all per-param state so the jitted step threads concrete
         buffers (the reference creates them lazily on first apply)."""
+        params = list(params)
+        self._params_by_id = {id(p): p for p in params}
         for p in params:
             self._state(p)
+
+    def state_specs(self):
+        """PartitionSpec per state_arrays() entry: optimizer state for a
+        TP-sharded param is sharded like the param (momentum of a column
+        shard is a column shard)."""
+        from jax.sharding import PartitionSpec as P
+        specs = [P()]  # step counter
+        by_id = getattr(self, "_params_by_id", {})
+        for pid in self._state_order:
+            p = by_id.get(pid)
+            spec = getattr(p, "spec", None) if p is not None else None
+            for _k in sorted(self._states[pid]):
+                specs.append(spec if spec is not None else P())
+        return specs
 
     # -- API ---------------------------------------------------------------
     def __call__(self, loss: Tensor):
@@ -273,6 +294,9 @@ class DistOpt(Optimizer):
         self._spars_residual = {}   # id(param) -> error-feedback residual
         self._spars_order = []
         self._partial_counter = 0
+        self._partial_mode = False  # set while tracing partial-update
+        self.partial_k = 1
+        self._partial_static_idx = None  # set by Model per compiled tag
 
     # delegate scheduler/step state to the inner optimizer
     @property
@@ -291,6 +315,16 @@ class DistOpt(Optimizer):
         for pid in self._spars_order:
             arrs.append(self._spars_residual[pid])
         return arrs
+
+    def state_specs(self):
+        from jax.sharding import PartitionSpec as P
+        specs = list(self.opt.state_specs())
+        by_id = getattr(self.opt, "_params_by_id", {})
+        for pid in self._spars_order:
+            p = by_id.get(pid)
+            spec = getattr(p, "spec", None) if p is not None else None
+            specs.append(spec if spec is not None else P())
+        return specs
 
     def load_state_arrays(self, arrs):
         n = len(arrs) - len(self._spars_order)
@@ -342,25 +376,52 @@ class DistOpt(Optimizer):
         self.opt.step()
 
     # -- strategy 3: async partial-parameter update (ref opt.py:922) -------
-    def backward_and_partial_update(self, loss: Tensor, num_partitions=4):
-        """Rotates which 1/k slice of params is synchronized each step.
+    def step_tag(self) -> int:
+        """Rotating static partition index. Model compiles ONE executable
+        per tag, each containing only that partition's collectives — the
+        compiled-schedule analog of the reference's bandwidth rotation
+        (XLA comm schedules are static, so a runtime mask could not skip
+        the wire traffic)."""
+        if not self._partial_mode:
+            return 0
+        tag = self._partial_counter % self.partial_k
+        self._partial_counter += 1
+        return tag
 
-        NOTE on TPU semantics: the collective is still compiled into the
-        step for every param (XLA needs static comm schedules); the rotating
-        mask reproduces the reference's *numerics*. True bandwidth saving
-        needs per-partition compiled steps — see parallel/README.
-        """
-        k = num_partitions
-        sel = jnp.mod(self.opt.step_counter, k)
+    def backward_and_partial_update(self, loss: Tensor, num_partitions=4):
+        """Each step synchronizes only the params with index % k == sel;
+        the rest update from local gradients (ref opt.py:922-992). In
+        graph mode `sel` is the STATIC tag Model passed, so untouched
+        partitions have no collective in the executable at all."""
+        k = int(num_partitions)
+        self.partial_k = k
+        if not self._partial_mode:
+            self._partial_mode = True
+            # the in-flight trace is tag 0; the next invoke picks tag 1
+            self._partial_counter = max(self._partial_counter, 1)
+        sel = self._partial_static_idx
+        if sel is None:  # eager path: rotate on the host counter
+            sel = self._partial_counter % k
+            self._partial_counter += 1
         for i, (p, g) in enumerate(autograd.backward(loss)):
-            synced = self.communicator.all_reduce(g.data) / self.world_size
-            g.data = jnp.where(jnp.equal(sel, i % k), synced, g.data)
+            if i % k == sel:
+                g.data = self.communicator.all_reduce(g.data) \
+                    / self.world_size
             self.opt.apply(p, g)
         self.opt.step()
 
     # -- strategy 4: sparsified allreduce w/ error feedback (ref :994) -----
     def backward_and_sparse_update(self, loss: Tensor, spars: float = 0.05,
                                    topK: bool = True, corr: bool = True):
+        by_id = getattr(self.opt, "_params_by_id", {})
+        if any(getattr(p, "spec", None) is not None for p in by_id.values()):
+            # residuals grow state_arrays() lazily inside the trace, which
+            # cannot pytree-match the per-leaf in/out specs a TP/PP mesh
+            # needs — fail loud instead of a cryptic shard_map error
+            raise NotImplementedError(
+                "sparse gradient strategies are not supported together "
+                "with TP/PP-sharded parameters yet; use plain/half/"
+                "partial strategies on tensor/pipeline-parallel models")
         for p, g in autograd.backward(loss):
             pid = id(p)
             if pid not in self._spars_residual:

@@ -63,3 +63,29 @@ def last_stage_value(x, axis_name):
     stage = lax.axis_index(axis_name)
     mask = (stage == n - 1).astype(x.dtype)
     return lax.psum(x * mask, axis_name)
+
+
+def bcast_from_last(axis_name, x):
+    """last_stage_value with a per-device-correct vjp for use by tape ops
+    differentiated INSIDE the shard_map body: psum's transpose under an
+    in-body jax.vjp is another psum, which would scale the cotangent by
+    the axis size; the true per-device rule is dy * mask (only the last
+    stage's input influenced the broadcast value)."""
+    import functools
+    import jax
+
+    @functools.partial(jax.custom_vjp)
+    def _bcast(x):
+        return last_stage_value(x, axis_name)
+
+    def _fwd(x):
+        return _bcast(x), None
+
+    def _bwd(_, dy):
+        n = lax.axis_size(axis_name)
+        stage = lax.axis_index(axis_name)
+        mask = (stage == n - 1).astype(dy.dtype)
+        return (dy * mask,)
+
+    _bcast.defvjp(_fwd, _bwd)
+    return _bcast(x)

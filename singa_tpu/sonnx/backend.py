@@ -409,10 +409,13 @@ class SingaBackend:
         auto_pad = _attr(node.proto, "auto_pad", "NOTSET")
         if isinstance(auto_pad, bytes):
             auto_pad = auto_pad.decode()
-        assert list(dil) == [1] * len(dil), "dilation != 1 unsupported"
+        dil = [int(d) for d in dil]
         if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
             from ..utils import get_padding_shape
-            pp = get_padding_shape(auto_pad, x.shape[2:], W.shape[2:], strides)
+            # SAME pads follow the effective (dilated) kernel extent
+            k_eff = [(int(k) - 1) * d + 1
+                     for k, d in zip(W.shape[2:], dil)]
+            pp = get_padding_shape(auto_pad, x.shape[2:], k_eff, strides)
             pad, odd = (pp[0][0], pp[1][0]), None
             if pp[0][0] != pp[0][1] or pp[1][0] != pp[1][1]:
                 pad = (0, 0)
@@ -429,6 +432,7 @@ class SingaBackend:
         h.padding = pad
         h.group = group
         h.odd_padding = odd
+        h.dilation = tuple(dil)
         return autograd.conv2d(h, x, W, b)
 
     def op_BatchNormalization(self, node, env):

@@ -62,8 +62,10 @@ def _input_name(ctx: _Ctx, op, idx, input_ids):
         if key not in ctx.names:
             if x_id in input_ids:
                 name = f"input_{input_ids[x_id]}"
+                dt = pb._NP2ONNX.get(np.dtype(x_tensor.dtype),
+                                     pb.TensorProto.FLOAT)
                 ctx.graph_inputs.append(pb.make_value_info(
-                    name, pb.TensorProto.FLOAT, x_tensor.shape))
+                    name, dt, x_tensor.shape))
             else:
                 name = ctx.init_name_for(x_tensor)
             ctx.names[key] = name
@@ -156,8 +158,10 @@ def _emit(ctx, op, ins, outs):
                               np.asarray(op.indices, np.int64))
         return [mk("Gather", ins + [idx_in], outs, axis=op.axis)]
     if t == "Embedding":
-        idx_in = _const_input(ctx, "ids", np.asarray(op.indices, np.int64))
-        return [mk("Gather", [ins[0], idx_in], outs, axis=0)]
+        # tape edges are (ids, table); ONNX Gather wants (data, indices) —
+        # the ids stay a real graph edge (graph input for model inputs),
+        # NOT a baked constant, so the exported model consumes its ids
+        return [mk("Gather", [ins[1], ins[0]], outs, axis=0)]
     if t == "Tile":
         return [mk("Tile", ins + [
             _const_input(ctx, "repeats",
@@ -183,7 +187,8 @@ def _emit(ctx, op, ins, outs):
             l, r, tt, b = op.odd_padding
             pads = [ph + tt, pw + l, ph + b, pw + r]
         return [mk("Conv", ins, outs, strides=list(op.stride), pads=pads,
-                   group=op.group)]
+                   group=op.group,
+                   dilations=list(getattr(op, "dilation", (1, 1))))]
     if t == "_Pooling2d":
         ph, pw = op.padding
         pads = [ph, pw, ph, pw]
@@ -208,8 +213,9 @@ def _emit(ctx, op, ins, outs):
         # opset-12 SoftmaxCrossEntropyLoss; targets exported as int64 input
         return [mk("SoftmaxCrossEntropyLoss", ins, outs, reduction="mean")]
     if t == "Dropout":
-        return [mk("Dropout", ins[:1], outs,
-                   ratio=np.float32(op.ratio))]
+        # opset >= 12: ratio is an input, not an attribute
+        ratio_in = _const_input(ctx, "ratio", np.float32(op.ratio))
+        return [mk("Dropout", ins[:1] + [ratio_in], outs)]
     if t == "Cast":
         to = pb._NP2ONNX[np.dtype(op.to)]
         return [mk("Cast", ins, outs, to=to)]

@@ -65,7 +65,7 @@ class Tensor:
     """
 
     __slots__ = ("data", "device", "creator", "requires_grad", "stores_grad",
-                 "name")
+                 "name", "spec")
 
     def __init__(self, shape=None, device: Device | None = None, dtype=None,
                  data=None, requires_grad: bool = True, stores_grad: bool = False,
@@ -92,6 +92,10 @@ class Tensor:
         self.requires_grad = requires_grad
         self.stores_grad = stores_grad
         self.name = name
+        # Optional jax.sharding.PartitionSpec: how this tensor (typically a
+        # TP-sharded param) is partitioned over the mesh inside Model's
+        # shard_mapped step. None = replicated.
+        self.spec = None
 
     # ---- metadata -------------------------------------------------------
     @property

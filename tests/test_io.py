@@ -72,3 +72,18 @@ def test_large_tensor_payload(tmp_path):
     (k, v), = list(rio.RecordReader(p))
     got = np.frombuffer(v, np.float32).reshape(256, 256)
     np.testing.assert_array_equal(got, arr)
+
+
+def test_corrupt_length_field(tmp_path):
+    """A garbage value-length must surface as OSError('corrupt record'),
+    not bad_alloc/std::terminate in the prefetch thread (ADVICE r1)."""
+    import struct
+    p = str(tmp_path / "len.rec")
+    with rio.RecordWriter(p) as w:
+        w.write("k1", b"hello world")
+    data = bytearray(open(p, "rb").read())
+    # layout: 8 magic + 4 klen + 2 key + 8 vlen
+    struct.pack_into("<Q", data, 8 + 4 + 2, 1 << 60)
+    open(p, "wb").write(bytes(data))
+    with pytest.raises(OSError):
+        list(rio.RecordReader(p))

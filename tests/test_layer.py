@@ -183,3 +183,61 @@ def test_set_params_roundtrip(dev, rng):
     assert np.allclose(lin.W.numpy(), w)
     with pytest.raises(AssertionError):
         lin.set_params({"bogus": w})
+
+
+def test_conv_dilation_matches_scipy(dev, rng):
+    """Dilated (atrous) conv vs explicit scipy correlation with a dilated
+    kernel (parity with ConvHandle dilation, convolution.h:43)."""
+    from scipy import signal
+    from singa_tpu import layer, tensor, autograd
+
+    x = rng.randn(1, 1, 12, 12).astype(np.float32)
+    conv = layer.Conv2d(1, 3, stride=1, padding=2, dilation=2, bias=False)
+    tx = tensor.from_numpy(x, dev)
+    y = conv(tx).numpy()
+
+    W = conv.W.numpy()[0, 0]               # (3, 3)
+    Wd = np.zeros((5, 5), np.float32)      # dilate kernel by 2
+    Wd[::2, ::2] = W
+    ref = signal.correlate2d(x[0, 0], Wd, mode="same")
+    np.testing.assert_allclose(y[0, 0], ref, atol=1e-4, rtol=1e-4)
+
+
+def test_lstm_variable_length(dev, rng, train_mode):
+    """CudnnRNN(seq_lengths=...) == running each sample's prefix alone
+    (GpuRNNForwardTrainingEx parity, rnn.h:117-131)."""
+    from singa_tpu import layer, tensor
+
+    T, B, F, H = 6, 3, 4, 5
+    x = rng.randn(T, B, F).astype(np.float32)
+    lengths = np.array([6, 3, 1], np.int32)
+    rnn = layer.CudnnRNN(H)
+    tx = tensor.from_numpy(x, dev)
+    ys, hy, cy = rnn(tx, seq_lengths=lengths)
+    ys_n, hy_n = ys.numpy(), hy.numpy()
+
+    for bi, L in enumerate(lengths):
+        # prefix-only run of this sample
+        xb = x[:L, bi:bi + 1]
+        ys_b, hy_b, _ = rnn(tensor.from_numpy(xb, dev))
+        np.testing.assert_allclose(hy_n[bi], hy_b.numpy()[0], atol=1e-5,
+                                   err_msg=f"hy sample {bi}")
+        np.testing.assert_allclose(ys_n[:L, bi], ys_b.numpy()[:, 0],
+                                   atol=1e-5)
+        # padded region is zero
+        assert np.all(ys_n[L:, bi] == 0.0)
+
+
+def test_lstm_variable_length_grads_flow(dev, rng, train_mode):
+    """Grads only flow from valid steps; padded steps contribute zero."""
+    from singa_tpu import layer, tensor, autograd
+
+    T, B, F, H = 5, 2, 3, 4
+    x = rng.randn(T, B, F).astype(np.float32)
+    lengths = np.array([5, 2], np.int32)
+    rnn = layer.CudnnRNN(H)
+    tx = tensor.from_numpy(x, dev)
+    ys, hy, cy = rnn(tx, seq_lengths=lengths)
+    loss = autograd.mean(autograd.mul(hy, hy))
+    grads = autograd.gradients(loss)
+    assert rnn.Wx in grads and np.isfinite(grads[rnn.Wx].numpy()).all()

@@ -36,6 +36,142 @@ def test_tp_mlp_matches_dense():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_tp_through_model_api_matches_serial():
+    """Linear(tp_axis=...) + DistOpt on a {data:2, tp:4} mesh must train to
+    the same losses/params as a serial single-device model (VERDICT r1 #7:
+    TP as a framework feature, not a library function)."""
+    from singa_tpu import layer, model, opt, tensor
+    from singa_tpu.device import get_default_device
+
+    class TPMLP(model.Model):
+        def __init__(self, tp_axis=None):
+            super().__init__()
+            self.fc1 = layer.Linear(32, tp_axis=tp_axis, tp_mode="column")
+            self.relu = layer.ReLU()
+            self.fc2 = layer.Linear(4, tp_axis=tp_axis, tp_mode="row")
+            self.loss_fn = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            return self.fc2(self.relu(self.fc1(x)))
+
+        def train_one_batch(self, x, y):
+            out = self.forward(x)
+            loss = self.loss_fn(out, y)
+            self._optimizer(loss)
+            return out, loss
+
+    dev = get_default_device()
+    rng = np.random.RandomState(3)
+    X = rng.randn(16, 10).astype(np.float32)
+    Y = rng.randint(0, 4, 16).astype(np.int32)
+    tx, ty = tensor.from_numpy(X, dev), tensor.from_numpy(Y, dev)
+
+    m_ser = TPMLP()
+    m_ser.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    m_ser.compile([tx], is_train=True, use_graph=True)
+    w0 = {k: v.numpy().copy() for k, v in m_ser.get_params().items()}
+
+    mesh = make_mesh({"data": 2, "tp": 4})
+    m_tp = TPMLP(tp_axis="tp")
+    m_tp.set_optimizer(opt.DistOpt(opt.SGD(lr=0.1, momentum=0.9),
+                                   axis="data", mesh=mesh))
+    m_tp.compile([tx], is_train=True, use_graph=True)
+    m_tp.set_params(w0)
+
+    for _ in range(5):
+        _, l_ser = m_ser(tx, ty)
+        _, l_tp = m_tp(tx, ty)
+    assert abs(float(l_ser.numpy()) - float(l_tp.numpy())) < 1e-4, \
+        (float(l_ser.numpy()), float(l_tp.numpy()))
+    for k in m_ser.get_params():
+        np.testing.assert_allclose(m_ser.get_params()[k].numpy(),
+                                   m_tp.get_params()[k].numpy(),
+                                   atol=1e-4, err_msg=k)
+
+
+def test_tp_gpt_through_model_api():
+    """GPT(tp_axis=...) trains through Model on a {data,tp} mesh; loss
+    matches the serial model (head-parallel MHA + column/row MLP)."""
+    from singa_tpu import models, opt, tensor
+    from singa_tpu.device import get_default_device
+
+    dev = get_default_device()
+    rng = np.random.RandomState(4)
+    V, B, S = 50, 4, 16
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    tx = tensor.from_numpy(ids, dev)
+    ty = tensor.from_numpy(tgt, dev)
+
+    def build(tp_axis=None, dist=False):
+        m = models.create_model("gpt", vocab_size=V, max_seq=S, dim=32,
+                                num_heads=4, num_layers=2, tp_axis=tp_axis)
+        if dist:
+            mesh = make_mesh({"data": 2, "tp": 4})
+            m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.05), axis="data",
+                                        mesh=mesh))
+        else:
+            m.set_optimizer(opt.SGD(lr=0.05))
+        m.compile([tx], is_train=True, use_graph=True)
+        return m
+
+    m_ser = build()
+    w0 = {k: v.numpy().copy() for k, v in m_ser.get_params().items()}
+    m_tp = build(tp_axis="tp", dist=True)
+    m_tp.set_params(w0)
+
+    for _ in range(3):
+        _, l_ser = m_ser(tx, ty)
+        _, l_tp = m_tp(tx, ty)
+    assert abs(float(l_ser.numpy()) - float(l_tp.numpy())) < 2e-3, \
+        (float(l_ser.numpy()), float(l_tp.numpy()))
+
+
+def test_pp_gpt_through_model_api():
+    """PipelinedGPT on a {data:1, pp:4} mesh via Model.compile(
+    pipeline_axis=, n_micro=) matches the same model run serially."""
+    from singa_tpu import models, opt, tensor
+    from singa_tpu.device import get_default_device
+
+    dev = get_default_device()
+    rng = np.random.RandomState(5)
+    V, B, S = 40, 8, 8
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    tx = tensor.from_numpy(ids, dev)
+    ty = tensor.from_numpy(tgt, dev)
+
+    def build(pp=False):
+        m = models.create_model("gpt_pipe", vocab_size=V, max_seq=S,
+                                dim=16, num_heads=2, num_layers=4)
+        if pp:
+            mesh = make_mesh({"data": 1, "pp": 4})
+            m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.05), axis="data",
+                                        mesh=mesh))
+            m.compile([tx], is_train=True, use_graph=True,
+                      pipeline_axis="pp", n_micro=4)
+        else:
+            m.set_optimizer(opt.SGD(lr=0.05))
+            m.compile([tx], is_train=True, use_graph=True)
+        return m
+
+    m_ser = build()
+    w0 = {k: v.numpy().copy() for k, v in m_ser.get_params().items()}
+    m_pp = build(pp=True)
+    m_pp.set_params(w0)
+
+    for _ in range(3):
+        _, l_ser = m_ser(tx, ty)
+        _, l_pp = m_pp(tx, ty)
+    assert abs(float(l_ser.numpy()) - float(l_pp.numpy())) < 2e-3, \
+        (float(l_ser.numpy()), float(l_pp.numpy()))
+    # stage-sharded stacks updated correctly on every stage
+    for k in ("Wq", "W1"):
+        np.testing.assert_allclose(m_ser.get_params()[k].numpy(),
+                                   m_pp.get_params()[k].numpy(),
+                                   atol=2e-3, err_msg=k)
+
+
 def _stage_apply(params, x):
     W, b = params
     return jnp.tanh(x @ W + b)

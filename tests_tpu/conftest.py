@@ -1,0 +1,24 @@
+"""TPU-gated tests: run on the real chip (ambient platform, no CPU pin).
+
+These are NOT part of the CPU-mesh suite (tests/); run explicitly with
+`python -m pytest tests_tpu/ -q` on a machine with a TPU attached.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        on_tpu = False
+    if not on_tpu:
+        skip = pytest.mark.skip(reason="no TPU attached")
+        for item in items:
+            item.add_marker(skip)
