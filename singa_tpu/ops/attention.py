@@ -349,7 +349,8 @@ def flash_attention(q, k, v, causal=False, scale=None,
                     interpret=None):
     """Fused attention; q,k,v (B,H,S,D). Falls back to the reference path
     when shapes don't tile (S % block != 0) or Pallas is unavailable."""
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _, _, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
     return out
 
 
@@ -385,7 +386,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, lse, bq, bk = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                                  interpret)
     if lse is None:  # fallback path: vjp of the reference impl
         d = q.shape[-1]
         s, _ = _resolve(scale, d, interpret)
@@ -404,9 +406,8 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
     d = q.shape[-1]
     s, interp = _resolve(scale, d, interpret)
     sq, sk = q.shape[2], k.shape[2]
-    bq, bk = min(block_q, sq), min(block_k, sk)
-    if _HAS_PALLAS and sq % bq == 0 and sk % bk == 0 and bq % 8 == 0 \
-            and bk % 8 == 0:
+    bq, bk, ok = _resolve_blocks(sq, sk, block_q, block_k)
+    if _HAS_PALLAS and ok:
         return _flash_bwd_pallas(q, k, v, out, lse, g, causal, s, bq, bk,
                                  interp)
     return _flash_bwd_blockwise(q, k, v, out, lse, g, causal, s, bk)
