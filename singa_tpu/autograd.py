@@ -1368,3 +1368,411 @@ def attention(q, k, v, causal=False, seq_axis=None):
     if seq_axis is not None:
         return _RingAttention(seq_axis, causal)(q, k, v)
     return _FlashAttention(causal)(q, k, v)
+
+
+# ======================= extended ONNX op set ==============================
+# Ops beyond the reference's _rename_operators table (sonnx.py:1046-1133),
+# needed to import real-world exported models (torch/tf2onnx graphs use
+# ConvTranspose, InstanceNorm, ArgMax, the full Reduce* family, LSTM/GRU,
+# TopK, LRN, ...). Forwards are jnp/lax; backward vjp-derived unless noted.
+
+
+class ArgMax(Operator):
+    never_requires_grad = True
+
+    def __init__(self, axis=0, keepdims=True, select_last_index=False):
+        super().__init__()
+        self.axis, self.keepdims = int(axis), bool(keepdims)
+
+    def forward(self, x):
+        y = jnp.argmax(x, axis=self.axis).astype(jnp.int64)
+        return jnp.expand_dims(y, self.axis) if self.keepdims else y
+
+
+class ArgMin(Operator):
+    never_requires_grad = True
+
+    def __init__(self, axis=0, keepdims=True, select_last_index=False):
+        super().__init__()
+        self.axis, self.keepdims = int(axis), bool(keepdims)
+
+    def forward(self, x):
+        y = jnp.argmin(x, axis=self.axis).astype(jnp.int64)
+        return jnp.expand_dims(y, self.axis) if self.keepdims else y
+
+
+class _Reduce(Operator):
+    """Shared shell for the ONNX Reduce* family."""
+    _fn = None
+
+    def __init__(self, axes=None, keepdims=True):
+        super().__init__()
+        self.axes = tuple(int(a) for a in axes) if axes is not None else None
+        self.keepdims = bool(keepdims)
+
+    def forward(self, x):
+        return type(self)._fn(x, self.axes, self.keepdims)
+
+
+class ReduceMax(_Reduce):
+    _fn = staticmethod(lambda x, a, k: jnp.max(x, axis=a, keepdims=k))
+
+
+class ReduceMin(_Reduce):
+    _fn = staticmethod(lambda x, a, k: jnp.min(x, axis=a, keepdims=k))
+
+
+class ReduceProd(_Reduce):
+    _fn = staticmethod(lambda x, a, k: jnp.prod(x, axis=a, keepdims=k))
+
+
+class ReduceL1(_Reduce):
+    _fn = staticmethod(
+        lambda x, a, k: jnp.sum(jnp.abs(x), axis=a, keepdims=k))
+
+
+class ReduceL2(_Reduce):
+    _fn = staticmethod(
+        lambda x, a, k: jnp.sqrt(jnp.sum(x * x, axis=a, keepdims=k)))
+
+
+class ReduceLogSum(_Reduce):
+    _fn = staticmethod(
+        lambda x, a, k: jnp.log(jnp.sum(x, axis=a, keepdims=k)))
+
+
+class ReduceLogSumExp(_Reduce):
+    _fn = staticmethod(
+        lambda x, a, k: jax.scipy.special.logsumexp(x, axis=a, keepdims=k))
+
+
+class ReduceSumSquare(_Reduce):
+    _fn = staticmethod(lambda x, a, k: jnp.sum(x * x, axis=a, keepdims=k))
+
+
+class LogSoftmax(Operator):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = int(axis)
+
+    def forward(self, x):
+        return jax.nn.log_softmax(x, axis=self.axis)
+
+
+class Hardmax(Operator):
+    never_requires_grad = True
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = int(axis)
+
+    def forward(self, x):
+        idx = jnp.argmax(x, axis=self.axis)
+        return jax.nn.one_hot(idx, x.shape[self.axis], axis=self.axis,
+                              dtype=x.dtype)
+
+
+class HardSwish(Operator):
+    def forward(self, x):
+        return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+class Celu(Operator):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self.alpha = float(alpha)
+
+    def forward(self, x):
+        a = self.alpha
+        return jnp.maximum(x, 0.0) + jnp.minimum(
+            0.0, a * (jnp.exp(x / a) - 1.0))
+
+
+class ThresholdedRelu(Operator):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self.alpha = float(alpha)
+
+    def forward(self, x):
+        return jnp.where(x > self.alpha, x, 0.0)
+
+
+class Shrink(Operator):
+    def __init__(self, bias=0.0, lambd=0.5):
+        super().__init__()
+        self.bias, self.lambd = float(bias), float(lambd)
+
+    def forward(self, x):
+        return jnp.where(x < -self.lambd, x + self.bias,
+                         jnp.where(x > self.lambd, x - self.bias, 0.0))
+
+
+class Mod(Operator):
+    never_requires_grad = True
+
+    def __init__(self, fmod=0):
+        super().__init__()
+        self.fmod = int(fmod)
+
+    def forward(self, a, b):
+        return jnp.fmod(a, b) if self.fmod else jnp.mod(a, b)
+
+
+class CumSum(Operator):
+    def __init__(self, axis=0, exclusive=0, reverse=0):
+        super().__init__()
+        self.axis = int(axis)
+        self.exclusive, self.reverse = int(exclusive), int(reverse)
+
+    def forward(self, x):
+        ax = self.axis
+        if self.reverse:
+            x = jnp.flip(x, ax)
+        y = jnp.cumsum(x, axis=ax)
+        if self.exclusive:
+            y = jnp.roll(y, 1, axis=ax)
+            y = y.at[(slice(None),) * (ax % y.ndim) + (0,)].set(0)
+        if self.reverse:
+            y = jnp.flip(y, ax)
+        return y
+
+
+class EyeLike(Operator):
+    never_requires_grad = True
+
+    def __init__(self, k=0, dtype=None):
+        super().__init__()
+        self.k = int(k)
+        self.dtype = dtype
+
+    def forward(self, x):
+        return jnp.eye(x.shape[-2], x.shape[-1], k=self.k,
+                       dtype=self.dtype or x.dtype)
+
+
+class Size(Operator):
+    never_requires_grad = True
+
+    def forward(self, x):
+        return jnp.asarray(x.size, jnp.int64)
+
+
+class IsNaN(Operator):
+    never_requires_grad = True
+
+    def forward(self, x):
+        return jnp.isnan(x).astype(jnp.float32)
+
+
+class IsInf(Operator):
+    never_requires_grad = True
+
+    def __init__(self, detect_negative=1, detect_positive=1):
+        super().__init__()
+        self.neg, self.pos = bool(detect_negative), bool(detect_positive)
+
+    def forward(self, x):
+        hit = jnp.zeros(x.shape, bool)
+        if self.pos:
+            hit |= jnp.isposinf(x)
+        if self.neg:
+            hit |= jnp.isneginf(x)
+        return hit.astype(jnp.float32)
+
+
+class Trilu(Operator):
+    def __init__(self, upper=1, k=0):
+        super().__init__()
+        self.upper, self.k = int(upper), int(k)
+
+    def forward(self, x):
+        return jnp.triu(x, self.k) if self.upper else jnp.tril(x, self.k)
+
+
+class GatherElements(Operator):
+    """jnp.take_along_axis; ONNX GatherElements / torch.gather."""
+
+    def __init__(self, axis, indices):
+        super().__init__()
+        self.axis = int(axis)
+        self.indices = jnp.asarray(indices, jnp.int32)
+
+    def forward(self, x):
+        return jnp.take_along_axis(x, self.indices, axis=self.axis)
+
+
+class TopK(Operator):
+    """(values, indices) of the k largest along `axis`. Values carry
+    gradient (scatter back through the selected slots); indices are int."""
+
+    def __init__(self, k, axis=-1, largest=True):
+        super().__init__()
+        self.k, self.axis, self.largest = int(k), int(axis), bool(largest)
+
+    def forward(self, x):
+        ax = self.axis % x.ndim
+        xs = jnp.moveaxis(x, ax, -1)
+        xs = xs if self.largest else -xs
+        v, i = jax.lax.top_k(xs, self.k)
+        v = v if self.largest else -v
+        self._x_shape, self._ax = x.shape, ax
+        self._idx = i
+        return (jnp.moveaxis(v, -1, ax),
+                jnp.moveaxis(i, -1, ax).astype(jnp.int64))
+
+    def backward(self, dv, di):
+        dv = jnp.moveaxis(dv, self._ax, -1)
+        zero = jnp.zeros(jnp.moveaxis(
+            jnp.empty(self._x_shape), self._ax, -1).shape, dv.dtype)
+        dx = jnp.put_along_axis(zero, self._idx, dv, axis=-1,
+                                inplace=False)
+        return jnp.moveaxis(dx, -1, self._ax)
+
+
+class LRN(Operator):
+    """Local response normalization (AlexNet-era ONNX zoo models)."""
+
+    def __init__(self, size, alpha=1e-4, beta=0.75, bias=1.0):
+        super().__init__()
+        self.size = int(size)
+        self.alpha, self.beta, self.bias = float(alpha), float(beta), \
+            float(bias)
+
+    def forward(self, x):
+        half = self.size // 2
+        sq = x * x
+        pad = [(0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)]
+        sq = jnp.pad(sq, pad)
+        acc = sum(sq[:, i:i + x.shape[1]] for i in range(self.size))
+        return x / jnp.power(self.bias + self.alpha / self.size * acc,
+                             self.beta)
+
+
+class MeanVarianceNormalization(Operator):
+    def __init__(self, axes=(0, 2, 3)):
+        super().__init__()
+        self.axes = tuple(int(a) for a in axes)
+
+    def forward(self, x):
+        m = jnp.mean(x, axis=self.axes, keepdims=True)
+        v = jnp.var(x, axis=self.axes, keepdims=True)
+        return (x - m) / jnp.sqrt(v + 1e-9)
+
+
+class LpNormalization(Operator):
+    def __init__(self, axis=-1, p=2):
+        super().__init__()
+        self.axis, self.p = int(axis), int(p)
+
+    def forward(self, x):
+        if self.p == 1:
+            n = jnp.sum(jnp.abs(x), axis=self.axis, keepdims=True)
+        else:
+            n = jnp.sqrt(jnp.sum(x * x, axis=self.axis, keepdims=True))
+        return x / jnp.maximum(n, 1e-12)
+
+
+class InstanceNorm2d(Operator):
+    """Per-sample per-channel spatial normalization (NCHW)."""
+
+    def __init__(self, eps=1e-5):
+        super().__init__()
+        self.eps = float(eps)
+
+    def forward(self, x, gamma, beta):
+        m = jnp.mean(x, axis=(2, 3), keepdims=True)
+        v = jnp.var(x, axis=(2, 3), keepdims=True)
+        xhat = (x - m) * jax.lax.rsqrt(v + self.eps)
+        return xhat * gamma.reshape(1, -1, 1, 1) + beta.reshape(1, -1, 1, 1)
+
+
+class _ConvTranspose2d(Operator):
+    """Gradient-of-conv transposed convolution (NCHW, OIHW-transposed
+    weights as ONNX lays them out: (C_in, C_out/group, kH, kW))."""
+
+    def __init__(self, stride=(1, 1), padding=(0, 0), output_padding=(0, 0),
+                 dilation=(1, 1), group=1):
+        super().__init__()
+        self.stride = tuple(int(s) for s in stride)
+        self.padding = tuple(int(p) for p in padding)
+        self.output_padding = tuple(int(p) for p in output_padding)
+        self.dilation = tuple(int(d) for d in dilation)
+        self.group = int(group)
+
+    def forward(self, x, W, b=None):
+        kh, kw = W.shape[2], W.shape[3]
+        ph, pw = self.padding
+        oph, opw = self.output_padding
+        dh, dw = self.dilation
+        # lax.conv_transpose pads the *output*; ONNX semantics: out =
+        # (in-1)*stride - 2*pad + dilation*(k-1) + output_padding + 1
+        pads = ((dh * (kh - 1) - ph, dh * (kh - 1) - ph + oph),
+                (dw * (kw - 1) - pw, dw * (kw - 1) - pw + opw))
+        y = jax.lax.conv_general_dilated(
+            x, jnp.flip(W, (2, 3)).transpose(1, 0, 2, 3)
+            if self.group == 1 else self._grouped_kernel(W),
+            window_strides=(1, 1),
+            padding=pads,
+            lhs_dilation=self.stride,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.group)
+        if b is not None:
+            y = y + b.reshape(1, -1, 1, 1)
+        return y
+
+    def _grouped_kernel(self, W):
+        # (C_in, C_out/g, kH, kW) -> per-group OIHW stacked on O
+        g = self.group
+        ci, cog, kh, kw = W.shape
+        Wg = W.reshape(g, ci // g, cog, kh, kw)
+        Wg = jnp.flip(Wg, (3, 4)).transpose(0, 2, 1, 3, 4)
+        return Wg.reshape(g * cog, ci // g, kh, kw)
+
+
+class GlobalMaxPool(Operator):
+    def forward(self, x):
+        return jnp.max(x, axis=(2, 3), keepdims=True)
+
+
+class Einsum(Operator):
+    def __init__(self, equation):
+        super().__init__()
+        self.equation = equation
+
+    def forward(self, *xs):
+        return jnp.einsum(self.equation, *xs)
+
+
+class GreaterOrEqual(_CmpBinary):
+    _fn = staticmethod(jnp.greater_equal)
+
+
+class LessOrEqual(_CmpBinary):
+    _fn = staticmethod(jnp.less_equal)
+
+
+argmax = _functional(ArgMax)
+argmin = _functional(ArgMin)
+reduce_max = _functional(ReduceMax)
+reduce_min = _functional(ReduceMin)
+reduce_prod = _functional(ReduceProd)
+log_softmax = _functional(LogSoftmax)
+hardswish = _functional(HardSwish)
+celu = _functional(Celu)
+cumsum = _functional(CumSum)
+trilu = _functional(Trilu)
+topk = _functional(TopK)
+lrn = _functional(LRN)
+einsum = _functional(Einsum)
+global_max_pool = _functional(GlobalMaxPool)
+
+
+def instance_norm(x, gamma, beta, eps=1e-5):
+    return InstanceNorm2d(eps)(x, gamma, beta)
+
+
+def conv_transpose2d(x, W, b=None, stride=(1, 1), padding=(0, 0),
+                     output_padding=(0, 0), dilation=(1, 1), group=1):
+    op = _ConvTranspose2d(stride, padding, output_padding, dilation, group)
+    return op(x, W, b) if b is not None else op(x, W)

@@ -117,19 +117,30 @@ def reverse_padded(x: Tensor, lengths: Tensor):
 
 
 class _GRUScan(Operator):
-    def __init__(self, hidden: int):
+    def __init__(self, hidden: int, linear_before_reset: bool = True):
         super().__init__("GRUScan")
         self.hidden = hidden
+        self.lbr = bool(linear_before_reset)
 
-    def forward(self, x, hx, Wx, Wh, b):
+    def forward(self, x, hx, Wx, Wh, b, rb=None):
         H = self.hidden
+        lbr = self.lbr
 
         def body(h, xt):
             zx = xt @ Wx + b
-            zh = h @ Wh
+            zh = h @ Wh if rb is None else h @ Wh + rb
             r = jax.nn.sigmoid(zx[..., :H] + zh[..., :H])
             u = jax.nn.sigmoid(zx[..., H:2 * H] + zh[..., H:2 * H])
-            n = jnp.tanh(zx[..., 2 * H:] + r * zh[..., 2 * H:])
+            if lbr:
+                # n = tanh(Wn x + Wbn + r * (Rn h + Rbn))
+                n = jnp.tanh(zx[..., 2 * H:] + r * zh[..., 2 * H:])
+            else:
+                # n = tanh(Wn x + Wbn + (r*h) Rn + Rbn): reset applies to h
+                # BEFORE the recurrent matmul (ONNX linear_before_reset=0)
+                nr = (r * h) @ Wh[:, 2 * H:]
+                if rb is not None:
+                    nr = nr + rb[2 * H:]
+                n = jnp.tanh(zx[..., 2 * H:] + nr)
             h_new = (1 - u) * n + u * h
             return h_new, h_new
 
@@ -137,5 +148,12 @@ class _GRUScan(Operator):
         return ys, hy
 
 
-def gru_scan(x: Tensor, hx: Tensor, Wx: Tensor, Wh: Tensor, b: Tensor):
-    return _GRUScan(Wh.shape[0])(x, hx, Wx, Wh, b)
+def gru_scan(x: Tensor, hx: Tensor, Wx: Tensor, Wh: Tensor, b: Tensor,
+             rb: Tensor | None = None, linear_before_reset: bool = True):
+    """Optional `rb` is a separate recurrent bias (3H,). With
+    `linear_before_reset` (torch/keras-reset_after exports) it is added to
+    `h @ Wh` inside the reset multiply; without, the reset gate multiplies
+    `h` before the candidate's recurrent matmul (ONNX GRU lbr=0)."""
+    op = _GRUScan(Wh.shape[0], linear_before_reset)
+    return op(x, hx, Wx, Wh, b, rb) if rb is not None \
+        else op(x, hx, Wx, Wh, b)

@@ -45,6 +45,97 @@ def _np_const(env, name):
     return None
 
 
+def _np_div(a, b):
+    """ONNX Div on ints truncates toward zero (C semantics)."""
+    if np.issubdtype(np.asarray(a).dtype, np.integer):
+        return np.trunc(np.true_divide(a, b)).astype(np.asarray(a).dtype)
+    return np.true_divide(a, b)
+
+
+def _np_slice(node, ins):
+    data, starts, ends = ins[0], np.atleast_1d(ins[1]), np.atleast_1d(ins[2])
+    axes = np.atleast_1d(ins[3]) if len(ins) > 3 and ins[3] is not None \
+        else range(len(starts))
+    steps = np.atleast_1d(ins[4]) if len(ins) > 4 and ins[4] is not None \
+        else [1] * len(starts)
+    sl = [slice(None)] * data.ndim
+    for s, e, a, st in zip(starts, ends, axes, steps):
+        sl[int(a)] = slice(int(s), int(min(e, np.iinfo(np.int64).max)),
+                           int(st))
+    return data[tuple(sl)]
+
+
+def _np_unsqueeze(node, ins):
+    y = ins[0]
+    axes = np.atleast_1d(ins[1]) if len(ins) > 1 and ins[1] is not None \
+        else np.atleast_1d(node.attrs["axes"])
+    for a in sorted(int(a) for a in axes):
+        y = np.expand_dims(y, a)
+    return y
+
+
+def _np_squeeze(node, ins):
+    axes = None
+    if len(ins) > 1 and ins[1] is not None:      # opset 13: input
+        axes = ins[1]
+    elif "axes" in node.attrs:                   # opset <13: attribute
+        axes = node.attrs["axes"]
+    return np.squeeze(ins[0], tuple(int(a) for a in np.atleast_1d(axes))
+                      if axes is not None else None)
+
+
+def _np_reshape(node, ins):
+    # ONNX: a 0 in the target shape copies the input dim at that position
+    shape = [int(s) if s != 0 else ins[0].shape[i]
+             for i, s in enumerate(np.atleast_1d(ins[1]).tolist())]
+    return ins[0].reshape(shape)
+
+
+#: Shape-arithmetic chains exported by torch (Shape->Gather->Add->Div->
+#: Concat->Reshape/Slice...) must fold on host with INTEGER semantics, not
+#: get traced as float device ops. Applied when every input is a host
+#: ndarray (initializer consts / Shape outputs), never to tape Tensors.
+_NP_FOLD = {
+    "Add": lambda n, i: i[0] + i[1],
+    "Sub": lambda n, i: i[0] - i[1],
+    "Mul": lambda n, i: i[0] * i[1],
+    "Div": lambda n, i: _np_div(i[0], i[1]),
+    "Mod": lambda n, i: np.fmod(i[0], i[1]) if n.attrs.get("fmod")
+    else np.mod(i[0], i[1]),
+    "Neg": lambda n, i: -i[0],
+    "Abs": lambda n, i: np.abs(i[0]),
+    "Floor": lambda n, i: np.floor(i[0]),
+    "Ceil": lambda n, i: np.ceil(i[0]),
+    "Gather": lambda n, i: np.take(i[0], i[1].astype(np.int64),
+                                   axis=int(n.attrs.get("axis", 0))),
+    "Concat": lambda n, i: np.concatenate(i, axis=int(n.attrs.get("axis",
+                                                                  0))),
+    "Unsqueeze": _np_unsqueeze,
+    "Squeeze": _np_squeeze,
+    "Cast": lambda n, i: i[0].astype(
+        pb._ONNX2NP.get(int(n.attrs["to"]), np.float32)),
+    "Slice": _np_slice,
+    "Range": lambda n, i: np.arange(np.asarray(i[0]).ravel()[0],
+                                    np.asarray(i[1]).ravel()[0],
+                                    np.asarray(i[2]).ravel()[0]),
+    "Min": lambda n, i: np.minimum.reduce(i),
+    "Max": lambda n, i: np.maximum.reduce(i),
+    "Equal": lambda n, i: i[0] == i[1],
+    "Less": lambda n, i: i[0] < i[1],
+    "Greater": lambda n, i: i[0] > i[1],
+    "Where": lambda n, i: np.where(i[0], i[1], i[2]),
+    "ReduceProd": lambda n, i: np.prod(
+        i[0], axis=tuple(n.attrs["axes"]) if "axes" in n.attrs else None,
+        keepdims=bool(n.attrs.get("keepdims", 1))),
+    "Identity": lambda n, i: i[0],
+    "Reshape": _np_reshape,
+    "Expand": lambda n, i: np.broadcast_to(
+        i[0], np.broadcast_shapes(i[0].shape,
+                                  tuple(int(s) for s in i[1]))),
+    "Transpose": lambda n, i: np.transpose(i[0], n.attrs.get("perm")),
+}
+
+
 class SingaBackend:
     """Builds an executable op list from a ModelProto."""
 
@@ -94,6 +185,15 @@ class SingaBackend:
             for name, t in zip(self.input_names, inputs):
                 env[name] = t
         for node in self.nodes:
+            fold = _NP_FOLD.get(node.op_type)
+            if fold is not None and node.inputs and any(
+                    nm for nm in node.inputs) and all(
+                    isinstance(env.get(nm), np.ndarray)
+                    for nm in node.inputs if nm):
+                # keep positions: '' optional-input placeholders become None
+                ins = [env[nm] if nm else None for nm in node.inputs]
+                env[node.outputs[0]] = np.asarray(fold(node, ins))
+                continue
             handler = getattr(self, "op_" + node.op_type, None)
             if handler is None:
                 raise NotImplementedError(
@@ -485,6 +585,306 @@ class SingaBackend:
         if len(node.outputs) > 1:
             return out, out  # mask output unused downstream in real models
         return out
+
+    def op_ReduceMax(self, node, env):
+        return self._reduce(node, env, autograd.ReduceMax)
+
+    def op_ReduceMin(self, node, env):
+        return self._reduce(node, env, autograd.ReduceMin)
+
+    def op_ReduceProd(self, node, env):
+        return self._reduce(node, env, autograd.ReduceProd)
+
+    def op_ReduceL1(self, node, env):
+        return self._reduce(node, env, autograd.ReduceL1)
+
+    def op_ReduceL2(self, node, env):
+        return self._reduce(node, env, autograd.ReduceL2)
+
+    def op_ReduceLogSum(self, node, env):
+        return self._reduce(node, env, autograd.ReduceLogSum)
+
+    def op_ReduceLogSumExp(self, node, env):
+        return self._reduce(node, env, autograd.ReduceLogSumExp)
+
+    def op_ReduceSumSquare(self, node, env):
+        return self._reduce(node, env, autograd.ReduceSumSquare)
+
+    def _reduce(self, node, env, cls):
+        axes = self._const(env, node, 1, attr="axes")
+        axes = tuple(int(a) for a in np.atleast_1d(axes)) if axes is not None \
+            else None
+        return cls(axes, bool(_attr(node.proto, "keepdims", 1)))(
+            self._t(env, node.inputs[0]))
+
+    def op_ArgMax(self, node, env):
+        return autograd.ArgMax(
+            int(_attr(node.proto, "axis", 0)),
+            int(_attr(node.proto, "keepdims", 1)))(
+            self._t(env, node.inputs[0]))
+
+    def op_ArgMin(self, node, env):
+        return autograd.ArgMin(
+            int(_attr(node.proto, "axis", 0)),
+            int(_attr(node.proto, "keepdims", 1)))(
+            self._t(env, node.inputs[0]))
+
+    def op_LogSoftmax(self, node, env):
+        return autograd.log_softmax(self._t(env, node.inputs[0]),
+                                    axis=int(_attr(node.proto, "axis", -1)))
+
+    def op_Hardmax(self, node, env):
+        return autograd.Hardmax(int(_attr(node.proto, "axis", -1)))(
+            self._t(env, node.inputs[0]))
+
+    def op_HardSwish(self, node, env):
+        return autograd.hardswish(self._t(env, node.inputs[0]))
+
+    def op_Celu(self, node, env):
+        return autograd.celu(self._t(env, node.inputs[0]),
+                             alpha=_attr(node.proto, "alpha", 1.0))
+
+    def op_ThresholdedRelu(self, node, env):
+        return autograd.ThresholdedRelu(_attr(node.proto, "alpha", 1.0))(
+            self._t(env, node.inputs[0]))
+
+    def op_Shrink(self, node, env):
+        return autograd.Shrink(_attr(node.proto, "bias", 0.0),
+                               _attr(node.proto, "lambd", 0.5))(
+            self._t(env, node.inputs[0]))
+
+    def op_Mod(self, node, env):
+        return autograd.Mod(int(_attr(node.proto, "fmod", 0)))(
+            self._t(env, node.inputs[0]), self._t(env, node.inputs[1]))
+
+    def op_CumSum(self, node, env):
+        axis = int(np.asarray(self._const(env, node, 1)).ravel()[0])
+        return autograd.cumsum(self._t(env, node.inputs[0]), axis=axis,
+                               exclusive=int(_attr(node.proto, "exclusive", 0)),
+                               reverse=int(_attr(node.proto, "reverse", 0)))
+
+    def op_Range(self, node, env):
+        start, limit, delta = (np.asarray(self._const(env, node, i)).ravel()[0]
+                               for i in range(3))
+        return np.arange(start, limit, delta)  # host constant, foldable
+
+    def op_EyeLike(self, node, env):
+        dt = node.attrs.get("dtype")
+        np_dt = pb._ONNX2NP.get(int(dt)) if dt is not None else None
+        return autograd.EyeLike(int(_attr(node.proto, "k", 0)), np_dt)(
+            self._t(env, node.inputs[0]))
+
+    def op_Size(self, node, env):
+        x = env[node.inputs[0]]
+        return np.asarray(np.prod(x.shape), np.int64)  # host constant
+
+    def op_IsNaN(self, node, env):
+        return autograd.IsNaN()(self._t(env, node.inputs[0]))
+
+    def op_IsInf(self, node, env):
+        return autograd.IsInf(
+            int(_attr(node.proto, "detect_negative", 1)),
+            int(_attr(node.proto, "detect_positive", 1)))(
+            self._t(env, node.inputs[0]))
+
+    def op_Trilu(self, node, env):
+        k = self._const(env, node, 1, default=0)
+        return autograd.trilu(self._t(env, node.inputs[0]),
+                              upper=int(_attr(node.proto, "upper", 1)),
+                              k=int(np.asarray(k).ravel()[0]))
+
+    def op_GatherElements(self, node, env):
+        idx = self._const(env, node, 1)
+        if idx is None:
+            idx = self._t(env, node.inputs[1]).numpy()
+        return autograd.GatherElements(
+            int(_attr(node.proto, "axis", 0)), idx.astype(np.int32))(
+            self._t(env, node.inputs[0]))
+
+    def op_TopK(self, node, env):
+        k = int(np.asarray(self._const(env, node, 1, attr="k")).ravel()[0])
+        return autograd.TopK(k, int(_attr(node.proto, "axis", -1)),
+                             bool(_attr(node.proto, "largest", 1)))(
+            self._t(env, node.inputs[0]))
+
+    def op_LRN(self, node, env):
+        return autograd.LRN(int(node.attrs["size"]),
+                            _attr(node.proto, "alpha", 1e-4),
+                            _attr(node.proto, "beta", 0.75),
+                            _attr(node.proto, "bias", 1.0))(
+            self._t(env, node.inputs[0]))
+
+    def op_MeanVarianceNormalization(self, node, env):
+        axes = _attr(node.proto, "axes", [0, 2, 3])
+        return autograd.MeanVarianceNormalization(tuple(axes))(
+            self._t(env, node.inputs[0]))
+
+    def op_LpNormalization(self, node, env):
+        return autograd.LpNormalization(int(_attr(node.proto, "axis", -1)),
+                                        int(_attr(node.proto, "p", 2)))(
+            self._t(env, node.inputs[0]))
+
+    def op_InstanceNormalization(self, node, env):
+        return autograd.instance_norm(
+            self._t(env, node.inputs[0]), self._t(env, node.inputs[1]),
+            self._t(env, node.inputs[2]),
+            eps=_attr(node.proto, "epsilon", 1e-5))
+
+    def op_ConvTranspose(self, node, env):
+        x = self._t(env, node.inputs[0])
+        W = self._t(env, node.inputs[1])
+        b = self._t(env, node.inputs[2]) if len(node.inputs) > 2 else None
+        pads = _attr(node.proto, "pads", [0, 0, 0, 0])
+        assert pads[0] == pads[2] and pads[1] == pads[3], \
+            "asymmetric ConvTranspose pads unsupported"
+        return autograd.conv_transpose2d(
+            x, W, b,
+            stride=tuple(_attr(node.proto, "strides", [1, 1])),
+            padding=(int(pads[0]), int(pads[1])),
+            output_padding=tuple(_attr(node.proto, "output_padding", [0, 0])),
+            dilation=tuple(_attr(node.proto, "dilations", [1, 1])),
+            group=int(_attr(node.proto, "group", 1)))
+
+    def op_GlobalMaxPool(self, node, env):
+        return autograd.global_max_pool(self._t(env, node.inputs[0]))
+
+    def op_Einsum(self, node, env):
+        eq = node.attrs["equation"]
+        if isinstance(eq, bytes):
+            eq = eq.decode()
+        return autograd.einsum(*[self._t(env, n) for n in node.inputs],
+                               equation=eq)
+
+    op_GreaterOrEqual = _binary(lambda a, b: autograd.GreaterOrEqual()(a, b))
+    op_LessOrEqual = _binary(lambda a, b: autograd.LessOrEqual()(a, b))
+
+    def op_LSTM(self, node, env):
+        """Single-layer uni/bidirectional ONNX LSTM mapped onto the fused
+        scan (ops/rnn.py). ONNX gate order iofc, W (dirs, 4H, I),
+        R (dirs, 4H, H), B (dirs, 8H); scan expects ifgo with
+        Wx (I, 4H)."""
+        from ..ops import rnn as rnn_ops
+        x = self._t(env, node.inputs[0])       # (seq, batch, input)
+        W = self._t(env, node.inputs[1]).numpy()
+        R = self._t(env, node.inputs[2]).numpy()
+        B = None
+        if len(node.inputs) > 3 and node.inputs[3]:
+            B = self._t(env, node.inputs[3]).numpy()
+        seq_lens = None
+        if len(node.inputs) > 4 and node.inputs[4]:
+            seq_lens = self._t(env, node.inputs[4])
+        hidden = int(node.attrs["hidden_size"])
+        direction = _attr(node.proto, "direction", "forward")
+        if isinstance(direction, bytes):
+            direction = direction.decode()
+
+        def _dir(d):
+            # iofc -> ifgo (our scan's gate layout: i, f, g(=c), o)
+            perm = np.concatenate([np.arange(hidden),              # i
+                                   np.arange(2 * hidden, 3 * hidden),  # f
+                                   np.arange(3 * hidden, 4 * hidden),  # c->g
+                                   np.arange(hidden, 2 * hidden)])     # o
+            Wx = from_numpy(W[d][perm].T.copy(), device=self.device)
+            Wh = from_numpy(R[d][perm].T.copy(), device=self.device)
+            if B is not None:
+                bb = (B[d][:4 * hidden] + B[d][4 * hidden:])[perm]
+            else:
+                bb = np.zeros(4 * hidden, np.float32)
+            b = from_numpy(bb.astype(np.float32), device=self.device)
+            return Wx, Wh, b
+
+        batch = x.shape[1]
+        init_h = self._t(env, node.inputs[5]) \
+            if len(node.inputs) > 5 and node.inputs[5] else None
+        init_c = self._t(env, node.inputs[6]) \
+            if len(node.inputs) > 6 and node.inputs[6] else None
+        zeros = from_numpy(np.zeros((batch, hidden), np.float32),
+                           device=self.device)
+        outs = []
+        dirs = ["forward", "reverse"] if direction == "bidirectional" \
+            else [direction]
+        for d, dname in enumerate(dirs):
+            Wx, Wh, b = _dir(d)
+            # initial_h/initial_c: (num_dirs, batch, hidden)
+            h0 = autograd.squeeze(autograd.slice(init_h, [d], [d + 1], [0]),
+                                  (0,)) if init_h is not None else zeros
+            c0 = autograd.squeeze(autograd.slice(init_c, [d], [d + 1], [0]),
+                                  (0,)) if init_c is not None else zeros
+            xd = x
+            if dname == "reverse":
+                xd = rnn_ops.reverse_padded(x, seq_lens) if seq_lens is not None \
+                    else autograd.flip(x, 0)
+            if seq_lens is not None:
+                ys, hy, cy = rnn_ops.lstm_scan_ex(xd, seq_lens, h0, c0,
+                                                  Wx, Wh, b)
+            else:
+                ys, hy, cy = rnn_ops.lstm_scan(xd, h0, c0, Wx, Wh, b)
+            if dname == "reverse":
+                ys = rnn_ops.reverse_padded(ys, seq_lens) \
+                    if seq_lens is not None else autograd.flip(ys, 0)
+            outs.append((ys, hy, cy))
+        if len(outs) == 1:
+            ys, hy, cy = outs[0]
+            # ONNX Y: (seq, dirs, batch, hidden); Y_h/Y_c: (dirs, batch, H)
+            return (autograd.unsqueeze(ys, [1]), autograd.unsqueeze(hy, [0]),
+                    autograd.unsqueeze(cy, [0]))
+        ys = autograd.cat([autograd.unsqueeze(o[0], [1]) for o in outs], 1)
+        hy = autograd.cat([autograd.unsqueeze(o[1], [0]) for o in outs], 0)
+        cy = autograd.cat([autograd.unsqueeze(o[2], [0]) for o in outs], 0)
+        return ys, hy, cy
+
+    def op_GRU(self, node, env):
+        """Single-layer uni/bidirectional ONNX GRU (gate order z|r|h) onto
+        the fused GRU scan; honors linear_before_reset and initial_h."""
+        from ..ops import rnn as rnn_ops
+        x = self._t(env, node.inputs[0])
+        W = self._t(env, node.inputs[1]).numpy()
+        R = self._t(env, node.inputs[2]).numpy()
+        B = None
+        if len(node.inputs) > 3 and node.inputs[3]:
+            B = self._t(env, node.inputs[3]).numpy()
+        if len(node.inputs) > 4 and node.inputs[4]:
+            raise NotImplementedError(
+                "GRU sequence_lens not supported (pad or use LSTM)")
+        init_h = self._t(env, node.inputs[5]) \
+            if len(node.inputs) > 5 and node.inputs[5] else None
+        hidden = int(node.attrs["hidden_size"])
+        lbr = bool(_attr(node.proto, "linear_before_reset", 0))
+        direction = _attr(node.proto, "direction", "forward")
+        if isinstance(direction, bytes):
+            direction = direction.decode()
+        # ONNX gate order z|r|h -> scan's r|z|h
+        perm = np.concatenate([np.arange(hidden, 2 * hidden),
+                               np.arange(hidden),
+                               np.arange(2 * hidden, 3 * hidden)])
+        zeros = from_numpy(np.zeros((x.shape[1], hidden), np.float32),
+                           device=self.device)
+        dirs = ["forward", "reverse"] if direction == "bidirectional" \
+            else [direction]
+        outs = []
+        for d, dname in enumerate(dirs):
+            Wx = from_numpy(W[d][perm].T.copy(), device=self.device)
+            Wh = from_numpy(R[d][perm].T.copy(), device=self.device)
+            wb = B[d][:3 * hidden][perm] if B is not None \
+                else np.zeros(3 * hidden, np.float32)
+            rbv = B[d][3 * hidden:][perm] if B is not None \
+                else np.zeros(3 * hidden, np.float32)
+            b = from_numpy(wb.astype(np.float32), device=self.device)
+            rb = from_numpy(rbv.astype(np.float32), device=self.device)
+            h0 = autograd.squeeze(autograd.slice(init_h, [d], [d + 1], [0]),
+                                  (0,)) if init_h is not None else zeros
+            xd = autograd.flip(x, 0) if dname == "reverse" else x
+            ys, hy = rnn_ops.gru_scan(xd, h0, Wx, Wh, b, rb,
+                                      linear_before_reset=lbr)
+            if dname == "reverse":
+                ys = autograd.flip(ys, 0)
+            outs.append((ys, hy))
+        if len(outs) == 1:
+            ys, hy = outs[0]
+            return autograd.unsqueeze(ys, [1]), autograd.unsqueeze(hy, [0])
+        ys = autograd.cat([autograd.unsqueeze(o[0], [1]) for o in outs], 1)
+        hy = autograd.cat([autograd.unsqueeze(o[1], [0]) for o in outs], 0)
+        return ys, hy
 
     def op_ScatterElements(self, node, env):
         idx = self._const(env, node, 1)

@@ -1,0 +1,349 @@
+"""Extended ONNX op-set tests: each handler vs numpy reference semantics.
+
+Covers the ops beyond the reference's _rename_operators table that real
+exported models use (ref sonnx.py:1046-1133 is the baseline; these are the
+torch/tf2onnx extras: Reduce* family, ArgMax, InstanceNorm, ConvTranspose,
+LSTM/GRU, TopK, ...).
+"""
+
+import numpy as np
+import pytest
+
+from singa_tpu import sonnx, tensor
+from singa_tpu.sonnx import onnx_pb as pb
+
+
+def _run_graph(nodes, inputs, n_outputs=1, initializers=(), dev=None):
+    """Build a ModelProto from nodes and run it through the backend."""
+    in_vis = [pb.make_value_info(k, pb.TensorProto.FLOAT, v.shape)
+              for k, v in inputs.items()]
+    out_names = []
+    for n in nodes:
+        out_names.extend(n.output)
+    outs = out_names[-n_outputs:]
+    graph = pb.GraphProto(
+        name="g", node=list(nodes),
+        initializer=[pb.numpy_to_tensor(a, nm) for nm, a in initializers],
+        input=in_vis,
+        output=[pb.make_value_info(o, pb.TensorProto.FLOAT, ())
+                for o in outs])
+    m = pb.ModelProto(ir_version=8, producer_name="t", graph=graph,
+                      opset_import=[pb.OperatorSetIdProto(domain="",
+                                                          version=13)])
+    rep = sonnx.prepare(m, dev)
+    res = rep.run([tensor.from_numpy(v, device=dev)
+                   for v in inputs.values()])
+    return [np.asarray(r.numpy() if hasattr(r, "numpy") else r)
+            for r in res]
+
+
+RS = np.random.RandomState(3)
+X34 = RS.randn(3, 4).astype(np.float32)
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("ReduceMax", lambda x: x.max(1, keepdims=True)),
+    ("ReduceMin", lambda x: x.min(1, keepdims=True)),
+    ("ReduceProd", lambda x: x.prod(1, keepdims=True)),
+    ("ReduceL1", lambda x: np.abs(x).sum(1, keepdims=True)),
+    ("ReduceL2", lambda x: np.sqrt((x * x).sum(1, keepdims=True))),
+    ("ReduceSumSquare", lambda x: (x * x).sum(1, keepdims=True)),
+    ("ReduceLogSumExp",
+     lambda x: np.log(np.exp(x).sum(1, keepdims=True))),
+])
+def test_reduce_family(dev, op, ref):
+    node = pb.make_node(op, ["x"], ["y"], axes=[1], keepdims=1)
+    (y,) = _run_graph([node], {"x": X34}, dev=dev)
+    np.testing.assert_allclose(y, ref(X34), rtol=1e-5)
+
+
+def test_reduce_logsum(dev):
+    x = np.abs(X34) + 0.1
+    node = pb.make_node("ReduceLogSum", ["x"], ["y"], axes=[1], keepdims=1)
+    (y,) = _run_graph([node], {"x": x}, dev=dev)
+    np.testing.assert_allclose(y, np.log(x.sum(1, keepdims=True)), rtol=1e-5)
+
+
+def test_argmax_argmin(dev):
+    for op, ref in [("ArgMax", np.argmax), ("ArgMin", np.argmin)]:
+        node = pb.make_node(op, ["x"], ["y"], axis=1, keepdims=0)
+        (y,) = _run_graph([node], {"x": X34}, dev=dev)
+        np.testing.assert_array_equal(y, ref(X34, 1))
+
+
+def test_logsoftmax_hardmax(dev):
+    (y,) = _run_graph([pb.make_node("LogSoftmax", ["x"], ["y"], axis=-1)],
+                      {"x": X34}, dev=dev)
+    e = np.exp(X34 - X34.max(-1, keepdims=True))
+    np.testing.assert_allclose(
+        y, np.log(e / e.sum(-1, keepdims=True)), rtol=1e-5, atol=1e-6)
+    (h,) = _run_graph([pb.make_node("Hardmax", ["x"], ["y"], axis=-1)],
+                      {"x": X34}, dev=dev)
+    assert h.sum() == 3 and (h.argmax(-1) == X34.argmax(-1)).all()
+
+
+def test_pointwise_extras(dev):
+    x = X34
+    cases = {
+        "HardSwish": x * np.clip(x / 6 + 0.5, 0, 1),
+        "Celu": np.maximum(x, 0) + np.minimum(0, np.exp(x) - 1),
+        "ThresholdedRelu": np.where(x > 1.0, x, 0),
+        "IsNaN": np.zeros_like(x),
+    }
+    for op, ref in cases.items():
+        (y,) = _run_graph([pb.make_node(op, ["x"], ["y"])], {"x": x},
+                          dev=dev)
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_shrink_mod_trilu(dev):
+    (y,) = _run_graph([pb.make_node("Shrink", ["x"], ["y"], bias=0.1,
+                                    lambd=0.5)], {"x": X34}, dev=dev)
+    ref = np.where(X34 < -0.5, X34 + 0.1, np.where(X34 > 0.5, X34 - 0.1, 0))
+    np.testing.assert_allclose(y, ref, rtol=1e-5)
+
+    a = np.array([[5.0, -7.0, 9.0]], np.float32)
+    b = np.array([[3.0, 3.0, -4.0]], np.float32)
+    (y,) = _run_graph([pb.make_node("Mod", ["a", "b"], ["y"], fmod=1)],
+                      {"a": a, "b": b}, dev=dev)
+    np.testing.assert_allclose(y, np.fmod(a, b))
+
+    sq = RS.randn(4, 4).astype(np.float32)
+    (y,) = _run_graph([pb.make_node("Trilu", ["x"], ["y"], upper=0)],
+                      {"x": sq}, dev=dev)
+    np.testing.assert_allclose(y, np.tril(sq))
+
+
+def test_cumsum(dev):
+    (y,) = _run_graph(
+        [pb.make_node("CumSum", ["x", "ax"], ["y"])],
+        {"x": X34}, initializers=[("ax", np.array(1, np.int64))], dev=dev)
+    np.testing.assert_allclose(y, np.cumsum(X34, 1), rtol=1e-6)
+
+
+def test_gather_elements_topk(dev):
+    idx = np.array([[0, 2, 1, 3], [3, 1, 0, 2], [1, 1, 2, 0]], np.int64)
+    (y,) = _run_graph(
+        [pb.make_node("GatherElements", ["x", "i"], ["y"], axis=1)],
+        {"x": X34}, initializers=[("i", idx)], dev=dev)
+    np.testing.assert_allclose(y, np.take_along_axis(X34, idx, 1))
+
+    v, i = _run_graph(
+        [pb.make_node("TopK", ["x", "k"], ["v", "i"], axis=-1)],
+        {"x": X34}, n_outputs=2,
+        initializers=[("k", np.array([2], np.int64))], dev=dev)
+    ref = np.sort(X34, -1)[:, ::-1][:, :2]
+    np.testing.assert_allclose(v, ref, rtol=1e-6)
+    np.testing.assert_allclose(np.take_along_axis(X34, i.astype(np.int64),
+                                                  -1), ref, rtol=1e-6)
+
+
+def test_instance_norm(dev):
+    x = RS.randn(2, 3, 5, 5).astype(np.float32)
+    g = RS.rand(3).astype(np.float32) + 0.5
+    b = RS.randn(3).astype(np.float32)
+    (y,) = _run_graph(
+        [pb.make_node("InstanceNormalization", ["x", "g", "b"], ["y"],
+                      epsilon=1e-5)],
+        {"x": x}, initializers=[("g", g), ("b", b)], dev=dev)
+    m = x.mean((2, 3), keepdims=True)
+    v = x.var((2, 3), keepdims=True)
+    ref = (x - m) / np.sqrt(v + 1e-5) * g.reshape(1, 3, 1, 1) \
+        + b.reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose_vs_torch(dev):
+    torch = pytest.importorskip("torch")
+    x = RS.randn(2, 3, 7, 7).astype(np.float32)
+    W = (RS.randn(3, 4, 3, 3) * 0.2).astype(np.float32)  # (Cin, Cout, kh, kw)
+    b = RS.randn(4).astype(np.float32)
+    for stride, padding, opad in [(1, 0, 0), (2, 1, 1), (2, 0, 0)]:
+        node = pb.make_node("ConvTranspose", ["x", "w", "b"], ["y"],
+                            strides=[stride, stride],
+                            pads=[padding] * 4,
+                            output_padding=[opad, opad])
+        (y,) = _run_graph([node], {"x": x},
+                          initializers=[("w", W), ("b", b)], dev=dev)
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x), torch.from_numpy(W), torch.from_numpy(b),
+            stride=stride, padding=padding, output_padding=opad).numpy()
+        np.testing.assert_allclose(y, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_conv_transpose_grouped_vs_torch(dev):
+    torch = pytest.importorskip("torch")
+    x = RS.randn(1, 4, 6, 6).astype(np.float32)
+    W = (RS.randn(4, 2, 3, 3) * 0.2).astype(np.float32)  # g=2: (Cin,Cout/g,k,k)
+    node = pb.make_node("ConvTranspose", ["x", "w"], ["y"],
+                        strides=[2, 2], pads=[1, 1, 1, 1], group=2)
+    (y,) = _run_graph([node], {"x": x}, initializers=[("w", W)], dev=dev)
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x), torch.from_numpy(W), stride=2, padding=1,
+        groups=2).numpy()
+    np.testing.assert_allclose(y, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_global_max_pool_lrn(dev):
+    torch = pytest.importorskip("torch")
+    x = RS.randn(2, 5, 6, 6).astype(np.float32)
+    (y,) = _run_graph([pb.make_node("GlobalMaxPool", ["x"], ["y"])],
+                      {"x": x}, dev=dev)
+    np.testing.assert_allclose(y, x.max((2, 3), keepdims=True))
+
+    (y,) = _run_graph([pb.make_node("LRN", ["x"], ["y"], size=3,
+                                    alpha=1e-3, beta=0.75, bias=1.0)],
+                      {"x": x}, dev=dev)
+    ref = torch.nn.functional.local_response_norm(
+        torch.from_numpy(x), 3, alpha=1e-3, beta=0.75, k=1.0).numpy()
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_einsum_geq_leq(dev):
+    a = RS.randn(3, 4).astype(np.float32)
+    b = RS.randn(4, 5).astype(np.float32)
+    (y,) = _run_graph([pb.make_node("Einsum", ["a", "b"], ["y"],
+                                    equation="ij,jk->ik")],
+                      {"a": a, "b": b}, dev=dev)
+    np.testing.assert_allclose(y, a @ b, rtol=1e-5)
+    (y,) = _run_graph([pb.make_node("GreaterOrEqual", ["a", "c"], ["y"])],
+                      {"a": a, "c": np.zeros_like(a)}, dev=dev)
+    np.testing.assert_array_equal(y, (a >= 0).astype(np.float32))
+
+
+def test_lstm_vs_torch(dev):
+    torch = pytest.importorskip("torch")
+    S, B, I, H = 5, 2, 3, 4
+    x = RS.randn(S, B, I).astype(np.float32)
+    m = torch.nn.LSTM(I, H)
+    with torch.no_grad():
+        ref, (hn, cn) = m(torch.from_numpy(x))
+    # ONNX layout: W (1, 4H, I) iofc; torch layout ifgo
+    wi, wf, wg, wo = m.weight_ih_l0.detach().numpy().reshape(4, H, I)
+    ri, rf, rg, ro = m.weight_hh_l0.detach().numpy().reshape(4, H, H)
+    bwi, bwf, bwg, bwo = m.bias_ih_l0.detach().numpy().reshape(4, H)
+    bri, brf, brg, bro = m.bias_hh_l0.detach().numpy().reshape(4, H)
+    W = np.concatenate([wi, wo, wf, wg])[None]          # iofc
+    R = np.concatenate([ri, ro, rf, rg])[None]
+    Bb = np.concatenate([np.concatenate([bwi, bwo, bwf, bwg]),
+                         np.concatenate([bri, bro, brf, brg])])[None]
+    node = pb.make_node("LSTM", ["x", "w", "r", "b"], ["Y", "Yh", "Yc"],
+                        hidden_size=H)
+    y, yh, yc = _run_graph([node], {"x": x}, n_outputs=3,
+                           initializers=[("w", W), ("r", R), ("b", Bb)],
+                           dev=dev)
+    np.testing.assert_allclose(y[:, 0], ref.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(yh[0], hn[0].numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(yc[0], cn[0].numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_gru_vs_torch(dev):
+    torch = pytest.importorskip("torch")
+    S, B, I, H = 5, 2, 3, 4
+    x = RS.randn(S, B, I).astype(np.float32)
+    m = torch.nn.GRU(I, H)
+    with torch.no_grad():
+        ref, hn = m(torch.from_numpy(x))
+    # torch gates r|z|n; ONNX wants z|r|n (linear_before_reset=1 semantics)
+    wr, wz, wn = m.weight_ih_l0.detach().numpy().reshape(3, H, I)
+    rr, rz, rn = m.weight_hh_l0.detach().numpy().reshape(3, H, H)
+    bwr, bwz, bwn = m.bias_ih_l0.detach().numpy().reshape(3, H)
+    brr, brz, brn = m.bias_hh_l0.detach().numpy().reshape(3, H)
+    W = np.concatenate([wz, wr, wn])[None]
+    R = np.concatenate([rz, rr, rn])[None]
+    Bb = np.concatenate([np.concatenate([bwz, bwr, bwn]),
+                         np.concatenate([brz, brr, brn])])[None]
+    node = pb.make_node("GRU", ["x", "w", "r", "b"], ["Y", "Yh"],
+                        hidden_size=H, linear_before_reset=1)
+    y, yh = _run_graph([node], {"x": x}, n_outputs=2,
+                       initializers=[("w", W), ("r", R), ("b", Bb)],
+                       dev=dev)
+    np.testing.assert_allclose(y[:, 0], ref.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(yh[0], hn[0].numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_lstm_runs(dev):
+    S, B, I, H = 4, 2, 3, 4
+    x = RS.randn(S, B, I).astype(np.float32)
+    W = (RS.randn(2, 4 * H, I) * 0.1).astype(np.float32)
+    R = (RS.randn(2, 4 * H, H) * 0.1).astype(np.float32)
+    node = pb.make_node("LSTM", ["x", "w", "r"], ["Y", "Yh", "Yc"],
+                        hidden_size=H, direction="bidirectional")
+    y, yh, yc = _run_graph([node], {"x": x}, n_outputs=3,
+                           initializers=[("w", W), ("r", R)], dev=dev)
+    assert y.shape == (S, 2, B, H)
+    assert yh.shape == (2, B, H) and yc.shape == (2, B, H)
+
+
+def test_gru_lbr0_vs_numpy(dev):
+    """ONNX-default linear_before_reset=0: reset gate multiplies h BEFORE
+    the candidate's recurrent matmul."""
+    S, B, I, H = 4, 2, 3, 4
+    x = RS.randn(S, B, I).astype(np.float32)
+    W = (RS.randn(1, 3 * H, I) * 0.3).astype(np.float32)   # z|r|h
+    R = (RS.randn(1, 3 * H, H) * 0.3).astype(np.float32)
+    Bb = (RS.randn(1, 6 * H) * 0.3).astype(np.float32)
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    Wz, Wr, Wn = W[0].reshape(3, H, I)
+    Rz, Rr, Rn = R[0].reshape(3, H, H)
+    bwz, bwr, bwn = Bb[0][:3 * H].reshape(3, H)
+    brz, brr, brn = Bb[0][3 * H:].reshape(3, H)
+    h = np.zeros((B, H), np.float32)
+    ref = []
+    for t in range(S):
+        z = sig(x[t] @ Wz.T + bwz + h @ Rz.T + brz)
+        r = sig(x[t] @ Wr.T + bwr + h @ Rr.T + brr)
+        n = np.tanh(x[t] @ Wn.T + bwn + (r * h) @ Rn.T + brn)
+        h = (1 - z) * n + z * h
+        ref.append(h)
+    node = pb.make_node("GRU", ["x", "w", "r", "b"], ["Y", "Yh"],
+                        hidden_size=H, linear_before_reset=0)
+    y, yh = _run_graph([node], {"x": x}, n_outputs=2,
+                       initializers=[("w", W), ("r", R), ("b", Bb)],
+                       dev=dev)
+    np.testing.assert_allclose(y[:, 0], np.stack(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_initial_state_vs_torch(dev):
+    torch = pytest.importorskip("torch")
+    S, B, I, H = 5, 2, 3, 4
+    x = RS.randn(S, B, I).astype(np.float32)
+    h0 = RS.randn(1, B, H).astype(np.float32)
+    c0 = RS.randn(1, B, H).astype(np.float32)
+    m = torch.nn.LSTM(I, H)
+    with torch.no_grad():
+        ref, (hn, cn) = m(torch.from_numpy(x),
+                          (torch.from_numpy(h0), torch.from_numpy(c0)))
+    wi, wf, wg, wo = m.weight_ih_l0.detach().numpy().reshape(4, H, I)
+    ri, rf, rg, ro = m.weight_hh_l0.detach().numpy().reshape(4, H, H)
+    bwi, bwf, bwg, bwo = m.bias_ih_l0.detach().numpy().reshape(4, H)
+    bri, brf, brg, bro = m.bias_hh_l0.detach().numpy().reshape(4, H)
+    W = np.concatenate([wi, wo, wf, wg])[None]
+    R = np.concatenate([ri, ro, rf, rg])[None]
+    Bb = np.concatenate([np.concatenate([bwi, bwo, bwf, bwg]),
+                         np.concatenate([bri, bro, brf, brg])])[None]
+    node = pb.make_node("LSTM", ["x", "w", "r", "b", "", "h0", "c0"],
+                        ["Y", "Yh", "Yc"], hidden_size=H)
+    y, yh, yc = _run_graph(
+        [node], {"x": x}, n_outputs=3,
+        initializers=[("w", W), ("r", R), ("b", Bb),
+                      ("h0", h0), ("c0", c0)], dev=dev)
+    np.testing.assert_allclose(y[:, 0], ref.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(yh[0], hn[0].numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(yc[0], cn[0].numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_gru_runs(dev):
+    S, B, I, H = 4, 2, 3, 4
+    x = RS.randn(S, B, I).astype(np.float32)
+    W = (RS.randn(2, 3 * H, I) * 0.1).astype(np.float32)
+    R = (RS.randn(2, 3 * H, H) * 0.1).astype(np.float32)
+    node = pb.make_node("GRU", ["x", "w", "r"], ["Y", "Yh"],
+                        hidden_size=H, direction="bidirectional",
+                        linear_before_reset=1)
+    y, yh = _run_graph([node], {"x": x}, n_outputs=2,
+                       initializers=[("w", W), ("r", R)], dev=dev)
+    assert y.shape == (S, 2, B, H) and yh.shape == (2, B, H)
