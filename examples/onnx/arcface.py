@@ -1,0 +1,65 @@
+"""ArcFace face-embedding ONNX import (ref examples/onnx/arcface.py).
+
+The reference embeds two 112x112 face crops with the zoo arcface resnet and
+compares cosine similarity; identical pipeline here, with the L2-normalized
+embedding head exercising the ReduceL2/Div (torch F.normalize) import path.
+"""
+
+import numpy as np
+
+from utils import check_vs_torch, fake_image, load_or_export, run_imported
+
+
+def build_torch():
+    import torch
+    import torch.nn as nn
+
+    class Block(nn.Module):
+        def __init__(self, cin, cout, stride):
+            super().__init__()
+            self.conv = nn.Sequential(
+                nn.BatchNorm2d(cin),
+                nn.Conv2d(cin, cout, 3, 1, 1, bias=False),
+                nn.BatchNorm2d(cout), nn.PReLU(cout),
+                nn.Conv2d(cout, cout, 3, stride, 1, bias=False),
+                nn.BatchNorm2d(cout))
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout)) if (stride != 1 or cin != cout) \
+                else nn.Identity()
+
+        def forward(self, x):
+            return self.conv(x) + self.down(x)
+
+    class ArcFaceNet(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.stem = nn.Sequential(nn.Conv2d(3, 32, 3, 1, 1, bias=False),
+                                      nn.BatchNorm2d(32), nn.PReLU(32))
+            self.body = nn.Sequential(Block(32, 64, 2), Block(64, 64, 1),
+                                      Block(64, 128, 2), Block(128, 128, 1),
+                                      Block(128, 256, 2))
+            self.head = nn.Sequential(nn.Flatten(),
+                                      nn.Linear(256 * 14 * 14, 128))
+
+        def forward(self, x):
+            e = self.head(self.body(self.stem(x)))
+            return torch.nn.functional.normalize(e, dim=1)
+
+    return ArcFaceNet()
+
+
+if __name__ == "__main__":
+    import torch
+    torch.manual_seed(0)
+    face1 = fake_image(112, 112, seed=1)[None]
+    face2 = fake_image(112, 112, seed=2)[None]
+    proto, tm = load_or_export("arcface", build_torch,
+                               torch.from_numpy(face1))
+    (e1,) = run_imported(proto, [face1])
+    (e2,) = run_imported(proto, [face2])
+    sim = float((e1 * e2).sum())
+    dist = float(np.arccos(np.clip(sim, -1, 1)))
+    print(f"embedding dim {e1.shape[1]}, |e1|={np.linalg.norm(e1):.4f}")
+    print(f"cosine similarity {sim:.4f}, angular distance {dist:.4f} rad")
+    check_vs_torch(tm, [torch.from_numpy(face1)], e1, name="arcface")

@@ -1,0 +1,114 @@
+"""BERT-SQuAD ONNX import (ref examples/onnx/bert/bert-squad.py).
+
+The reference downloads bertsquad-10.onnx and extracts answer spans; this
+builds a BERT QA architecture via `transformers` config (random weights
+unless a real file is staged at /tmp/onnx-zoo/bertsquad.onnx), exports,
+imports through the singa_tpu backend, and decodes the same way
+(start/end logits -> best span).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from utils import load_or_export, run_imported  # noqa: E402
+
+SEQ = 48
+VOCAB = 4000
+
+
+def build_torch():
+    """BERT encoder + span head in plain torch (post-LN blocks, token-type
+    embeddings, additive attention mask) — transformers' vmap mask creation
+    can't trace under the TorchScript exporter."""
+    import math
+
+    import torch
+    import torch.nn as nn
+
+    torch.manual_seed(0)
+    D, H, L = 128, 4, 3
+
+    class Block(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.qkv = nn.Linear(D, 3 * D)
+            self.proj = nn.Linear(D, D)
+            self.ln1 = nn.LayerNorm(D)
+            self.ff1 = nn.Linear(D, 256)
+            self.ff2 = nn.Linear(256, D)
+            self.ln2 = nn.LayerNorm(D)
+
+        def forward(self, x, amask):
+            B, S, _ = x.shape
+            q, k, v = self.qkv(x).chunk(3, -1)
+
+            def heads(t):
+                return t.reshape(B, S, H, D // H).transpose(1, 2)
+
+            att = heads(q) @ heads(k).transpose(-1, -2) / math.sqrt(D // H)
+            att = (att + amask).softmax(-1)
+            o = (att @ heads(v)).transpose(1, 2).reshape(B, S, D)
+            x = self.ln1(x + self.proj(o))
+            return self.ln2(x + self.ff2(
+                torch.nn.functional.gelu(self.ff1(x))))
+
+    class BertQA(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.tok = nn.Embedding(VOCAB, D)
+            self.pos = nn.Embedding(SEQ, D)
+            self.typ = nn.Embedding(2, D)
+            self.ln = nn.LayerNorm(D)
+            self.blocks = nn.ModuleList(Block() for _ in range(L))
+            self.span = nn.Linear(D, 2)
+
+        def forward(self, ids, mask, types):
+            pos = torch.arange(ids.shape[1])
+            x = self.ln(self.tok(ids) + self.pos(pos)[None]
+                        + self.typ(types))
+            amask = (1.0 - mask[:, None, None, :].float()) * -1e9
+            for b in self.blocks:
+                x = b(x, amask)
+            logits = self.span(x)
+            return logits[..., 0], logits[..., 1]
+
+    return BertQA()
+
+
+def best_span(start_logits, end_logits, max_len=15):
+    best, span = -1e30, (0, 0)
+    for s in range(len(start_logits)):
+        for e in range(s, min(s + max_len, len(end_logits))):
+            sc = start_logits[s] + end_logits[e]
+            if sc > best:
+                best, span = sc, (s, e)
+    return span, best
+
+
+def main():
+    import torch
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, VOCAB, (1, SEQ)).astype(np.int64)
+    mask = np.ones((1, SEQ), np.int64)
+    types = np.concatenate([np.zeros((1, 12), np.int64),
+                            np.ones((1, SEQ - 12), np.int64)], 1)
+    args = tuple(torch.from_numpy(a) for a in (ids, mask, types))
+    proto, tm = load_or_export("bertsquad", build_torch, args, opset=14)
+    start, end = run_imported(proto, [ids, mask, types], n_out=2)
+    (s, e), score = best_span(start[0], end[0])
+    print(f"best answer span tokens [{s}, {e}] score {score:.3f}")
+    if tm is not None:
+        with torch.no_grad():
+            ref_s, ref_e = tm(*args)
+        np.testing.assert_allclose(start, ref_s.numpy(), rtol=5e-3,
+                                   atol=5e-4)
+        np.testing.assert_allclose(end, ref_e.numpy(), rtol=5e-3,
+                                   atol=5e-4)
+        print("parity vs torch OK (bert-squad)")
+
+
+if __name__ == "__main__":
+    main()

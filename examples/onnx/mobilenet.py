@@ -1,0 +1,62 @@
+"""MobileNetV2 ONNX import (ref examples/onnx/mobilenet.py).
+
+Depthwise convs exercise the grouped-conv import path
+(singa_tpu/sonnx/backend.py op_Conv feature_group_count).
+"""
+
+import numpy as np
+
+from utils import (check_vs_torch, fake_image, load_or_export,
+                   preprocess_imagenet, run_imported, top5)
+
+
+def build_torch():
+    import torch.nn as nn
+
+    def conv_bn(cin, cout, stride, groups=1, k=3):
+        return nn.Sequential(
+            nn.Conv2d(cin, cout, k, stride, k // 2, groups=groups,
+                      bias=False),
+            nn.BatchNorm2d(cout), nn.ReLU6(True))
+
+    class InvRes(nn.Module):
+        def __init__(self, cin, cout, stride, expand):
+            super().__init__()
+            mid = cin * expand
+            layers = []
+            if expand != 1:
+                layers.append(conv_bn(cin, mid, 1, k=1))
+            layers += [conv_bn(mid, mid, stride, groups=mid),
+                       nn.Conv2d(mid, cout, 1, bias=False),
+                       nn.BatchNorm2d(cout)]
+            self.conv = nn.Sequential(*layers)
+            self.res = stride == 1 and cin == cout
+
+        def forward(self, x):
+            return x + self.conv(x) if self.res else self.conv(x)
+
+    import torch
+    cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+           (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+    layers = [conv_bn(3, 32, 2)]
+    cin = 32
+    for expand, cout, n, stride in cfg:
+        for i in range(n):
+            layers.append(InvRes(cin, cout, stride if i == 0 else 1, expand))
+            cin = cout
+    layers.append(conv_bn(320, 1280, 1, k=1))
+    return torch.nn.Sequential(
+        *layers, torch.nn.AdaptiveAvgPool2d(1), torch.nn.Flatten(),
+        torch.nn.Linear(1280, 1000))
+
+
+if __name__ == "__main__":
+    import torch
+    torch.manual_seed(0)
+    x = preprocess_imagenet(fake_image())
+    proto, tm = load_or_export("mobilenetv2", build_torch,
+                               torch.from_numpy(x))
+    (logits,) = run_imported(proto, [x])
+    print("top-5:")
+    top5(logits)
+    check_vs_torch(tm, [torch.from_numpy(x)], logits, name="mobilenetv2")

@@ -1,0 +1,67 @@
+"""ResNet18 ONNX import (ref examples/onnx/resnet18.py).
+
+Same pipeline as the reference (zoo resnet18-v1 .onnx -> singa backend ->
+classify); torch-built fallback with parity check when no real file is
+staged (zero egress).
+"""
+
+import numpy as np
+
+from utils import (check_vs_torch, fake_image, load_or_export,
+                   preprocess_imagenet, run_imported, top5)
+
+
+def build_torch():
+    import torch
+    import torch.nn as nn
+
+    class Basic(nn.Module):
+        def __init__(self, cin, cout, stride=1):
+            super().__init__()
+            self.c1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.b1 = nn.BatchNorm2d(cout)
+            self.c2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.b2 = nn.BatchNorm2d(cout)
+            self.down = None
+            if stride != 1 or cin != cout:
+                self.down = nn.Sequential(
+                    nn.Conv2d(cin, cout, 1, stride, bias=False),
+                    nn.BatchNorm2d(cout))
+
+        def forward(self, x):
+            idt = self.down(x) if self.down else x
+            y = torch.relu(self.b1(self.c1(x)))
+            return torch.relu(self.b2(self.c2(y)) + idt)
+
+    class ResNet18(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.stem = nn.Sequential(
+                nn.Conv2d(3, 64, 7, 2, 3, bias=False), nn.BatchNorm2d(64),
+                nn.ReLU(True), nn.MaxPool2d(3, 2, 1))
+            blocks = []
+            cin = 64
+            for cout, stride in [(64, 1), (64, 1), (128, 2), (128, 1),
+                                 (256, 2), (256, 1), (512, 2), (512, 1)]:
+                blocks.append(Basic(cin, cout, stride))
+                cin = cout
+            self.blocks = nn.Sequential(*blocks)
+            self.pool = nn.AdaptiveAvgPool2d(1)
+            self.fc = nn.Linear(512, 1000)
+
+        def forward(self, x):
+            y = self.pool(self.blocks(self.stem(x)))
+            return self.fc(torch.flatten(y, 1))
+
+    return ResNet18()
+
+
+if __name__ == "__main__":
+    import torch
+    torch.manual_seed(0)
+    x = preprocess_imagenet(fake_image())
+    proto, tm = load_or_export("resnet18", build_torch, torch.from_numpy(x))
+    (logits,) = run_imported(proto, [x])
+    print("top-5:")
+    top5(logits)
+    check_vs_torch(tm, [torch.from_numpy(x)], logits, name="resnet18")

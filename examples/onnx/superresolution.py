@@ -1,0 +1,32 @@
+"""Super-resolution ONNX import (ref examples/onnx/superresolution.py).
+
+ESPCN sub-pixel net: the PixelShuffle exports as DepthToSpace(CRD) —
+exercises that import path. Upscales a 224x224 luma channel 3x.
+"""
+
+import numpy as np
+
+from utils import check_vs_torch, fake_image, load_or_export, run_imported
+
+
+def build_torch():
+    import torch.nn as nn
+    return nn.Sequential(
+        nn.Conv2d(1, 64, 5, 1, 2), nn.ReLU(True),
+        nn.Conv2d(64, 64, 3, 1, 1), nn.ReLU(True),
+        nn.Conv2d(64, 32, 3, 1, 1), nn.ReLU(True),
+        nn.Conv2d(32, 9, 3, 1, 1),
+        nn.PixelShuffle(3))
+
+
+if __name__ == "__main__":
+    import torch
+    torch.manual_seed(0)
+    y = fake_image(224, 224)[:1][None]  # luma channel only
+    proto, tm = load_or_export("super_resolution", build_torch,
+                               torch.from_numpy(y))
+    (hi,) = run_imported(proto, [y])
+    assert hi.shape == (1, 1, 672, 672), hi.shape
+    print(f"upscaled {y.shape[-2:]} -> {hi.shape[-2:]}, "
+          f"range [{hi.min():.3f}, {hi.max():.3f}]")
+    check_vs_torch(tm, [torch.from_numpy(y)], hi, name="superres")

@@ -1,0 +1,78 @@
+"""Tiny-YOLOv2 ONNX import (ref examples/onnx/tiny_yolov2.py).
+
+The reference runs the zoo tinyyolov2 model on a 416x416 image and decodes
+the (1, 125, 13, 13) grid into boxes; this does the same through the
+singa_tpu backend, with the torch-built fallback when no real file exists.
+"""
+
+import numpy as np
+
+from utils import check_vs_torch, fake_image, load_or_export, run_imported
+
+ANCHORS = [(1.08, 1.19), (3.42, 4.41), (6.63, 11.38), (9.42, 5.11),
+           (16.62, 10.52)]
+VOC = ["aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car",
+       "cat", "chair", "cow", "diningtable", "dog", "horse", "motorbike",
+       "person", "pottedplant", "sheep", "sofa", "train", "tvmonitor"]
+
+
+def build_torch():
+    import torch.nn as nn
+
+    def block(cin, cout, pool_stride):
+        layers = [nn.Conv2d(cin, cout, 3, 1, 1, bias=False),
+                  nn.BatchNorm2d(cout), nn.LeakyReLU(0.1, True)]
+        if pool_stride == 1:
+            # darknet's stride-1 "same" maxpool keeps the 13x13 grid
+            layers += [nn.ZeroPad2d((0, 1, 0, 1)), nn.MaxPool2d(2, 1)]
+        elif pool_stride:
+            layers.append(nn.MaxPool2d(2, pool_stride))
+        return layers
+
+    import torch
+    layers = []
+    cin = 3
+    for cout, pool in [(16, 2), (32, 2), (64, 2), (128, 2), (256, 2),
+                       (512, 1), (1024, 0), (1024, 0)]:
+        layers += block(cin, cout, pool)
+        cin = cout
+    layers.append(nn.Conv2d(1024, 125, 1))  # 5 anchors * (5 + 20 classes)
+    return torch.nn.Sequential(*layers)
+
+
+def decode(grid, conf_thresh=0.25):
+    """(1, 125, 13, 13) -> [(score, cls, cx, cy, w, h)] (ref postprocess)."""
+    g = grid.reshape(5, 25, 13, 13)
+    boxes = []
+    for a, (aw, ah) in enumerate(ANCHORS):
+        tx, ty, tw, th, to = g[a, 0], g[a, 1], g[a, 2], g[a, 3], g[a, 4]
+        probs = np.exp(g[a, 5:] - g[a, 5:].max(0))
+        probs /= probs.sum(0)
+        obj = 1 / (1 + np.exp(-to))
+        score = obj * probs.max(0)
+        for cy in range(13):
+            for cx in range(13):
+                if score[cy, cx] > conf_thresh:
+                    bx = (cx + 1 / (1 + np.exp(-tx[cy, cx]))) * 32
+                    by = (cy + 1 / (1 + np.exp(-ty[cy, cx]))) * 32
+                    bw = aw * np.exp(tw[cy, cx]) * 32
+                    bh = ah * np.exp(th[cy, cx]) * 32
+                    boxes.append((float(score[cy, cx]),
+                                  VOC[int(probs[:, cy, cx].argmax())],
+                                  bx, by, bw, bh))
+    return sorted(boxes, reverse=True)
+
+
+if __name__ == "__main__":
+    import torch
+    torch.manual_seed(0)
+    x = fake_image(416, 416)[None] * 255.0  # zoo model takes raw 0-255
+    proto, tm = load_or_export("tinyyolov2", build_torch,
+                               torch.from_numpy(x))
+    (grid,) = run_imported(proto, [x])
+    assert grid.shape == (1, 125, 13, 13), grid.shape
+    boxes = decode(grid[0])
+    print(f"{len(boxes)} boxes above threshold; top 5:")
+    for s, c, bx, by, bw, bh in boxes[:5]:
+        print(f"  {c}: {s:.2f} at ({bx:.0f},{by:.0f}) {bw:.0f}x{bh:.0f}")
+    check_vs_torch(tm, [torch.from_numpy(x)], grid, name="tiny_yolov2")

@@ -1,0 +1,148 @@
+"""Re-train an imported ONNX model (ref examples/onnx/training/train.py).
+
+Pipeline parity with the reference: import a backbone .onnx, truncate its
+classifier (`last_layers=-1`), append a fresh Linear head, and train on
+CIFAR-10 with the full set of distributed options (fp32 / fp16 / partial /
+sparse top-K / sparse threshold). TPU redesign: the whole train step jits
+through Model.compile; DistOpt rides mesh collectives instead of NCCL.
+
+Usage:
+  python train.py                       # torch-built resnet18 backbone
+  python train.py --model /path/x.onnx  # a real model file
+  python train.py --dist fp16 --devices 8   # DP on the virtual CPU mesh
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                "cnn"))
+
+from utils import load_or_export, MODEL_DIR  # noqa: E402
+
+from singa_tpu import autograd, device, layer, opt, sonnx, tensor  # noqa: E402
+
+
+class MyModel(sonnx.SONNXModel):
+    """Imported backbone (minus its classifier) + fresh Linear head
+    (ref train.py:105-140)."""
+
+    def __init__(self, onnx_model, num_classes=10, last_layers=-1,
+                 device=None):
+        super().__init__(onnx_model, device=device)
+        self.last_layers = last_layers
+        self.dropout = layer.Dropout(0.2)
+        self.linear = layer.Linear(num_classes)
+
+    def forward(self, *x):
+        y = super().forward(*x, last_layers=self.last_layers)
+        if isinstance(y, (tuple, list)):
+            y = y[0]
+        if len(y.shape) > 2:
+            y = autograd.flatten(y, 1)
+        return self.linear(self.dropout(y))
+
+    def train_one_batch(self, x, y, dist_option="plain", spars=0.05):
+        out = self.forward(x)
+        loss = autograd.softmax_cross_entropy(out, y)
+        if dist_option in ("plain", "fp32"):
+            self.optimizer.backward_and_update(loss)
+        elif dist_option == "fp16":
+            self.optimizer.backward_and_update_half(loss)
+        elif dist_option == "partialUpdate":
+            self.optimizer.backward_and_partial_update(loss)
+        elif dist_option == "sparseTopK":
+            self.optimizer.backward_and_sparse_update(loss, topK=True,
+                                                      spars=spars)
+        elif dist_option == "sparseThreshold":
+            self.optimizer.backward_and_sparse_update(loss, topK=False,
+                                                      spars=spars)
+        return out, loss
+
+
+def accuracy(pred, target):
+    return (np.argmax(pred, axis=1) == target).sum()
+
+
+def build_backbone(args, dev):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if args.model and os.path.exists(args.model):
+        return sonnx.load_model(args.model)
+    resnet_dir = os.path.join(os.path.dirname(__file__), "..")
+    sys.path.insert(0, resnet_dir)
+    from resnet18 import build_torch
+    import torch
+    x = torch.randn(args.batch, 3, args.size, args.size)
+    proto, _ = load_or_export("resnet18_train", build_torch, x)
+    return proto
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default=None, help="path to a real .onnx")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--size", type=int, default=32,
+                   help="input resolution (ref resizes cifar to 224)")
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--dist", default="plain",
+                   choices=["plain", "fp32", "fp16", "partialUpdate",
+                            "sparseTopK", "sparseThreshold"])
+    p.add_argument("--devices", type=int, default=0,
+                   help="DP size (0 = single device)")
+    p.add_argument("--max-batches", type=int, default=0)
+    args = p.parse_args()
+
+    from data import cifar10
+    train_x, train_y, val_x, val_y = cifar10.load()
+    if args.size != 32:
+        # ref resize_dataset; nearest is fine for the demo
+        rep = args.size // 32
+        train_x = np.repeat(np.repeat(train_x, rep, 2), rep, 3)
+        val_x = np.repeat(np.repeat(val_x, rep, 2), rep, 3)
+
+    dev = device.best_device()
+    proto = build_backbone(args, dev)
+    m = MyModel(proto, num_classes=10, device=dev)
+
+    sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
+    mesh = None
+    if args.devices > 1:
+        from singa_tpu import parallel
+        mesh = parallel.data_parallel_mesh(args.devices)
+        sgd = opt.DistOpt(sgd, mesh=mesh)
+    m.set_optimizer(sgd)
+
+    tx = tensor.Tensor(data=train_x[:args.batch].astype(np.float32),
+                       device=dev)
+    ty = tensor.Tensor(data=train_y[:args.batch].astype(np.int32),
+                       device=dev)
+    m.compile([tx], is_train=True, use_graph=True)
+
+    n = len(train_x) // args.batch
+    if args.max_batches:
+        n = min(n, args.max_batches)
+    for ep in range(args.epochs):
+        idx = np.random.permutation(len(train_x))
+        tot_loss, tot_correct, seen = 0.0, 0, 0
+        for b in range(n):
+            sel = idx[b * args.batch:(b + 1) * args.batch]
+            bx = train_x[sel].astype(np.float32)
+            by = train_y[sel].astype(np.int32)
+            out, loss = m(tensor.Tensor(data=bx, device=dev),
+                          tensor.Tensor(data=by, device=dev),
+                          dist_option=args.dist)
+            tot_loss += float(loss.numpy())
+            tot_correct += accuracy(out.numpy(), by)
+            seen += len(sel)
+        print(f"epoch {ep}: loss {tot_loss / max(1, n):.4f} "
+              f"train-acc {tot_correct / max(1, seen):.4f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Shared helpers for the ONNX example zoo (ref examples/onnx/utils.py).
+
+The reference downloads pretrained .onnx files from the ONNX model zoo;
+this sandbox has zero egress, so each script (a) uses a real model file if
+one exists at the zoo path, else (b) builds the same architecture in torch
+with random weights and exports a genuine third-party .onnx to import.
+Either way the singa_tpu side of the pipeline — parse, build, run, match —
+is identical.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+
+# parity checks against torch need full fp32 accumulation; TPU matmuls
+# otherwise default to bf16 inputs
+jax.config.update("jax_default_matmul_precision", "highest")
+
+from singa_tpu import autograd, device, sonnx, tensor  # noqa: E402
+
+MODEL_DIR = os.environ.get("ONNX_MODEL_DIR", "/tmp/onnx-zoo")
+
+
+def model_path(name):
+    return os.path.join(MODEL_DIR, name + ".onnx")
+
+
+def torch_export(m, args, path, opset=13):
+    """Export a torch module to ONNX without the `onnx` pip package: the
+    exporter only imports it to inline onnxscript functions (none exist in
+    plain models), so stub that step out."""
+    import torch
+    try:  # private path moved across torch releases
+        from torch.onnx._internal.torchscript_exporter import \
+            onnx_proto_utils
+    except ImportError:
+        from torch.onnx._internal import onnx_proto_utils
+    orig = onnx_proto_utils._add_onnxscript_fn
+    onnx_proto_utils._add_onnxscript_fn = lambda b, co: b
+    try:
+        m.eval()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        torch.onnx.export(m, args, path, opset_version=opset, dynamo=False)
+    finally:
+        onnx_proto_utils._add_onnxscript_fn = orig
+    return path
+
+
+def load_or_export(name, build_torch, example, opset=13):
+    """Return (model_proto, torch_module_or_None). Uses a pre-downloaded
+    zoo file when present; otherwise exports `build_torch()` with random
+    weights so the import path still runs end-to-end."""
+    path = model_path(name)
+    if os.path.exists(path):
+        print(f"loading real model file {path}")
+        return sonnx.load_model(path), None
+    print(f"{path} not found; exporting torch-built {name} (random init)")
+    m = build_torch()
+    torch_export(m, example, path, opset=opset)
+    return sonnx.load_model(path), m
+
+
+def run_imported(model_proto, inputs, dev=None, n_out=None):
+    """Inference through the sonnx backend; returns numpy outputs."""
+    dev = dev or device.best_device()
+    rep = sonnx.prepare(model_proto, dev)
+    prev = autograd.training
+    autograd.training = False
+    try:
+        outs = rep.run([tensor.from_numpy(np.ascontiguousarray(x), device=dev)
+                        for x in inputs])
+    finally:
+        autograd.training = prev
+    outs = [np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+            for o in outs]
+    return outs[:n_out] if n_out else outs
+
+
+def check_vs_torch(m, torch_inputs, ours, rtol=1e-3, atol=1e-4, name=""):
+    """When the model was torch-built this run, verify the import end-to-end."""
+    if m is None:
+        return
+    import torch
+    with torch.no_grad():
+        ref = m(*torch_inputs)
+    if isinstance(ref, (tuple, list)):
+        ref = ref[0]
+    if hasattr(ref, "logits"):     # transformers output dataclass
+        ref = ref.logits
+    np.testing.assert_allclose(ours, ref.numpy(), rtol=rtol, atol=atol)
+    print(f"parity vs torch OK{' (' + name + ')' if name else ''} "
+          f"max|err|={np.abs(ours - ref.numpy()).max():.2e}")
+
+
+def fake_image(h=224, w=224, seed=0):
+    """Deterministic stand-in for the reference's downloaded kitten.jpg."""
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    img = np.stack([np.sin(yy / 17) * 0.5 + 0.5,
+                    np.cos(xx / 23) * 0.5 + 0.5,
+                    ((yy + xx) % 97) / 97.0]) \
+        + rng.rand(3, h, w).astype(np.float32) * 0.1
+    return np.clip(img, 0, 1)
+
+
+def preprocess_imagenet(img_chw):
+    """Reference preprocess (examples/onnx/vgg16.py:33-43): scale to [0,1],
+    normalize with ImageNet stats, add batch dim."""
+    mean = np.array([0.485, 0.456, 0.406], np.float32).reshape(3, 1, 1)
+    std = np.array([0.229, 0.224, 0.225], np.float32).reshape(3, 1, 1)
+    return ((img_chw - mean) / std)[None].astype(np.float32)
+
+
+def top5(logits, labels=None):
+    idx = np.argsort(logits.ravel())[::-1][:5]
+    for i in idx:
+        name = labels[i] if labels else f"class_{i}"
+        print(f"  {name}: {logits.ravel()[i]:.3f}")
+    return idx

@@ -1377,28 +1377,33 @@ def attention(q, k, v, causal=False, seq_axis=None):
 # TopK, LRN, ...). Forwards are jnp/lax; backward vjp-derived unless noted.
 
 
-class ArgMax(Operator):
+class _ArgReduce(Operator):
     never_requires_grad = True
+    _fn = None
 
     def __init__(self, axis=0, keepdims=True, select_last_index=False):
         super().__init__()
         self.axis, self.keepdims = int(axis), bool(keepdims)
+        self.last = bool(select_last_index)
 
     def forward(self, x):
-        y = jnp.argmax(x, axis=self.axis).astype(jnp.int64)
+        if self.last:
+            # ONNX select_last_index: ties resolve to the LAST occurrence
+            n = x.shape[self.axis]
+            y = n - 1 - type(self)._fn(jnp.flip(x, self.axis),
+                                       axis=self.axis)
+        else:
+            y = type(self)._fn(x, axis=self.axis)
+        y = y.astype(jnp.int64)
         return jnp.expand_dims(y, self.axis) if self.keepdims else y
 
 
-class ArgMin(Operator):
-    never_requires_grad = True
+class ArgMax(_ArgReduce):
+    _fn = staticmethod(jnp.argmax)
 
-    def __init__(self, axis=0, keepdims=True, select_last_index=False):
-        super().__init__()
-        self.axis, self.keepdims = int(axis), bool(keepdims)
 
-    def forward(self, x):
-        y = jnp.argmin(x, axis=self.axis).astype(jnp.int64)
-        return jnp.expand_dims(y, self.axis) if self.keepdims else y
+class ArgMin(_ArgReduce):
+    _fn = staticmethod(jnp.argmin)
 
 
 class _Reduce(Operator):

@@ -128,7 +128,12 @@ class _GRUScan(Operator):
 
         def body(h, xt):
             zx = xt @ Wx + b
-            zh = h @ Wh if rb is None else h @ Wh + rb
+            # lbr=0 recomputes the candidate's recurrent term from r*h, so
+            # only the r/u gate columns of Wh are needed up front
+            Whg = Wh if lbr else Wh[:, :2 * H]
+            zh = h @ Whg
+            if rb is not None:
+                zh = zh + (rb if lbr else rb[:2 * H])
             r = jax.nn.sigmoid(zx[..., :H] + zh[..., :H])
             u = jax.nn.sigmoid(zx[..., H:2 * H] + zh[..., H:2 * H])
             if lbr:

@@ -36,6 +36,9 @@ class SONNXModel(model_module.Model):
                 .replace(":", "_")
             self._register_state(attr, t)
 
-    def forward(self, *x):
-        outs = self.backend.run(list(x))
+    def forward(self, *x, last_layers=None):
+        """last_layers: stop after that many graph nodes (negative counts
+        from the end) and return that node's outputs — the reference's
+        truncated-backbone retraining hook (ref sonnx.py:2212)."""
+        outs = self.backend.run(list(x), last_layers=last_layers)
         return outs[0] if len(outs) == 1 else outs

@@ -173,8 +173,10 @@ class SingaBackend:
                 self.params[t.name] = p
 
     # -- execution ---------------------------------------------------------
-    def run(self, inputs, env=None):
-        """inputs: list of Tensors aligned with graph inputs (or dict)."""
+    def run(self, inputs, env=None, last_layers=None):
+        """inputs: list of Tensors aligned with graph inputs (or dict).
+        last_layers: execute only that many nodes (negative = from the
+        end) and return the last executed node's outputs."""
         env = dict(env or {})
         env.update(self.consts)
         env.update(self.params)
@@ -184,7 +186,17 @@ class SingaBackend:
         else:
             for name, t in zip(self.input_names, inputs):
                 env[name] = t
-        for node in self.nodes:
+        nodes = self.nodes
+        out_names = self.output_names
+        if last_layers is not None and last_layers != len(self.nodes):
+            if not -len(self.nodes) < last_layers <= len(self.nodes) \
+                    or last_layers == 0:
+                raise ValueError(
+                    f"last_layers={last_layers} out of range for a "
+                    f"{len(self.nodes)}-node graph")
+            nodes = self.nodes[:last_layers]
+            out_names = list(nodes[-1].outputs)
+        for node in nodes:
             fold = _NP_FOLD.get(node.op_type)
             if fold is not None and node.inputs and any(
                     nm for nm in node.inputs) and all(
@@ -204,7 +216,7 @@ class SingaBackend:
                 outs = (outs,)
             for name, v in zip(node.outputs, outs):
                 env[name] = v
-        return [env[n] for n in self.output_names]
+        return [env[n] for n in out_names]
 
     # -- helpers -----------------------------------------------------------
     def _t(self, env, name):
@@ -620,13 +632,15 @@ class SingaBackend:
     def op_ArgMax(self, node, env):
         return autograd.ArgMax(
             int(_attr(node.proto, "axis", 0)),
-            int(_attr(node.proto, "keepdims", 1)))(
+            int(_attr(node.proto, "keepdims", 1)),
+            int(_attr(node.proto, "select_last_index", 0)))(
             self._t(env, node.inputs[0]))
 
     def op_ArgMin(self, node, env):
         return autograd.ArgMin(
             int(_attr(node.proto, "axis", 0)),
-            int(_attr(node.proto, "keepdims", 1)))(
+            int(_attr(node.proto, "keepdims", 1)),
+            int(_attr(node.proto, "select_last_index", 0)))(
             self._t(env, node.inputs[0]))
 
     def op_LogSoftmax(self, node, env):
@@ -734,6 +748,13 @@ class SingaBackend:
         x = self._t(env, node.inputs[0])
         W = self._t(env, node.inputs[1])
         b = self._t(env, node.inputs[2]) if len(node.inputs) > 2 else None
+        auto_pad = _attr(node.proto, "auto_pad", "NOTSET")
+        if isinstance(auto_pad, bytes):
+            auto_pad = auto_pad.decode()
+        if auto_pad != "NOTSET" or "output_shape" in node.attrs:
+            raise NotImplementedError(
+                "ConvTranspose auto_pad/output_shape unsupported; "
+                "re-export with explicit pads")
         pads = _attr(node.proto, "pads", [0, 0, 0, 0])
         assert pads[0] == pads[2] and pads[1] == pads[3], \
             "asymmetric ConvTranspose pads unsupported"

@@ -36,7 +36,7 @@ _DT = {
     "float16": float16, "float32": float32, "float64": float64,
     "bfloat16": bfloat16, "int8": int8, "int32": int32, "int64": int64,
     "uint8": uint8, "char": int8, "float": float32, "double": float64,
-    "int": int32,
+    "int": int32, "bool": jnp.bool_,
 }
 
 

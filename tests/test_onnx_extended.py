@@ -347,3 +347,32 @@ def test_bidirectional_gru_runs(dev):
     y, yh = _run_graph([node], {"x": x}, n_outputs=2,
                        initializers=[("w", W), ("r", R)], dev=dev)
     assert y.shape == (S, 2, B, H) and yh.shape == (2, B, H)
+
+
+def test_argmax_select_last_index(dev):
+    x = np.array([[5.0, 5.0, 1.0]], np.float32)
+    node = pb.make_node("ArgMax", ["x"], ["y"], axis=1, keepdims=0,
+                        select_last_index=1)
+    (y,) = _run_graph([node], {"x": x}, dev=dev)
+    assert int(y[0]) == 1
+    node = pb.make_node("ArgMax", ["x"], ["y"], axis=1, keepdims=0)
+    (y,) = _run_graph([node], {"x": x}, dev=dev)
+    assert int(y[0]) == 0
+
+
+def test_last_layers_bounds(dev):
+    from singa_tpu import sonnx
+    node = pb.make_node("Relu", ["x"], ["y"])
+    graph = pb.GraphProto(
+        name="g", node=[node], initializer=[],
+        input=[pb.make_value_info("x", pb.TensorProto.FLOAT, (2,))],
+        output=[pb.make_value_info("y", pb.TensorProto.FLOAT, (2,))])
+    m = pb.ModelProto(ir_version=8, producer_name="t", graph=graph,
+                      opset_import=[pb.OperatorSetIdProto(domain="",
+                                                          version=13)])
+    rep = sonnx.prepare(m, dev)
+    x = tensor.from_numpy(np.ones(2, np.float32), device=dev)
+    with pytest.raises(ValueError, match="last_layers"):
+        rep.backend.run([x], last_layers=0)
+    with pytest.raises(ValueError, match="last_layers"):
+        rep.backend.run([x], last_layers=-5)

@@ -140,3 +140,33 @@ def test_backend_handcrafted_graph(dev):
     out = rep.run([tensor.from_numpy(x, device=dev)])[0]
     np.testing.assert_allclose(out.numpy(), np.maximum(x @ W + b, 0),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_sonnx_model_last_layers(dev):
+    """Truncated-backbone hook: last_layers=-1 returns the penultimate
+    node's output (ref sonnx.py:2212 retraining pattern)."""
+    import numpy as np
+    from singa_tpu.sonnx import onnx_pb as pb
+
+    w1 = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    w2 = np.random.RandomState(1).randn(8, 3).astype(np.float32)
+    nodes = [pb.make_node("MatMul", ["x", "w1"], ["h"]),
+             pb.make_node("Relu", ["h"], ["hr"]),
+             pb.make_node("MatMul", ["hr", "w2"], ["y"])]
+    graph = pb.GraphProto(
+        name="g", node=nodes,
+        initializer=[pb.numpy_to_tensor(w1, "w1"),
+                     pb.numpy_to_tensor(w2, "w2")],
+        input=[pb.make_value_info("x", pb.TensorProto.FLOAT, (2, 4))],
+        output=[pb.make_value_info("y", pb.TensorProto.FLOAT, (2, 3))])
+    m = pb.ModelProto(ir_version=8, producer_name="t", graph=graph,
+                      opset_import=[pb.OperatorSetIdProto(domain="",
+                                                          version=13)])
+    x = np.random.RandomState(2).randn(2, 4).astype(np.float32)
+    sm = sonnx.SONNXModel(m, device=dev)
+    full = sm.forward(tensor.from_numpy(x, device=dev))
+    trunc = sm.forward(tensor.from_numpy(x, device=dev), last_layers=-1)
+    np.testing.assert_allclose(np.asarray(full.numpy()),
+                               np.maximum(x @ w1, 0) @ w2, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(trunc.numpy()),
+                               np.maximum(x @ w1, 0), rtol=1e-5)
