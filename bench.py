@@ -58,7 +58,15 @@ def main():
                         "distribution")
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--amp", action="store_true", default=None,
+                   help="mixed precision: bf16 compute, fp32 master "
+                        "weights (compile(amp='bfloat16')). Default: on "
+                        "for conv models (the canonical TPU training "
+                        "mode), off for gpt (flash kernel is fp32-tuned)")
+    p.add_argument("--no-amp", dest="amp", action="store_false")
     args = p.parse_args()
+    if args.amp is None:
+        args.amp = args.model != "gpt"
 
     import numpy as np
     import jax
@@ -97,7 +105,8 @@ def main():
 
     sgd = opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5)
     m.set_optimizer(sgd)
-    m.compile([tx], is_train=True, use_graph=True)
+    m.compile([tx], is_train=True, use_graph=True,
+              amp="bfloat16" if args.amp else None)
 
     # Always run >=1 untimed step: compiles the graph and guarantees
     # out/loss exist for the fence below even with --warmup 0.
@@ -167,7 +176,8 @@ def main():
 
     rec = {
         "metric": f"{args.model}_train_throughput_b{args.batch}_s{args.size}"
-                  f"_{args.dtype}" + ("_cpu" if on_cpu else ""),
+                  f"_{args.dtype}" + ("_amp_bf16" if args.amp else "")
+                  + ("_cpu" if on_cpu else ""),
         "value": round(value, 2),
         "unit": unit,
         "vs_baseline": round(vs, 3),

@@ -22,7 +22,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
                                 "cnn"))
 
-from utils import load_or_export, MODEL_DIR  # noqa: E402
+from utils import load_or_export  # noqa: E402
 
 from singa_tpu import autograd, device, layer, opt, sonnx, tensor  # noqa: E402
 
@@ -68,13 +68,10 @@ def accuracy(pred, target):
     return (np.argmax(pred, axis=1) == target).sum()
 
 
-def build_backbone(args, dev):
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+def build_backbone(args):
     if args.model and os.path.exists(args.model):
         return sonnx.load_model(args.model)
-    resnet_dir = os.path.join(os.path.dirname(__file__), "..")
-    sys.path.insert(0, resnet_dir)
-    from resnet18 import build_torch
+    from resnet18 import build_torch  # via the '..' path insert above
     import torch
     x = torch.randn(args.batch, 3, args.size, args.size)
     proto, _ = load_or_export("resnet18_train", build_torch, x)
@@ -101,20 +98,25 @@ def main():
     train_x, train_y, val_x, val_y = cifar10.load()
     if args.size != 32:
         # ref resize_dataset; nearest is fine for the demo
+        assert args.size % 32 == 0, \
+            f"--size must be a multiple of 32, got {args.size}"
         rep = args.size // 32
         train_x = np.repeat(np.repeat(train_x, rep, 2), rep, 3)
         val_x = np.repeat(np.repeat(val_x, rep, 2), rep, 3)
 
     dev = device.best_device()
-    proto = build_backbone(args, dev)
+    proto = build_backbone(args)
     m = MyModel(proto, num_classes=10, device=dev)
 
     sgd = opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5)
-    mesh = None
     if args.devices > 1:
         from singa_tpu import parallel
-        mesh = parallel.data_parallel_mesh(args.devices)
-        sgd = opt.DistOpt(sgd, mesh=mesh)
+        sgd = opt.DistOpt(sgd,
+                          mesh=parallel.data_parallel_mesh(args.devices))
+    elif args.dist not in ("plain", "fp32"):
+        # the fp16/partial/sparse strategies live on DistOpt; it degrades
+        # to world_size=1 identity collectives without a mesh
+        sgd = opt.DistOpt(sgd)
     m.set_optimizer(sgd)
 
     tx = tensor.Tensor(data=train_x[:args.batch].astype(np.float32),

@@ -30,25 +30,7 @@ def model_path(name):
     return os.path.join(MODEL_DIR, name + ".onnx")
 
 
-def torch_export(m, args, path, opset=13):
-    """Export a torch module to ONNX without the `onnx` pip package: the
-    exporter only imports it to inline onnxscript functions (none exist in
-    plain models), so stub that step out."""
-    import torch
-    try:  # private path moved across torch releases
-        from torch.onnx._internal.torchscript_exporter import \
-            onnx_proto_utils
-    except ImportError:
-        from torch.onnx._internal import onnx_proto_utils
-    orig = onnx_proto_utils._add_onnxscript_fn
-    onnx_proto_utils._add_onnxscript_fn = lambda b, co: b
-    try:
-        m.eval()
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        torch.onnx.export(m, args, path, opset_version=opset, dynamo=False)
-    finally:
-        onnx_proto_utils._add_onnxscript_fn = orig
-    return path
+from singa_tpu.sonnx.interop import export_torch_module as torch_export  # noqa: E402,F401
 
 
 def load_or_export(name, build_torch, example, opset=13):

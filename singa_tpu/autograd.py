@@ -865,6 +865,8 @@ class SoftMaxCrossEntropy(Operator):
         self._cache = None
 
     def forward(self, x, t):
+        self._in_dtype = x.dtype
+        x = x.astype(jnp.float32)  # fp32 island under bf16 compute policy
         self._cache = (x, t)
         return jnp.mean(tensor_module.softmax_cross_entropy_fwd(x, t))
 
@@ -874,7 +876,7 @@ class SoftMaxCrossEntropy(Operator):
         # scale is prod(x.shape[:-1]), not just the batch dim
         n = int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1
         dx = tensor_module.softmax_cross_entropy_bwd(x, t) * (dy / n)
-        return dx, None  # no grad for targets
+        return dx.astype(self._in_dtype), None  # no grad for targets
 
 
 # ---- NN ops (handle-backed in the reference, §2.6) -----------------------
@@ -921,11 +923,13 @@ class _BatchNorm2d(Operator):
 
     def forward(self, x, gamma, beta):
         axes = (0, 2, 3) if x.ndim == 4 else (0,)
-        m = jnp.mean(x, axis=axes)
-        v = jnp.var(x, axis=axes)
+        xf = x.astype(jnp.float32)  # fp32 island under bf16 compute policy
+        m = jnp.mean(xf, axis=axes)
+        v = jnp.var(xf, axis=axes)
         shape = (1, -1, 1, 1) if x.ndim == 4 else (1, -1)
-        xn = (x - m.reshape(shape)) * lax.rsqrt(v.reshape(shape) + self.eps)
-        return xn * gamma.reshape(shape) + beta.reshape(shape)
+        xn = (xf - m.reshape(shape)) * lax.rsqrt(v.reshape(shape) + self.eps)
+        return (xn * gamma.reshape(shape)
+                + beta.reshape(shape)).astype(x.dtype)
 
 
 class _BatchNorm2dInfer(Operator):
@@ -1017,9 +1021,13 @@ class LayerNorm(Operator):
         self.eps = eps
 
     def forward(self, x, gamma, beta):
-        m = jnp.mean(x, axis=-1, keepdims=True)
-        v = jnp.var(x, axis=-1, keepdims=True)
-        return (x - m) * lax.rsqrt(v + self.eps) * gamma + beta
+        # fp32 island under the bf16 compute policy: variance in low
+        # precision is catastrophically lossy
+        xf = x.astype(jnp.float32)
+        m = jnp.mean(xf, axis=-1, keepdims=True)
+        v = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - m) * lax.rsqrt(v + self.eps) * gamma + beta
+        return y.astype(x.dtype)
 
 
 class Gelu(Operator):
@@ -1318,7 +1326,7 @@ def batchnorm_2d(x, gamma, beta, running_mean, running_var, momentum=0.9,
         op._bn_extras = (running_mean, running_var)
         op._bn_momentum = momentum
         y = op(x, gamma, beta)
-        xd = lax.stop_gradient(x.data)
+        xd = lax.stop_gradient(x.data).astype(running_mean.data.dtype)
         axes = (0, 2, 3) if xd.ndim == 4 else (0,)
         bm = jnp.mean(xd, axis=axes)
         bv = jnp.var(xd, axis=axes)
@@ -1781,3 +1789,45 @@ def conv_transpose2d(x, W, b=None, stride=(1, 1), padding=(0, 0),
                      output_padding=(0, 0), dilation=(1, 1), group=1):
     op = _ConvTranspose2d(stride, padding, output_padding, dilation, group)
     return op(x, W, b) if b is not None else op(x, W)
+
+
+# ======================= mixed-precision policy ============================
+# bf16 compute + fp32 master weights (VERDICT r1 #14). Parameters stay
+# fp32 (optimizer updates, checkpoints); layers cast activations/weights to
+# `compute_dtype` at matmul/conv boundaries through a DIFFERENTIABLE cast,
+# so the cotangent is cast back on the way up and the master weight's grad
+# arrives fp32. Normalizations/losses upcast internally (see LayerNorm /
+# _BatchNorm2d / SoftMaxCrossEntropy). Enable via Model.compile(amp=...).
+
+compute_dtype = None
+
+
+class ComputeCast(Operator):
+    """Float->float cast that participates in the tape (unlike Cast, which
+    is for ONNX integer casts and never carries grad)."""
+
+    def __init__(self, to):
+        super().__init__()
+        self.to = to
+
+    def forward(self, x):
+        self._orig = x.dtype
+        return x.astype(self.to)
+
+    def backward(self, dy):
+        return dy.astype(self._orig)
+
+
+def compute_cast(*xs):
+    """Cast float Tensors to the active compute dtype (no-op when the
+    policy is off or dtypes already match)."""
+    if compute_dtype is None:
+        return xs if len(xs) > 1 else xs[0]
+    tgt = jnp.dtype(compute_dtype)
+    out = []
+    for x in xs:
+        if x is not None and jnp.issubdtype(x.data.dtype, jnp.floating) \
+                and x.data.dtype != tgt:
+            x = ComputeCast(tgt)(x)
+        out.append(x)
+    return tuple(out) if len(out) > 1 else out[0]

@@ -199,11 +199,13 @@ class Linear(Layer):
         tp = self.tp_axis is not None and autograd.axis_bound(self.tp_axis)
         if tp and self.tp_mode == "column":
             x = autograd.tp_copy(x, self.tp_axis)
-        y = autograd.matmul(x, self.W)
+        b = self.b if self.bias else None
+        x, W, b = autograd.compute_cast(x, self.W, b)
+        y = autograd.matmul(x, W)
         if tp and self.tp_mode == "row":
             y = autograd.tp_reduce(y, self.tp_axis)
-        if self.bias:
-            y = autograd.add_bias(y, self.b, axis=0)
+        if b is not None:
+            y = autograd.add_bias(y, b, axis=0)
         return y
 
 
@@ -258,7 +260,8 @@ class Embedding(Layer):
         self._register_param("W", W)
 
     def forward(self, x):
-        return autograd.embedding(x, self.W)
+        # cast AFTER the lookup: (B,S,D) activations, not the (V,D) table
+        return autograd.compute_cast(autograd.embedding(x, self.W))
 
 
 class _ConvGeometry:
@@ -340,8 +343,9 @@ class Conv2d(Layer):
                                     odd, self.dilation)
 
     def forward(self, x):
-        y = autograd.conv2d(self.handle, x, self.W,
-                            self.b if self.bias else None)
+        b = self.b if self.bias else None
+        x, W, b = autograd.compute_cast(x, self.W, b)
+        y = autograd.conv2d(self.handle, x, W, b)
         if self.activation in ("RELU", "relu"):
             y = autograd.relu(y)
         return y
@@ -641,14 +645,16 @@ class MultiHeadAttention(Layer):
                 f"{heads} heads not divisible by tp={tp_size}"
             heads //= tp_size
             x = autograd.tp_copy(x, self.tp_axis)
-        q = self._split(autograd.matmul(x, self.Wq), B, S, heads)
-        k = self._split(autograd.matmul(x, self.Wk), B, S, heads)
-        v = self._split(autograd.matmul(x, self.Wv), B, S, heads)
+        x, Wq, Wk, Wv, Wo = autograd.compute_cast(
+            x, self.Wq, self.Wk, self.Wv, self.Wo)
+        q = self._split(autograd.matmul(x, Wq), B, S, heads)
+        k = self._split(autograd.matmul(x, Wk), B, S, heads)
+        v = self._split(autograd.matmul(x, Wv), B, S, heads)
         o = autograd.attention(q, k, v, causal=self.causal,
                                seq_axis=self.seq_axis)
         o = autograd.transpose(o, (0, 2, 1, 3))
         o = autograd.reshape(o, (B, S, -1))
-        y = autograd.matmul(o, self.Wo)
+        y = autograd.matmul(o, Wo)
         if tp:
             y = autograd.tp_reduce(y, self.tp_axis)
         return y

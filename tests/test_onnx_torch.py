@@ -19,22 +19,11 @@ from singa_tpu import autograd, sonnx, tensor  # noqa: E402
 
 
 def _export(m, args, path, opset=13):
-    try:  # private path moved across torch releases (2.9 shown; 2.x varies)
-        from torch.onnx._internal.torchscript_exporter import \
-            onnx_proto_utils
-    except ImportError:
-        try:
-            from torch.onnx._internal import onnx_proto_utils
-        except ImportError:
-            pytest.skip("torch internal exporter layout unknown")
-    orig = onnx_proto_utils._add_onnxscript_fn
-    onnx_proto_utils._add_onnxscript_fn = lambda b, co: b
+    from singa_tpu.sonnx.interop import export_torch_module
     try:
-        m.eval()
-        torch.onnx.export(m, args, str(path), opset_version=opset,
-                          dynamo=False)
-    finally:
-        onnx_proto_utils._add_onnxscript_fn = orig
+        export_torch_module(m, args, str(path), opset=opset)
+    except ImportError:
+        pytest.skip("torch internal exporter layout unknown")
 
 
 def _import_run(path, x_np, dev, n_out=1):
