@@ -40,8 +40,9 @@ def run(args):
     dev.SetRandSeed(0)
     np.random.seed(0)
 
-    from data import mnist, cifar10, cifar100
-    loader = {"mnist": mnist, "cifar10": cifar10, "cifar100": cifar100}
+    from data import mnist, cifar10, cifar100, digits
+    loader = {"mnist": mnist, "cifar10": cifar10, "cifar100": cifar100,
+              "digits": digits}
     train_x, train_y, val_x, val_y = loader[args.data].load()
 
     num_channels = train_x.shape[1]
@@ -117,7 +118,8 @@ if __name__ == "__main__":
     p.add_argument("model", choices=["cnn", "mlp", "alexnet", "resnet",
                                      "resnet18", "resnet50", "xceptionnet"],
                    default="cnn", nargs="?")
-    p.add_argument("data", choices=["mnist", "cifar10", "cifar100"],
+    p.add_argument("data", choices=["mnist", "cifar10", "cifar100",
+                                    "digits"],
                    default="mnist", nargs="?")
     p.add_argument("--epochs", "-m", type=int, default=10)
     p.add_argument("--batch", "-b", type=int, default=64)
