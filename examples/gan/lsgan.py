@@ -1,9 +1,153 @@
-"""Least-squares GAN (ref examples/gan/lsgan.py): vanilla.py with the
-MSE adversarial loss."""
+"""LSGAN (ref examples/gan/lsgan.py + model/lsgan_mlp.py): least-squares
+adversarial losses, k discriminator steps per generator step, periodic
+sample dumps. A full model file (not a flag on vanilla.py): generator maps
+noise->image through two hidden layers; discriminator mirrors it; both
+train with MSE targets (real=1, fake=0 for D; fake=1 for G)."""
 
+import argparse
+import os
 import sys
 
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from singa_tpu import autograd, device, layer, opt, tensor  # noqa: E402
+
+
+class Generator(layer.Layer):
+    def __init__(self, feature_size=784, hidden_size=128):
+        super().__init__()
+        self.fc1 = layer.Linear(hidden_size)
+        self.fc2 = layer.Linear(hidden_size)
+        self.out = layer.Linear(feature_size)
+
+    def forward(self, z):
+        h = autograd.relu(self.fc1(z))
+        h = autograd.relu(self.fc2(h))
+        return autograd.tanh(self.out(h))
+
+
+class Discriminator(layer.Layer):
+    def __init__(self, hidden_size=128):
+        super().__init__()
+        self.fc1 = layer.Linear(hidden_size)
+        self.fc2 = layer.Linear(hidden_size)
+        self.out = layer.Linear(1)
+
+    def forward(self, x):
+        h = autograd.relu(self.fc1(x))
+        h = autograd.relu(self.fc2(h))
+        return self.out(h)  # raw score; LSGAN regresses it to 0/1
+
+
+class LSGAN:
+    """ref lsgan.py:33: hyperparameters + train loop in one object."""
+
+    def __init__(self, dev, rows=28, cols=28, channels=1, noise_size=100,
+                 hidden_size=128, batch=128, interval=200,
+                 learning_rate=1e-3, iterations=1000, d_steps=3, g_steps=1,
+                 file_dir="lsgan_images/"):
+        self.dev = dev
+        self.feature_size = rows * cols * channels
+        self.rows, self.cols = rows, cols
+        self.noise_size = noise_size
+        self.batch_size = batch // 2
+        self.interval = interval
+        self.iterations = iterations
+        self.d_steps = d_steps
+        self.g_steps = g_steps
+        self.file_dir = file_dir
+        self.G = Generator(self.feature_size, hidden_size)
+        self.D = Discriminator(hidden_size)
+        self.g_opt = opt.SGD(lr=learning_rate, momentum=0.5)
+        self.d_opt = opt.SGD(lr=learning_rate, momentum=0.5)
+
+    def _mse(self, pred, target_val):
+        t = tensor.Tensor(data=np.full((pred.shape[0], 1), target_val,
+                                       np.float32), device=self.dev,
+                          requires_grad=False)
+        return autograd.mse_loss(pred, t)
+
+    def _step(self, params, loss, optimizer):
+        ids = {id(p) for p in params}
+        for p, g in autograd.backward(loss):
+            if id(p) in ids:
+                optimizer.apply(p, g)
+        optimizer.step()
+
+    def train(self, train_x):
+        autograd.training = True
+        rng = np.random.RandomState(0)
+        d_loss = g_loss = None
+        for it in range(self.iterations):
+            for _ in range(self.d_steps):
+                real = train_x[rng.randint(0, len(train_x),
+                                           self.batch_size)]
+                z = rng.standard_normal(
+                    (self.batch_size, self.noise_size)).astype(np.float32)
+                t_real = tensor.Tensor(data=real, device=self.dev,
+                                       requires_grad=False)
+                t_z = tensor.Tensor(data=z, device=self.dev,
+                                    requires_grad=False)
+                # detach: only D's params should see this backward
+                # (same pattern as vanilla.py:81-85)
+                fake = self.G.forward(t_z)
+                fake = tensor.Tensor(data=fake.data, device=self.dev,
+                                     requires_grad=False)
+                d_loss = autograd.add(
+                    self._mse(self.D.forward(t_real), 1.0),
+                    self._mse(self.D.forward(fake), 0.0))
+                self._step(self.D.get_params().values(), d_loss,
+                           self.d_opt)
+            for _ in range(self.g_steps):
+                z = rng.standard_normal(
+                    (self.batch_size, self.noise_size)).astype(np.float32)
+                t_z = tensor.Tensor(data=z, device=self.dev,
+                                    requires_grad=False)
+                g_loss = self._mse(self.D.forward(self.G.forward(t_z)), 1.0)
+                self._step(self.G.get_params().values(), g_loss,
+                           self.g_opt)
+            if it % self.interval == 0:
+                fmt = lambda v: ("n/a" if v is None  # noqa: E731
+                                 else f"{float(v.numpy()):.4f}")
+                print(f"iter {it}: d_loss={fmt(d_loss)} "
+                      f"g_loss={fmt(g_loss)}", flush=True)
+                self.save_image(it)
+
+    def save_image(self, iteration):
+        """ref lsgan.py:132 dumps a PNG grid; with no PIL/matplotlib
+        guarantee we dump the raw sample grid as .npy."""
+        os.makedirs(self.file_dir, exist_ok=True)
+        z = np.random.RandomState(iteration).standard_normal(
+            (16, self.noise_size)).astype(np.float32)
+        imgs = self.G.forward(
+            tensor.Tensor(data=z, device=self.dev, requires_grad=False))
+        grid = np.asarray(imgs.numpy()).reshape(16, self.rows, self.cols)
+        np.save(os.path.join(self.file_dir, f"samples_{iteration}.npy"),
+                grid)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iterations", type=int, default=600)
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--d-steps", type=int, default=3)
+    p.add_argument("--g-steps", type=int, default=1)
+    args = p.parse_args()
+
+    dev = device.best_device()
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "cnn"))
+    from data import mnist
+    train_x, _, _, _ = mnist.load()
+    train_x = (train_x.reshape(len(train_x), -1).astype(np.float32)
+               * 2.0 - 1.0)  # tanh range
+
+    gan = LSGAN(dev, batch=args.batch, iterations=args.iterations,
+                d_steps=args.d_steps, g_steps=args.g_steps)
+    # param init needs one concrete forward
+    gan.train(train_x)
+
+
 if __name__ == "__main__":
-    sys.argv.append("--lsgan")
-    import vanilla
-    vanilla.main()
+    main()

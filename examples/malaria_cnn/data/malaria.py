@@ -1,0 +1,74 @@
+"""Malaria cell-image loader (ref examples/malaria_cnn/data/malaria.py).
+
+Reads the NIH malaria dataset layout (training_set/{Parasitized,
+Uninfected}/ image files) from /tmp/malaria or ~/data/malaria; with no
+dataset on disk (zero-egress sandbox) falls back to a deterministic
+synthetic set: "parasitized" cells are blobs with dark inclusions,
+"uninfected" are clean blobs — a learnable 2-class problem with the same
+shapes as the real data.
+"""
+
+import os
+
+import numpy as np
+
+SEARCH_DIRS = ["/tmp/malaria", os.path.expanduser("~/data/malaria")]
+
+
+def _real_dir():
+    for d in SEARCH_DIRS:
+        if os.path.isdir(os.path.join(d, "training_set", "Parasitized")):
+            return d
+    return None
+
+
+def _load_real(dir_path, resize=(128, 128)):
+    from PIL import Image
+    xs, ys = [], []
+    for label, sub in ((1, "Parasitized"), (0, "Uninfected")):
+        p = os.path.join(dir_path, "training_set", sub)
+        for f in sorted(os.listdir(p)):
+            if not f.lower().endswith((".png", ".jpg", ".jpeg")):
+                continue
+            img = Image.open(os.path.join(p, f)).resize(resize)
+            xs.append(np.rollaxis(np.asarray(img, np.float32)[..., :3],
+                                  2, 0) / 255.0)
+            ys.append(label)
+    x = np.stack(xs)
+    y = np.asarray(ys, np.int32)
+    return x, y
+
+
+def synthetic(n=600, size=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = np.zeros((n, 3, size, size), np.float32)
+    y = rng.randint(0, 2, n).astype(np.int32)
+    yy, xx = np.mgrid[0:size, 0:size]
+    for i in range(n):
+        cy, cx = rng.randint(size // 4, 3 * size // 4, 2)
+        r = rng.randint(size // 5, size // 3)
+        cell = ((yy - cy) ** 2 + (xx - cx) ** 2) < r * r
+        x[i, 0][cell] = 0.8
+        x[i, 1][cell] = 0.5
+        x[i, 2][cell] = 0.6
+        if y[i]:  # parasite inclusion: small dark dot inside the cell
+            py, px = cy + rng.randint(-r // 2, r // 2), \
+                cx + rng.randint(-r // 2, r // 2)
+            dot = ((yy - py) ** 2 + (xx - px) ** 2) < max(2, r // 4) ** 2
+            x[i, :, dot] = 0.15
+        x[i] += rng.rand(3, size, size).astype(np.float32) * 0.05
+    return x, y
+
+
+def load(val_frac=0.2, seed=0):
+    d = _real_dir()
+    if d is not None:
+        x, y = _load_real(d)
+    else:
+        print("malaria: dataset not found on disk; using synthetic cells")
+        x, y = synthetic()
+    rng = np.random.RandomState(seed)
+    idx = rng.permutation(len(x))
+    x, y = x[idx], y[idx]
+    n_val = int(len(x) * val_frac)
+    return x[:-n_val], y[:-n_val], x[-n_val:], y[-n_val:]

@@ -1,0 +1,46 @@
+"""Malaria CNN (ref examples/malaria_cnn/model/cnn.py): three conv+pool
+stages, two linear layers."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                ".."))
+
+from singa_tpu import layer, model  # noqa: E402
+
+
+class CNN(model.Model):
+    def __init__(self, num_classes=2, num_channels=3):
+        super().__init__()
+        self.num_classes = num_classes
+        self.dimension = 4
+        self.conv1 = layer.Conv2d(num_channels, 32, 3, padding=0,
+                                  activation="RELU")
+        self.conv2 = layer.Conv2d(32, 64, 3, padding=0, activation="RELU")
+        self.conv3 = layer.Conv2d(64, 64, 3, padding=0, activation="RELU")
+        self.pooling1 = layer.MaxPool2d(2, 2, padding=0)
+        self.pooling2 = layer.MaxPool2d(2, 2, padding=0)
+        self.pooling3 = layer.MaxPool2d(2, 2, padding=0)
+        self.flatten = layer.Flatten()
+        self.linear1 = layer.Linear(128)
+        self.relu = layer.ReLU()
+        self.linear2 = layer.Linear(num_classes)
+        self.softmax_cross_entropy = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        y = self.pooling1(self.conv1(x))
+        y = self.pooling2(self.conv2(y))
+        y = self.pooling3(self.conv3(y))
+        y = self.relu(self.linear1(self.flatten(y)))
+        return self.linear2(y)
+
+    def train_one_batch(self, x, y, dist_option="plain", spars=None):
+        out = self.forward(x)
+        loss = self.softmax_cross_entropy(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def create_model(**kwargs):
+    return CNN(**kwargs)

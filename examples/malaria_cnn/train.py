@@ -1,0 +1,76 @@
+"""Train the malaria CNN (ref examples/malaria_cnn/train_cnn.py / run.sh).
+
+Usage: python train.py --epochs 10
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from singa_tpu import device, opt, tensor  # noqa: E402
+
+from data import malaria  # noqa: E402
+from model import cnn  # noqa: E402
+
+
+def accuracy(pred, target):
+    return int((np.argmax(pred, axis=1) == target).sum())
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.005)
+    args = p.parse_args()
+
+    dev = device.best_device()
+    dev.SetRandSeed(0)
+    np.random.seed(0)
+    train_x, train_y, val_x, val_y = malaria.load()
+
+    m = cnn.create_model(num_classes=int(train_y.max()) + 1,
+                         num_channels=train_x.shape[1])
+    m.set_optimizer(opt.SGD(lr=args.lr, momentum=0.9, weight_decay=1e-5))
+
+    bs = args.batch
+    tx = tensor.Tensor(data=train_x[:bs].astype(np.float32), device=dev)
+    ty = tensor.from_numpy(train_y[:bs], device=dev)
+    m.compile([tx], is_train=True, use_graph=True)
+
+    n_train, n_val = len(train_x) // bs, len(val_x) // bs
+    idx = np.arange(len(train_x))
+    for ep in range(args.epochs):
+        t0 = time.time()
+        np.random.shuffle(idx)
+        m.train()
+        correct, loss_sum = 0, 0.0
+        for b in range(n_train):
+            sel = idx[b * bs:(b + 1) * bs]
+            tx.copy_from_numpy(train_x[sel].astype(np.float32))
+            ty.copy_from_numpy(train_y[sel])
+            out, loss = m(tx, ty)
+            correct += accuracy(out.numpy(), train_y[sel])
+            loss_sum += float(loss.numpy())
+        print(f"epoch {ep}: loss={loss_sum / n_train:.4f} "
+              f"acc={correct / (n_train * bs):.4f} "
+              f"time={time.time() - t0:.1f}s", flush=True)
+        m.eval()
+        correct = 0
+        for b in range(n_val):
+            tx.copy_from_numpy(val_x[b * bs:(b + 1) * bs].astype(np.float32))
+            out = m(tx)
+            correct += accuracy(out.numpy(), val_y[b * bs:(b + 1) * bs])
+        print(f"epoch {ep}: eval acc={correct / (n_val * bs):.4f}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

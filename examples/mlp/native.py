@@ -1,0 +1,75 @@
+"""MLP on raw autograd — no Layer/Model API (ref examples/mlp/native.py).
+
+Weights are bare Tensors with requires_grad/stores_grad; the train loop
+drives autograd.backward and opt.SGD.apply directly. Demonstrates the
+lowest API layer the reference exposes, on the same 2-class linear
+boundary task.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from singa_tpu import autograd, device, opt, tensor  # noqa: E402
+from singa_tpu.tensor import Tensor  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("-p", choices=["float32", "float16"], default="float32",
+                   dest="precision")
+    p.add_argument("-m", "--max-epoch", default=600, type=int,
+                   dest="max_epoch")
+    args = p.parse_args()
+
+    np.random.seed(0)
+    autograd.training = True
+
+    # training data: points around the boundary y = 5x + 1 (ref :52-64)
+    f = lambda x: (5 * x + 1)  # noqa: E731
+    x = np.random.uniform(-1, 1, 400)
+    y = f(x) + 2 * np.random.randn(len(x))
+    label = np.asarray([5 * a + 1 > b for (a, b) in zip(x, y)],
+                       np.int32)
+    data = np.array(list(zip(x, y)), dtype=np.float32)
+
+    dev = device.best_device()
+    inputs = Tensor(data=data, device=dev, dtype=args.precision)
+    target = tensor.from_numpy(label, device=dev)
+
+    # bare parameter tensors (ref :98-126)
+    w0 = Tensor(data=np.random.normal(0, 0.1, (2, 3)).astype(np.float32),
+                device=dev, dtype=args.precision, requires_grad=True,
+                stores_grad=True)
+    b0 = Tensor(shape=(3,), device=dev, dtype=args.precision,
+                requires_grad=True, stores_grad=True)
+    b0.set_value(0.0)
+    w1 = Tensor(data=np.random.normal(0, 0.1, (3, 2)).astype(np.float32),
+                device=dev, dtype=args.precision, requires_grad=True,
+                stores_grad=True)
+    b1 = Tensor(shape=(2,), device=dev, dtype=args.precision,
+                requires_grad=True, stores_grad=True)
+    b1.set_value(0.0)
+
+    sgd = opt.SGD(0.05)
+    for epoch in range(args.max_epoch):
+        h = autograd.relu(autograd.add_bias(
+            autograd.matmul(inputs, w0), b0, axis=0))
+        out = autograd.add_bias(autograd.matmul(h, w1), b1, axis=0)
+        loss = autograd.softmax_cross_entropy(out, target)
+        for pt, gt in autograd.backward(loss):
+            sgd.apply(pt, gt)
+        sgd.step()
+        if epoch % 100 == 0 or epoch == args.max_epoch - 1:
+            pred = np.argmax(np.asarray(out.numpy()), 1)
+            acc = float((pred == label).mean())
+            print(f"epoch {epoch}: loss={float(loss.numpy()):.4f} "
+                  f"acc={acc:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

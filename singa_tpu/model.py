@@ -113,7 +113,8 @@ class Model(Layer, metaclass=ModelMeta):
         return self._optimizer
 
     def compile(self, inputs, is_train=True, use_graph=False,
-                sequential=False, pipeline_axis=None, n_micro=1, amp=None):
+                sequential=False, pipeline_axis=None, n_micro=1, amp=None,
+                eval_buckets=False):
         """Dummy forward with concrete inputs to init all params
         (ref model.py:156-184).
 
@@ -123,7 +124,12 @@ class Model(Layer, metaclass=ModelMeta):
 
         amp: compute dtype for mixed-precision training ("bfloat16"):
         fp32 master weights with differentiable casts at matmul/conv
-        boundaries; normalizations and losses stay fp32 (VERDICT r1 #14)."""
+        boundaries; normalizations and losses stay fp32 (VERDICT r1 #14).
+
+        eval_buckets: pad varying eval batch sizes to power-of-two buckets
+        (O(log B) compiled variants instead of a retrace per size). Only
+        valid when forward's outputs are all per-sample — a forward that
+        reduces over the batch dim would average in the padding."""
         assert len(inputs) > 0 and isinstance(inputs[0], Tensor)
         self._device = inputs[0].device
         self.graph_mode = use_graph
@@ -133,6 +139,7 @@ class Model(Layer, metaclass=ModelMeta):
         if amp in ("bf16", True):
             amp = "bfloat16"
         self.amp = amp
+        self.eval_buckets = eval_buckets
         prev = autograd.training
         autograd.training = False  # init pass builds no tape
         try:
@@ -269,6 +276,15 @@ class Model(Layer, metaclass=ModelMeta):
                     check_vma=False)
             else:
                 wrapped = step
+            if self.sequential:
+                # RunGraph(sequential=true) parity (ref device.cc / SURVEY
+                # §2.1): execute ops one-by-one eagerly for debugging —
+                # op-level python breakpoints and immediate error locations
+                # instead of one fused XLA program
+                def serial(*a):
+                    with jax.disable_jit():
+                        return wrapped(*a)
+                return serial
             return jax.jit(wrapped, donate_argnums=(0, 1))
 
         self._dist_shardings = None
@@ -454,13 +470,37 @@ class Model(Layer, metaclass=ModelMeta):
             self._eval_tensors = eval_tensors
             self._compiled_eval = jax.jit(efwd)
         concrete = [t.data for t in self._eval_tensors]
+        # batch-shape bucketing (opt-in, compile(eval_buckets=True)): pad
+        # the batch dim up to the next power of two so varying eval sizes
+        # (e.g. the last partial batch) reuse O(log B) compiled variants
+        # instead of retracing per size. Only sound when every output is
+        # per-sample (leading dim == batch); a forward that reduces over
+        # the batch would see the zero padding.
+        arrs = [a.data for a in args]
+        nb = arrs[0].shape[0] if arrs and arrs[0].ndim > 0 else None
+        bucket = None
+        if getattr(self, "eval_buckets", False) and nb is not None \
+                and nb > 0 and all(
+                a.ndim > 0 and a.shape[0] == nb for a in arrs):
+            bucket = 1
+            while bucket < nb:
+                bucket *= 2
+            if bucket != nb:
+                arrs = [jnp.concatenate(
+                    [a, jnp.zeros((bucket - nb,) + a.shape[1:], a.dtype)])
+                    for a in arrs]
+            else:
+                bucket = None
         try:
-            outs = self._compiled_eval(concrete, [a.data for a in args])
+            outs = self._compiled_eval(concrete, arrs)
         finally:
             # tracing assigns tracers into the state Tensors; put the real
             # arrays back so later eager/train calls see concrete buffers
             for t, a in zip(self._eval_tensors, concrete):
                 t.data = a
+        if bucket is not None:
+            outs = [o[:nb] if o.ndim > 0 and o.shape[0] == bucket else o
+                    for o in outs]
         tensors = [Tensor(data=a, device=self._device, requires_grad=False)
                    for a in outs]
         return _rebuild_out(self._eval_template, tensors)
