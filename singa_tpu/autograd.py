@@ -785,8 +785,15 @@ class ReduceMean(Operator):
 # ---- linear algebra ------------------------------------------------------
 
 class Matmul(Operator):
+    def __init__(self, out_dtype=None):
+        super().__init__()
+        self.out_dtype = out_dtype
+
     def forward(self, a, b):
-        return jnp.matmul(a, b)
+        # out_dtype="float32" with bf16 inputs: MXU accumulates fp32
+        # anyway, so requesting a fp32 result is free and saves the
+        # downstream upcast pass (loss heads under the amp policy)
+        return jnp.matmul(a, b, preferred_element_type=self.out_dtype)
 
 
 class Gemm(Operator):

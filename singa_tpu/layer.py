@@ -166,7 +166,7 @@ class Linear(Layer):
 
     def __init__(self, out_features: int, *args, bias: bool = True, name=None,
                  tp_axis: str | None = None, tp_mode: str = "column",
-                 **kwargs):
+                 out_dtype: str | None = None, **kwargs):
         super().__init__(name)
         # legacy call style Linear(in_features, out_features) (ref layer.py:294)
         if len(args) > 0 and isinstance(args[0], int):
@@ -176,6 +176,9 @@ class Linear(Layer):
         assert tp_mode in ("column", "row"), tp_mode
         self.tp_axis = tp_axis
         self.tp_mode = tp_mode
+        # out_dtype="float32": fp32-accumulated output even under the bf16
+        # amp policy (use on loss heads so the CE never upcasts logits)
+        self.out_dtype = out_dtype
 
     def initialize(self, x):
         in_features = x.shape[-1]
@@ -201,7 +204,7 @@ class Linear(Layer):
             x = autograd.tp_copy(x, self.tp_axis)
         b = self.b if self.bias else None
         x, W, b = autograd.compute_cast(x, self.W, b)
-        y = autograd.matmul(x, W)
+        y = autograd.matmul(x, W, out_dtype=self.out_dtype)
         if tp and self.tp_mode == "row":
             y = autograd.tp_reduce(y, self.tp_axis)
         if b is not None:

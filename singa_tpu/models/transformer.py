@@ -49,7 +49,10 @@ class GPT(model.Model):
         self.blocks = blocks
         self.register_layers(*blocks)
         self.ln_f = layer.LayerNorm()
-        self.head = layer.Linear(vocab_size, bias=False)
+        # fp32-accumulated logits: under amp the CE loss would otherwise
+        # upcast the full (B,S,V) tensor
+        self.head = layer.Linear(vocab_size, bias=False,
+                                 out_dtype="float32")
         self.sce = layer.SoftMaxCrossEntropy()
         self.seq_axis = seq_axis
         self._pos_init = False
@@ -173,7 +176,10 @@ class PipelinedGPT(model.Model):
         self.mlp_ratio = mlp_ratio
         self.tok_embed = layer.Embedding(vocab_size, dim)
         self.ln_f = layer.LayerNorm()
-        self.head = layer.Linear(vocab_size, bias=False)
+        # fp32-accumulated logits: under amp the CE loss would otherwise
+        # upcast the full (B,S,V) tensor
+        self.head = layer.Linear(vocab_size, bias=False,
+                                 out_dtype="float32")
         self.sce = layer.SoftMaxCrossEntropy()
         self._stacks_init = False
 
