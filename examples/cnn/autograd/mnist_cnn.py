@@ -1,0 +1,71 @@
+"""Imperative (eager, no Model/graph) CNN on MNIST (ref
+examples/cnn/autograd/mnist_cnn.py): layers called directly, backward
+driven by autograd.backward, updates applied per-yielded grad."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from singa_tpu import autograd, device, layer, opt, tensor  # noqa: E402
+
+
+def build():
+    return {
+        "conv1": layer.Conv2d(32, 3, padding=1, activation="RELU"),
+        "pool1": layer.MaxPool2d(2, 2),
+        "conv2": layer.Conv2d(32, 3, padding=1, activation="RELU"),
+        "pool2": layer.MaxPool2d(2, 2),
+        "flat": layer.Flatten(),
+        "fc": layer.Linear(10),
+    }
+
+
+def forward(net, x):
+    y = net["pool1"](net["conv1"](x))
+    y = net["pool2"](net["conv2"](y))
+    return net["fc"](net["flat"](y))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--max-batches", type=int, default=20,
+                   help="eager mode is per-op dispatch; keep batches few")
+    args = p.parse_args()
+
+    dev = device.best_device()
+    from data import mnist
+    train_x, train_y, _, _ = mnist.load()
+
+    net = build()
+    sgd = opt.SGD(lr=0.05, momentum=0.9)
+    autograd.training = True
+
+    n = min(len(train_x) // args.batch, args.max_batches)
+    for ep in range(args.epochs):
+        tot, correct = 0.0, 0
+        for b in range(n):
+            xb = train_x[b * args.batch:(b + 1) * args.batch]
+            yb = train_y[b * args.batch:(b + 1) * args.batch]
+            tx = tensor.Tensor(data=xb.astype(np.float32), device=dev)
+            ty = tensor.from_numpy(yb.astype(np.int32), device=dev)
+            out = forward(net, tx)
+            loss = autograd.softmax_cross_entropy(out, ty)
+            for pt, gt in autograd.backward(loss):
+                sgd.apply(pt, gt)
+            sgd.step()
+            tot += float(loss.numpy())
+            correct += int((np.argmax(out.numpy(), 1) == yb).sum())
+        print(f"epoch {ep}: loss={tot / n:.4f} "
+              f"acc={correct / (n * args.batch):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Gradient-sparsified data-parallel training (ref
+examples/cnn/autograd/sparsification_mnist.py): DistOpt's sparse
+strategies (top-K / threshold, both with error feedback) on an 8-device
+mesh, imperative model definition through the Model API step."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--topk", action="store_true",
+                   help="top-K sparsification (default: threshold)")
+    p.add_argument("--spars", type=float, default=0.05,
+                   help="K-fraction (topK) or |g| threshold")
+    p.add_argument("--devices", type=int, default=8)
+    args = p.parse_args()
+
+    import jax
+    if jax.default_backend() != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.devices)
+
+    from singa_tpu import device, models, opt, tensor
+    from singa_tpu.parallel import data_parallel_mesh
+
+    dev = device.get_default_device()
+    from data import mnist
+    train_x, train_y, _, _ = mnist.load()
+
+    mesh = data_parallel_mesh(min(args.devices, len(jax.devices())))
+    sgd = opt.DistOpt(opt.SGD(lr=0.05, momentum=0.9), axis="data",
+                      mesh=mesh)
+    m = models.create_model("cnn", num_classes=10,
+                            num_channels=train_x.shape[1])
+    m.set_optimizer(sgd)
+
+    bs = args.batch
+    tx = tensor.Tensor(data=train_x[:bs].astype(np.float32), device=dev)
+    ty = tensor.from_numpy(train_y[:bs].astype(np.int32), device=dev)
+    m.compile([tx], is_train=True, use_graph=True)
+
+    mode = "sparseTopK" if args.topk else "sparseThreshold"
+    for it in range(args.iters):
+        xb = train_x[(it * bs) % (len(train_x) - bs):][:bs]
+        yb = train_y[(it * bs) % (len(train_y) - bs):][:bs]
+        tx.copy_from_numpy(xb.astype(np.float32))
+        ty.copy_from_numpy(yb.astype(np.int32))
+        out, loss = m(tx, ty, mode, args.spars)
+        print(f"iter {it}: loss={float(loss.numpy()):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""One rank of multi-process data-parallel CNN training. Launched by
+train_multiprocess.py (forked workers) or train_mpi.py (mpirun/srun);
+bootstrap parameters arrive via SINGA_* env vars (set directly, or mapped
+from MPI/SLURM vars by train_mpi.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+
+def main():
+    import jax
+    # real accelerators by default; launchers that want the virtual CPU
+    # mesh (train_multiprocess.py, launcher-less smoke) set this
+    if os.environ.get("SINGA_FORCE_CPU", "0") == "1":
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices",
+                          int(os.environ.get("SINGA_LOCAL_DEVS", "2")))
+
+    from singa_tpu import distributed
+
+    distributed.init()
+    rank = distributed.process_index()
+    world = distributed.process_count()
+    mesh = distributed.global_mesh()  # 'data' axis over all procs' devices
+
+    import numpy as np
+    from singa_tpu import device, models, opt, tensor
+
+    iters = int(os.environ.get("SINGA_ITERS", "8"))
+    global_batch = int(os.environ.get("SINGA_BATCH", "32"))
+    dev = device.get_default_device()
+    dev.rng_state = jax.random.key(0)  # identical init on every rank
+    rng = np.random.RandomState(0)          # identical data on every rank
+    x = rng.rand(global_batch, 1, 16, 16).astype(np.float32)
+    y = rng.randint(0, 10, global_batch).astype(np.int32)
+
+    m = models.create_model("cnn", num_classes=10, num_channels=1)
+    m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.01, momentum=0.9),
+                                axis="data", mesh=mesh))
+
+    # compile traces with a LOCAL tensor of the global shape (the eager
+    # init pass must be single-device); training feeds global arrays
+    m.compile([tensor.Tensor(data=x, device=dev)], is_train=True,
+              use_graph=True)
+
+    tx = tensor.Tensor(data=distributed.global_batch(x, mesh), device=dev)
+    ty = tensor.Tensor(data=distributed.global_batch(y, mesh), device=dev)
+
+    losses = []
+    for _ in range(iters):
+        out, loss = m(tx, ty)
+        losses.append(round(float(np.asarray(jax.device_get(loss.data))),
+                            6))
+    print(f"rank {rank}/{world}: losses {losses}", flush=True)
+    assert losses[-1] < losses[0], losses
+
+
+if __name__ == "__main__":
+    main()

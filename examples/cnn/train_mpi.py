@@ -1,0 +1,46 @@
+"""MPI-launched data-parallel training (ref examples/cnn/train_mpi.py).
+
+The reference's Communicator does MPI_Init and broadcasts an NCCL id
+(src/io/communicator.cc:73-103); here mpirun provides rank/size via its
+environment and jax.distributed replaces the id broadcast with a
+coordinator handshake:
+
+  mpirun -n 2 -x MASTER_ADDR=host0 -x MASTER_PORT=29520 python train_mpi.py
+  srun -n 2 python train_mpi.py        # SLURM variables work the same way
+
+Without a launcher it runs single-process (world=1) as a smoke test.
+"""
+
+import os
+
+import dp_worker
+
+
+def _from_launcher(names, default=None):
+    for n in names:
+        if n in os.environ:
+            return os.environ[n]
+    return default
+
+
+def main():
+    rank = _from_launcher(["OMPI_COMM_WORLD_RANK", "PMI_RANK",
+                           "SLURM_PROCID"], "0")
+    world = _from_launcher(["OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
+                            "SLURM_NTASKS"], "1")
+    addr = _from_launcher(["MASTER_ADDR"], "127.0.0.1")
+    port = _from_launcher(["MASTER_PORT"], "29520")
+    os.environ.setdefault("SINGA_COORDINATOR", f"{addr}:{port}")
+    os.environ.setdefault("SINGA_NPROCS", world)
+    os.environ.setdefault("SINGA_PROC_ID", rank)
+    # launcher-less smoke test runs on the virtual CPU mesh; under a real
+    # launcher the attached accelerators are used (SINGA_FORCE_CPU=1 to
+    # override)
+    launched = any(v in os.environ for v in
+                   ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "SLURM_PROCID"))
+    os.environ.setdefault("SINGA_FORCE_CPU", "0" if launched else "1")
+    dp_worker.main()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Multi-process data-parallel CNN training (ref
+examples/cnn/train_multiprocess.py): fork workers, share a bootstrap
+secret, train one model data-parallel across all workers' devices.
+
+Reference mechanism: fork + shared NcclIdHolder + per-rank CUDA device
+(:100-111). TPU-native: fork + shared coordinator address
+(singa_tpu.distributed.init), one GLOBAL mesh over every process's
+devices, and the SAME Model/DistOpt train step as single-process — the
+mesh, not the training code, changes. Each worker feeds its local shard
+of the global batch; collectives ride ICI/DCN (here: gloo over localhost).
+
+Run: python train_multiprocess.py --world-size 2 --iters 8
+All ranks must print identical losses (synchronous DP).
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--world-size", type=int, default=2)
+    p.add_argument("--local-devices", type=int, default=2,
+                   help="virtual devices per process")
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--batch", type=int, default=32, help="global batch")
+    p.add_argument("--port", type=int, default=29517)
+    args = p.parse_args()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env_base = {**os.environ,
+                "SINGA_COORDINATOR": f"127.0.0.1:{args.port}",
+                "SINGA_NPROCS": str(args.world_size),
+                "SINGA_LOCAL_DEVS": str(args.local_devices),
+                "SINGA_ITERS": str(args.iters),
+                "SINGA_BATCH": str(args.batch),
+                "SINGA_FORCE_CPU": "1",
+                "JAX_PLATFORMS": "cpu"}
+    procs = []
+    for rank in range(args.world_size):
+        env = {**env_base, "SINGA_PROC_ID": str(rank)}
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(here, "dp_worker.py")], env=env))
+    rc = [p.wait(timeout=420) for p in procs]
+    assert rc == [0] * args.world_size, rc
+    print(f"{args.world_size}-process data-parallel training OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -84,3 +84,22 @@ def global_mesh(axis_sizes: dict | None = None):
     assert n == len(devs), \
         f"mesh wants {n} devices, slice has {len(devs)}"
     return make_mesh(axis_sizes, devices=devs)
+
+
+def global_batch(host_array, mesh, axis: str = "data"):
+    """Assemble a global jax.Array sharded along `axis` from a host array
+    holding the FULL global batch (identical on every process). Each
+    process materializes only its own devices' shards — the standard
+    multi-host feeding pattern (reference analog: per-rank data partition,
+    examples/cnn/train_cnn.py:58-72).
+    """
+    import jax.numpy as jnp  # noqa: F401 (kept lazy like the rest)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.devices.size
+    assert host_array.shape[0] % n == 0, \
+        f"global batch {host_array.shape[0]} must divide {n} devices"
+    sh = NamedSharding(mesh, P(axis))
+    host = np.asarray(host_array)
+    return jax.make_array_from_callback(host.shape, sh,
+                                        lambda idx: host[idx])

@@ -347,16 +347,44 @@ class Model(Layer, metaclass=ModelMeta):
             # on the data axis (a no-op after step 1: outputs already carry
             # these shardings, so only fresh host batches actually move)
             rep, shard, state_sh, opt_sh = self._dist_shardings
+
+            def put(a, sh):
+                if getattr(a, "sharding", None) == sh:
+                    return a
+                if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                    # already a global array (a previous step's output);
+                    # re-putting is impossible and unnecessary
+                    return a
+                if jax.process_count() > 1:
+                    # multi-host: device_put cannot scatter across hosts.
+                    # Every process holds the FULL host value (params init
+                    # from a shared seed, batches fed as global arrays), so
+                    # each builds its addressable shards by indexing into
+                    # it — correct for replicated AND partitioned specs.
+                    if jnp.issubdtype(getattr(a, "dtype", None),
+                                      jax.dtypes.prng_key):
+                        # typed keys can't pass np.asarray; ship the raw
+                        # key data (rng shardings are replicated, so the
+                        # spec is rank-agnostic)
+                        kd = np.asarray(jax.random.key_data(a))
+                        g = jax.make_array_from_callback(
+                            kd.shape, sh, lambda idx: kd[idx])
+                        return jax.random.wrap_key_data(g)
+                    host = np.asarray(a)
+                    return jax.make_array_from_callback(
+                        host.shape, sh, lambda idx: host[idx])
+                return jax.device_put(a, sh)
+
             if state_sh is None:
-                state_arrs = [jax.device_put(a, rep) for a in state_arrs]
-                opt_arrs = [jax.device_put(a, rep) for a in opt_arrs]
+                state_arrs = [put(a, rep) for a in state_arrs]
+                opt_arrs = [put(a, rep) for a in opt_arrs]
             else:
-                state_arrs = [jax.device_put(a, s)
+                state_arrs = [put(a, s)
                               for a, s in zip(state_arrs, state_sh)]
-                opt_arrs = [jax.device_put(a, s)
+                opt_arrs = [put(a, s)
                             for a, s in zip(opt_arrs, opt_sh)]
-            rng = jax.device_put(rng, rep)
-            input_arrs = [jax.device_put(a, shard) for a in input_arrs]
+            rng = put(rng, rep)
+            input_arrs = [put(a, shard) for a in input_arrs]
         tag = opt.step_tag() if opt is not None else 0
         fn = self._compiled_step.get(tag)
         if fn is None:
@@ -377,9 +405,13 @@ class Model(Layer, metaclass=ModelMeta):
             t.data = a
         if opt is not None and new_opt:
             opt.load_state_arrays(new_opt)
-        if self._dist_shardings is not None:
+        if self._dist_shardings is not None and (
+                not isinstance(new_rng, jax.Array)
+                or new_rng.is_fully_addressable):
             # un-replicate the key so later eager/single-device work (fresh
-            # param init, eval) doesn't inherit a mesh sharding
+            # param init, eval) doesn't inherit a mesh sharding. (On a
+            # multi-host mesh the key is not addressable here; it stays
+            # global and step feeds consume it in place.)
             new_rng = jax.device_put(new_rng, dev.jax_device)
         dev.rng_state = new_rng
         self._step_stats["steps"] += 1
