@@ -26,7 +26,9 @@ def main():
     args = p.parse_args()
 
     import jax
-    if jax.default_backend() != "tpu":
+    # config must precede any backend init (jax.default_backend() would
+    # lock it); gate on env like the other launchers
+    if os.environ.get("SINGA_FORCE_CPU", "1") == "1":
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.devices)
 

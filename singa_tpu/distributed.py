@@ -96,9 +96,10 @@ def global_batch(host_array, mesh, axis: str = "data"):
     import jax.numpy as jnp  # noqa: F401 (kept lazy like the rest)
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    n = mesh.devices.size
+    n = mesh.shape[axis]
     assert host_array.shape[0] % n == 0, \
-        f"global batch {host_array.shape[0]} must divide {n} devices"
+        f"axis '{axis}' has {n} shards; they must divide the global " \
+        f"batch of {host_array.shape[0]}"
     sh = NamedSharding(mesh, P(axis))
     host = np.asarray(host_array)
     return jax.make_array_from_callback(host.shape, sh,

@@ -184,3 +184,34 @@ def test_gpt_seq_parallel_dryrun(dev):
     tgt_m = _jax.device_put(jnp.asarray(tgt), shard)
     new_p, loss = _jax.jit(stepped)(p_arrs, ids_m, tgt_m)
     assert np.isfinite(float(loss))
+
+
+def test_block_autofit_nonpow2_seq():
+    """None-default blocks fit a divisor (S=384 -> 192) so the kernel
+    path keeps working off power-of-two lengths; explicit non-tiling
+    blocks keep the documented reference fallback."""
+    from singa_tpu.ops import attention as A
+    bq, bk, ok = A._resolve_blocks(384, 384, None, None)
+    assert ok and bq == 192 and bk == 192
+    _, _, ok = A._resolve_blocks(384, 384, 256, 256)
+    assert not ok
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.rand(1, 2, 384, 32), jnp.float32)
+    out = A.flash_attention(q, q, q, causal=True)
+    ref = A.attention_reference(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_backward_block_cap_refits():
+    """Explicit blocks above the backward VMEM cap refit to a divisor
+    instead of crashing the blockwise fallback (bq=768 at S=768)."""
+    from singa_tpu.ops import attention as A
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.rand(1, 2, 768, 32), jnp.float32)
+    g = jax.grad(lambda q: A.flash_attention(
+        q, q, q, causal=True, block_q=768, block_k=768).sum())(q)
+    gr = jax.grad(lambda q: A.attention_reference(
+        q, q, q, causal=True).sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=2e-3, atol=2e-4)
