@@ -61,12 +61,12 @@ def main():
     p.add_argument("--amp", action="store_true", default=None,
                    help="mixed precision: bf16 compute, fp32 master "
                         "weights (compile(amp='bfloat16')). Default: on "
-                        "for conv models (the canonical TPU training "
-                        "mode), off for gpt (flash kernel is fp32-tuned)")
+                        "(the canonical TPU training mode); --no-amp for "
+                        "pure fp32")
     p.add_argument("--no-amp", dest="amp", action="store_false")
     args = p.parse_args()
     if args.amp is None:
-        args.amp = args.model != "gpt"
+        args.amp = True
 
     import numpy as np
     import jax

@@ -23,12 +23,16 @@ def main():
     p.add_argument("--spars", type=float, default=0.05,
                    help="K-fraction (topK) or |g| threshold")
     p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--tpu", action="store_true",
+                   help="run on the attached accelerator (mesh over its "
+                        "devices) instead of the default virtual "
+                        "--devices-wide CPU mesh")
     args = p.parse_args()
 
     import jax
     # config must precede any backend init (jax.default_backend() would
-    # lock it); gate on env like the other launchers
-    if os.environ.get("SINGA_FORCE_CPU", "1") == "1":
+    # lock it), so the choice is an explicit flag, not a probe
+    if not args.tpu:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.devices)
 

@@ -1,0 +1,39 @@
+"""Facial-emotion recognition ONNX import (ref examples/onnx/fer_emotion.py):
+FER+ style CNN over 64x64 grayscale faces, 8 emotion classes."""
+
+import numpy as np
+
+from utils import check_vs_torch, fake_image, load_or_export, run_imported
+
+EMOTIONS = ["neutral", "happiness", "surprise", "sadness", "anger",
+            "disgust", "fear", "contempt"]
+
+
+def build_torch():
+    import torch.nn as nn
+    blocks = []
+    cin = 1
+    for cout, n in ((64, 2), (128, 2), (256, 3)):
+        for _ in range(n):
+            blocks += [nn.Conv2d(cin, cout, 3, padding=1), nn.ReLU(True)]
+            cin = cout
+        blocks.append(nn.MaxPool2d(2, 2))
+    import torch
+    return torch.nn.Sequential(
+        *blocks, nn.Flatten(),
+        nn.Linear(256 * 8 * 8, 1024), nn.ReLU(True), nn.Dropout(0.5),
+        nn.Linear(1024, len(EMOTIONS)))
+
+
+if __name__ == "__main__":
+    import torch
+    torch.manual_seed(0)
+    face = fake_image(64, 64)[:1][None]  # grayscale
+    proto, tm = load_or_export("fer_emotion", build_torch,
+                               torch.from_numpy(face))
+    (logits,) = run_imported(proto, [face])
+    order = np.argsort(logits[0])[::-1]
+    for i in order[:3]:
+        print(f"  {EMOTIONS[i]}: {logits[0][i]:.3f}")
+    check_vs_torch(tm, [torch.from_numpy(face)], logits,
+                   name="fer_emotion")

@@ -1,0 +1,97 @@
+"""ShuffleNetV2 ONNX import (ref examples/onnx/shufflenetv2.py): channel
+shuffle exports as Reshape/Transpose/Reshape; depthwise convs exercise
+grouped-conv import."""
+
+import numpy as np
+
+from utils import (check_vs_torch, fake_image, load_or_export,
+                   preprocess_imagenet, run_imported, top5)
+
+
+def build_torch():
+    import torch
+    import torch.nn as nn
+
+    def shuffle(x, groups=2):
+        b, c, h, w = x.shape
+        return (x.reshape(b, groups, c // groups, h, w)
+                .transpose(1, 2).reshape(b, c, h, w))
+
+    class Unit(nn.Module):
+        def __init__(self, c, stride):
+            super().__init__()
+            half = c // 2
+            self.stride = stride
+            cin = c if stride == 2 else half
+            self.branch = nn.Sequential(
+                nn.Conv2d(cin, half, 1, bias=False),
+                nn.BatchNorm2d(half), nn.ReLU(True),
+                nn.Conv2d(half, half, 3, stride, 1, groups=half,
+                          bias=False),
+                nn.BatchNorm2d(half),
+                nn.Conv2d(half, half, 1, bias=False),
+                nn.BatchNorm2d(half), nn.ReLU(True))
+            self.short = nn.Sequential(
+                nn.Conv2d(c, half, 3, 2, 1, groups=c, bias=False),
+                nn.BatchNorm2d(half),
+                nn.Conv2d(half, half, 1, bias=False),
+                nn.BatchNorm2d(half), nn.ReLU(True)) if stride == 2 \
+                else None
+
+        def forward(self, x):
+            if self.stride == 2:
+                out = torch.cat([self.short(x), self.branch(x)], 1)
+            else:
+                a, b = x.chunk(2, 1)
+                out = torch.cat([a, self.branch(b)], 1)
+            return shuffle(out)
+
+    layers = [nn.Conv2d(3, 24, 3, 2, 1, bias=False), nn.BatchNorm2d(24),
+              nn.ReLU(True), nn.MaxPool2d(3, 2, 1)]
+    c = 24
+    for cout, reps in ((116, 4), (232, 8), (464, 4)):
+        layers.append(Unit(c if False else cout, 2)
+                      if False else None)  # placeholder, replaced below
+        layers.pop()
+        # first unit downsamples from c -> cout
+        class Down(nn.Module):
+            def __init__(self, cin, cout):
+                super().__init__()
+                half = cout // 2
+                self.b = nn.Sequential(
+                    nn.Conv2d(cin, half, 1, bias=False),
+                    nn.BatchNorm2d(half), nn.ReLU(True),
+                    nn.Conv2d(half, half, 3, 2, 1, groups=half,
+                              bias=False),
+                    nn.BatchNorm2d(half),
+                    nn.Conv2d(half, half, 1, bias=False),
+                    nn.BatchNorm2d(half), nn.ReLU(True))
+                self.s = nn.Sequential(
+                    nn.Conv2d(cin, cin, 3, 2, 1, groups=cin, bias=False),
+                    nn.BatchNorm2d(cin),
+                    nn.Conv2d(cin, half, 1, bias=False),
+                    nn.BatchNorm2d(half), nn.ReLU(True))
+
+            def forward(self, x):
+                return shuffle(torch.cat([self.s(x), self.b(x)], 1))
+
+        layers.append(Down(c, cout))
+        for _ in range(reps - 1):
+            layers.append(Unit(cout, 1))
+        c = cout
+    layers += [nn.Conv2d(c, 1024, 1, bias=False), nn.BatchNorm2d(1024),
+               nn.ReLU(True), nn.AdaptiveAvgPool2d(1), nn.Flatten(),
+               nn.Linear(1024, 1000)]
+    return nn.Sequential(*layers)
+
+
+if __name__ == "__main__":
+    import torch
+    torch.manual_seed(0)
+    x = preprocess_imagenet(fake_image())
+    proto, tm = load_or_export("shufflenetv2", build_torch,
+                               torch.from_numpy(x))
+    (logits,) = run_imported(proto, [x])
+    print("top-5:")
+    top5(logits)
+    check_vs_torch(tm, [torch.from_numpy(x)], logits, name="shufflenetv2")

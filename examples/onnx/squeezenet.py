@@ -1,0 +1,49 @@
+"""SqueezeNet 1.0 ONNX import (ref examples/onnx/squeezenet.py)."""
+
+import numpy as np
+
+from utils import (check_vs_torch, fake_image, load_or_export,
+                   preprocess_imagenet, run_imported, top5)
+
+
+def build_torch():
+    import torch
+    import torch.nn as nn
+
+    class Fire(nn.Module):
+        def __init__(self, cin, squeeze, e1, e3):
+            super().__init__()
+            self.s = nn.Conv2d(cin, squeeze, 1)
+            self.e1 = nn.Conv2d(squeeze, e1, 1)
+            self.e3 = nn.Conv2d(squeeze, e3, 3, padding=1)
+
+        def forward(self, x):
+            s = torch.relu(self.s(x))
+            return torch.cat([torch.relu(self.e1(s)),
+                              torch.relu(self.e3(s))], 1)
+
+    return nn.Sequential(
+        nn.Conv2d(3, 96, 7, 2), nn.ReLU(True),
+        nn.MaxPool2d(3, 2, ceil_mode=True),
+        Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+        Fire(128, 32, 128, 128),
+        nn.MaxPool2d(3, 2, ceil_mode=True),
+        Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+        Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+        nn.MaxPool2d(3, 2, ceil_mode=True),
+        Fire(512, 64, 256, 256),
+        nn.Dropout(0.5), nn.Conv2d(512, 1000, 1), nn.ReLU(True),
+        nn.AdaptiveAvgPool2d(1), nn.Flatten())
+
+
+if __name__ == "__main__":
+    import torch
+    torch.manual_seed(0)
+    x = preprocess_imagenet(fake_image())
+    proto, tm = load_or_export("squeezenet", build_torch,
+                               torch.from_numpy(x))
+    (logits,) = run_imported(proto, [x])
+    print("top-5:")
+    top5(logits)
+    check_vs_torch(tm, [torch.from_numpy(x)], logits, atol=5e-4,
+                   name="squeezenet")

@@ -64,12 +64,15 @@ def _default_block(s):
     return 1024 if s >= 1024 else 256
 
 
-def _fit_block(s, target):
-    """Largest block <= target that tiles s evenly on 8-sublane alignment;
-    None when s itself is not 8-aligned-divisible (caller falls back)."""
+def _fit_block(s, target, floor=128):
+    """Largest block <= target that tiles s evenly on 8-sublane alignment.
+    None when nothing >= `floor` divides s (caller falls back to the XLA
+    reference path) — tiles below ~128 are per-grid-step-overhead bound
+    and run far slower than the O(S^2) XLA path."""
     b = min(target, s)
     b -= b % 8
-    while b >= 8:
+    floor = min(floor, s)
+    while b >= floor:
         if s % b == 0:
             return b
         b -= 8
