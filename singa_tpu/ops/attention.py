@@ -460,6 +460,134 @@ flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 # ======================= 3. ring attention ===============================
+#
+# Two implementations, same math:
+#   _ring_jnp    — einsum per hop (O(S_local^2) scores materialized);
+#                  ground truth, and fallback when shards don't tile.
+#   _ring_flash  — the Pallas flash kernel per hop + lse merge, with a
+#                  second ring for the backward: kernel speed and O(block)
+#                  memory on the long-context path itself. Per hop the
+#                  K/V shard's origin decides the mask: src < my -> fully
+#                  visible, src == my -> the causal diagonal, src > my ->
+#                  skipped (zero contribution).
+# `ring_attention` dispatches between them.
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, bq, bk, interp):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    B, H, S, D = q.shape
+    f32 = jnp.float32
+
+    def hop(k_cur, v_cur, src):
+        def full(_):
+            o, l = _flash_fwd_pallas(q, k_cur, v_cur, False, scale, bq, bk,
+                                     interp)
+            return o.astype(f32), l
+
+        def diag(_):
+            o, l = _flash_fwd_pallas(q, k_cur, v_cur, True, scale, bq, bk,
+                                     interp)
+            return o.astype(f32), l
+
+        def skip(_):
+            return (jnp.zeros((B, H, S, D), f32),
+                    jnp.full((B, H, S), _NEG_INF, f32))
+
+        if not causal:
+            return full(None)
+        idx = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+        return lax.switch(idx, (full, diag, skip), None)
+
+    def step(carry, step_i):
+        m, z, num, k_cur, v_cur = carry
+        src = (my - step_i) % n
+        o_i, lse_i = hop(k_cur, v_cur, src)
+        m_new = jnp.maximum(m, lse_i)
+        corr = jnp.exp(m - m_new)
+        w = jnp.exp(lse_i - m_new)
+        z = z * corr + w
+        num = num * corr[..., None] + w[..., None] * o_i
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, z, num, k_nxt, v_nxt), None
+
+    init = (jnp.full((B, H, S), _NEG_INF, f32),
+            jnp.zeros((B, H, S), f32),
+            jnp.zeros((B, H, S, D), f32), k, v)
+    (m, z, num, _, _), _ = lax.scan(step, init, jnp.arange(n))
+    z = jnp.maximum(z, 1e-20)
+    out = (num / z[..., None]).astype(q.dtype)
+    lse = m + jnp.log(z)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, scale, bq, bk, interp):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, bq,
+                                  bk, interp)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, bq, bk, interp):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, bq,
+                                    bk, interp)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, bq, bk, interp, res, g):
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    f32 = jnp.float32
+    # backward tiles capped at 512 for VMEM, same as single-shard flash
+    sq, sk = q.shape[2], k.shape[2]
+    bqb = _fit_block(sq, min(bq, 512))
+    bkb = _fit_block(sk, min(bk, 512))
+
+    def hop(k_cur, v_cur, src):
+        def run(causal_flag):
+            def f(_):
+                dq, dk, dv = _flash_bwd_pallas(q, k_cur, v_cur, out, lse,
+                                               g, causal_flag, scale, bqb,
+                                               bkb, interp)
+                return dq.astype(f32), dk.astype(f32), dv.astype(f32)
+            return f
+
+        def skip(_):
+            return (jnp.zeros(q.shape, f32), jnp.zeros(k.shape, f32),
+                    jnp.zeros(v.shape, f32))
+
+        if not causal:
+            return run(False)(None)
+        idx = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+        return lax.switch(idx, (run(False), run(True), skip), None)
+
+    def step(carry, step_i):
+        dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (my - step_i) % n
+        dq_i, dk_i, dv_i = hop(k_cur, v_cur, src)
+        dq_acc = dq_acc + dq_i
+        # dk/dv accumulate onto the rotating shard so that after n hops
+        # every contribution has ridden the ring home with its shard
+        dk_cur = dk_cur + dk_i
+        dv_cur = dv_cur + dv_i
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = lax.ppermute(dv_cur, axis_name, perm)
+        return (dq_acc, k_nxt, v_nxt, dk_nxt, dv_nxt), None
+
+    init = (jnp.zeros(q.shape, f32), k, v,
+            jnp.zeros(k.shape, f32), jnp.zeros(v.shape, f32))
+    (dq, _, _, dk, dv), _ = lax.scan(step, init, jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
 
 def ring_attention(q, k, v, axis_name: str, causal=False, scale=None):
     """Sequence-parallel attention INSIDE shard_map: q/k/v hold this
@@ -468,7 +596,29 @@ def ring_attention(q, k, v, axis_name: str, causal=False, scale=None):
     each device accumulates online-softmax partials — peak memory is one
     shard, total traffic (n-1) shard-hops over ICI, and XLA overlaps each
     hop with the local block's matmuls.
+
+    When the local shard tiles for the Pallas kernel, each hop runs the
+    flash kernel (O(block) score memory, kernel speed); otherwise the
+    jnp einsum path below is the fallback.
     """
+    d = q.shape[-1]
+    sq, sk = q.shape[2], k.shape[2]
+    resolved_scale = scale if scale is not None else d ** -0.5
+    bq = _fit_block(sq, _default_block(sq))
+    bk = _fit_block(sk, _default_block(sk))
+    # the backward ring has no blockwise fallback, so its capped tiles
+    # must fit as well (e.g. S_local=2032: fwd fits 1016 but nothing in
+    # [128,512] divides it)
+    bwd_ok = _fit_block(sq, min(bq or 0, 512)) and \
+        _fit_block(sk, min(bk or 0, 512))
+    if _HAS_PALLAS and bq and bk and bwd_ok:
+        _, interp = _resolve(resolved_scale, d, None)
+        return _ring_flash(q, k, v, axis_name, causal, resolved_scale,
+                           bq, bk, interp)
+    return _ring_jnp(q, k, v, axis_name, causal, scale)
+
+
+def _ring_jnp(q, k, v, axis_name: str, causal=False, scale=None):
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     d = q.shape[-1]

@@ -215,3 +215,17 @@ def test_backward_block_cap_refits():
         q, q, q, causal=True).sum())(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
                                rtol=2e-3, atol=2e-4)
+
+
+def test_ring_dispatch_falls_back_when_bwd_blocks_dont_fit():
+    """S_local=2032: forward could tile at 1016 but no [128,512] divisor
+    exists for the capped backward ring, so dispatch must use the jnp
+    path (which has full AD) instead of crashing at grad trace time."""
+    from singa_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh({"sp": 4})
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.rand(1, 1, 4 * 2032, 16), jnp.float32)
+    out = att.ring_attention_sharded(q, q, q, mesh, "sp", causal=True)
+    ref = att.attention_reference(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
