@@ -47,7 +47,7 @@ class LSGAN:
     def __init__(self, dev, rows=28, cols=28, channels=1, noise_size=100,
                  hidden_size=128, batch=128, interval=200,
                  learning_rate=1e-3, iterations=1000, d_steps=3, g_steps=1,
-                 file_dir="lsgan_images/"):
+                 file_dir=None):
         self.dev = dev
         self.feature_size = rows * cols * channels
         self.rows, self.cols = rows, cols
@@ -57,7 +57,9 @@ class LSGAN:
         self.iterations = iterations
         self.d_steps = d_steps
         self.g_steps = g_steps
-        self.file_dir = file_dir
+        # anchor sample dumps next to this script, not the caller's cwd
+        self.file_dir = file_dir or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "lsgan_images")
         self.G = Generator(self.feature_size, hidden_size)
         self.D = Discriminator(hidden_size)
         self.g_opt = opt.SGD(lr=learning_rate, momentum=0.5)

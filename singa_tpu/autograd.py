@@ -1528,7 +1528,8 @@ class Shrink(Operator):
 
 
 class Mod(Operator):
-    never_requires_grad = True
+    # differentiable a.e. for float operands (d/da fmod(a,b) = 1); int
+    # tensors never carry requires_grad, so no flag is needed
 
     def __init__(self, fmod=0):
         super().__init__()
@@ -1659,7 +1660,8 @@ class LRN(Operator):
             float(bias)
 
     def forward(self, x):
-        half = self.size // 2
+        # ONNX window: [c - floor((size-1)/2), c + ceil((size-1)/2)]
+        half = (self.size - 1) // 2
         sq = x * x
         pad = [(0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)]
         sq = jnp.pad(sq, pad)

@@ -243,6 +243,10 @@ class Model(Layer, metaclass=ModelMeta):
                     out = func(self, *call_args, **kwargs)
                 finally:
                     autograd.compute_dtype = prev_cd
+                    if opt is not None:
+                        # trace-time tag must not leak into later EAGER
+                        # partial updates (they rotate via a host counter)
+                        opt._partial_static_idx = None
                 out_leaves, template = _flatten_out(out)
                 out_template_box["t"] = template
                 outs = [o.data for o in out_leaves]
@@ -531,8 +535,14 @@ class Model(Layer, metaclass=ModelMeta):
             for t, a in zip(self._eval_tensors, concrete):
                 t.data = a
         if bucket is not None:
-            outs = [o[:nb] if o.ndim > 0 and o.shape[0] == bucket else o
-                    for o in outs]
+            # the eval_buckets contract is "every output is per-sample";
+            # enforce it loudly — a fixed-size output that merely happens
+            # to match the bucket would otherwise be silently truncated
+            for o in outs:
+                assert o.ndim > 0 and o.shape[0] == bucket, (
+                    f"eval_buckets=True requires per-sample outputs; got "
+                    f"shape {o.shape} with batch bucket {bucket}")
+            outs = [o[:nb] for o in outs]
         tensors = [Tensor(data=a, device=self._device, requires_grad=False)
                    for a in outs]
         return _rebuild_out(self._eval_template, tensors)

@@ -263,20 +263,30 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-                      interpret):
-    """Pallas flash backward: dQ and dK/dV kernels with streamed tiles."""
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
+def _flash_bwd_stats(o, lse, do):
+    """(lsef, delta) lane-broadcast stat tensors for the backward kernels;
+    loop-invariant across ring hops, so callers may precompute once."""
+    b, h, sq, _ = o.shape
     bh = b * h
-    qf, kf, vf = (a.reshape(bh, -1, d) for a in (q, k, v))
-    dof = do.reshape(bh, sq, d)
     stat = (bh, sq, _STAT_LANES)
     lsef = jnp.broadcast_to(lse.reshape(bh, sq)[:, :, None], stat)
     # delta = rowsum(do * o): cheap elementwise, leave to XLA fusion
     delta = jnp.broadcast_to(
         jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                 axis=-1).reshape(bh, sq)[:, :, None], stat)
+    return lsef, delta
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                      interpret, stats=None):
+    """Pallas flash backward: dQ and dK/dV kernels with streamed tiles."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    qf, kf, vf = (a.reshape(bh, -1, d) for a in (q, k, v))
+    dof = do.reshape(bh, sq, d)
+    lsef, delta = stats if stats is not None else _flash_bwd_stats(o, lse,
+                                                                   do)
     nq, nk = sq // block_q, sk // block_k
     stat_spec_q = pl.BlockSpec((1, block_q, _STAT_LANES),
                                lambda i, j, kb: (i, j, 0))
@@ -373,8 +383,8 @@ def flash_attention(q, k, v, causal=False, scale=None,
                     interpret=None):
     """Fused attention; q,k,v (B,H,S,D). Falls back to the reference path
     when shapes don't tile (S % block != 0) or Pallas is unavailable."""
-    out, _, _, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                              interpret)
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                        interpret)
     return out
 
 
@@ -412,18 +422,14 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     sq, sk = q.shape[2], k.shape[2]
     bq, bk, ok = _resolve_blocks(sq, sk, block_q, block_k)
     if not _HAS_PALLAS or not ok:
-        out = attention_reference(q, k, v, causal, scale)
-        lse = None
-        bq = bk = None
-    else:
-        out, lse = _flash_fwd_pallas(q, k, v, causal, scale, bq, bk,
-                                     interpret)
-    return out, lse, bq, bk
+        return attention_reference(q, k, v, causal, scale), None
+    out, lse = _flash_fwd_pallas(q, k, v, causal, scale, bq, bk, interpret)
+    return out, lse
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse, bq, bk = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                                  interpret)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
     if lse is None:  # fallback path: vjp of the reference impl
         d = q.shape[-1]
         s, _ = _resolve(scale, d, interpret)
@@ -547,12 +553,14 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, bq, bk, interp, res, g):
     bqb = _fit_block(sq, min(bq, 512))
     bkb = _fit_block(sk, min(bk, 512))
 
+    stats = _flash_bwd_stats(out, lse, g)  # loop-invariant across hops
+
     def hop(k_cur, v_cur, src):
         def run(causal_flag):
             def f(_):
                 dq, dk, dv = _flash_bwd_pallas(q, k_cur, v_cur, out, lse,
                                                g, causal_flag, scale, bqb,
-                                               bkb, interp)
+                                               bkb, interp, stats=stats)
                 return dq.astype(f32), dk.astype(f32), dv.astype(f32)
             return f
 

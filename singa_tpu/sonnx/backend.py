@@ -53,7 +53,18 @@ def _np_div(a, b):
 
 
 def _np_slice(node, ins):
-    data, starts, ends = ins[0], np.atleast_1d(ins[1]), np.atleast_1d(ins[2])
+    data = ins[0]
+    if len(ins) < 3:  # opset<10: starts/ends/axes are attributes
+        starts = np.atleast_1d(node.attrs["starts"])
+        ends = np.atleast_1d(node.attrs["ends"])
+        axes = np.atleast_1d(node.attrs["axes"]) \
+            if "axes" in node.attrs else range(len(starts))
+        steps = [1] * len(starts)
+        sl = [slice(None)] * data.ndim
+        for s, e, a in zip(starts, ends, axes):
+            sl[int(a)] = slice(int(s), int(min(e, np.iinfo(np.int64).max)))
+        return data[tuple(sl)]
+    starts, ends = np.atleast_1d(ins[1]), np.atleast_1d(ins[2])
     axes = np.atleast_1d(ins[3]) if len(ins) > 3 and ins[3] is not None \
         else range(len(starts))
     steps = np.atleast_1d(ins[4]) if len(ins) > 4 and ins[4] is not None \

@@ -376,3 +376,45 @@ def test_last_layers_bounds(dev):
         rep.backend.run([x], last_layers=0)
     with pytest.raises(ValueError, match="last_layers"):
         rep.backend.run([x], last_layers=-5)
+
+
+def test_opset9_attr_slice_folds(dev):
+    """Attribute-form Slice (opset<10) on a host constant must fold, not
+    IndexError (host fold path takes precedence over op_Slice)."""
+    shape_node = pb.make_node("Shape", ["x"], ["s"])
+    slice_node = pb.make_node("Slice", ["s"], ["s2"], starts=[1], ends=[3])
+    cast = pb.make_node("Cast", ["s2"], ["s3"], to=pb.TensorProto.FLOAT)
+    (y,) = _run_graph([shape_node, slice_node, cast],
+                      {"x": RS.randn(2, 3, 4).astype(np.float32)}, dev=dev)
+    np.testing.assert_array_equal(y, [3.0, 4.0])
+
+
+def test_lrn_even_size_window(dev):
+    """ONNX LRN window for even size: floor((size-1)/2) below the center,
+    ceil above."""
+    x = RS.randn(1, 6, 2, 2).astype(np.float32)
+    (y,) = _run_graph([pb.make_node("LRN", ["x"], ["y"], size=4,
+                                    alpha=0.3, beta=0.75, bias=1.0)],
+                      {"x": x}, dev=dev)
+    ref = np.empty_like(x)
+    C = x.shape[1]
+    for c in range(C):
+        lo, hi = max(0, c - 1), min(C, c + 3)  # [c-1, c+2]
+        acc = (x[:, lo:hi] ** 2).sum(1)
+        ref[:, c] = x[:, c] / (1.0 + 0.3 / 4 * acc) ** 0.75
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_mod_float_gradient(dev, train_mode):
+    """Float fmod carries gradient (d/da = 1 a.e.) so imported graphs
+    containing Mod keep training."""
+    from singa_tpu import autograd, tensor
+    a = tensor.from_numpy(np.array([5.3, -2.7], np.float32), device=dev)
+    a.requires_grad = True
+    a.stores_grad = True
+    b = tensor.from_numpy(np.array([2.0, 2.0], np.float32), device=dev)
+    y = autograd.Mod(fmod=1)(a, b)
+    loss = autograd.reduce_sum(y, None)
+    grads = autograd.gradients(loss)
+    (ga,) = [g for p, g in grads.items() if p is a]
+    np.testing.assert_allclose(np.asarray(ga.numpy()), [1.0, 1.0])
