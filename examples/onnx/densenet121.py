@@ -1,0 +1,56 @@
+"""DenseNet-121 ONNX import (ref examples/onnx/densenet121.py): dense
+blocks exercise long Concat chains through the importer."""
+
+import numpy as np
+
+from utils import (check_vs_torch, fake_image, load_or_export,
+                   preprocess_imagenet, run_imported, top5)
+
+
+def build_torch():
+    import torch
+    import torch.nn as nn
+
+    class DenseLayer(nn.Module):
+        def __init__(self, cin, growth=32):
+            super().__init__()
+            self.seq = nn.Sequential(
+                nn.BatchNorm2d(cin), nn.ReLU(True),
+                nn.Conv2d(cin, 4 * growth, 1, bias=False),
+                nn.BatchNorm2d(4 * growth), nn.ReLU(True),
+                nn.Conv2d(4 * growth, growth, 3, padding=1, bias=False))
+
+        def forward(self, x):
+            return torch.cat([x, self.seq(x)], 1)
+
+    def transition(cin):
+        return nn.Sequential(nn.BatchNorm2d(cin), nn.ReLU(True),
+                             nn.Conv2d(cin, cin // 2, 1, bias=False),
+                             nn.AvgPool2d(2, 2)), cin // 2
+
+    layers = [nn.Conv2d(3, 64, 7, 2, 3, bias=False), nn.BatchNorm2d(64),
+              nn.ReLU(True), nn.MaxPool2d(3, 2, 1)]
+    c = 64
+    for i, n in enumerate((6, 12, 24, 16)):
+        for _ in range(n):
+            layers.append(DenseLayer(c))
+            c += 32
+        if i < 3:
+            t, c = transition(c)
+            layers.append(t)
+    layers += [nn.BatchNorm2d(c), nn.ReLU(True),
+               nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(c, 1000)]
+    return nn.Sequential(*layers)
+
+
+if __name__ == "__main__":
+    import torch
+    torch.manual_seed(0)
+    x = preprocess_imagenet(fake_image())
+    proto, tm = load_or_export("densenet121", build_torch,
+                               torch.from_numpy(x))
+    (logits,) = run_imported(proto, [x])
+    print("top-5:")
+    top5(logits)
+    check_vs_torch(tm, [torch.from_numpy(x)], logits, atol=5e-4,
+                   name="densenet121")

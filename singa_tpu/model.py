@@ -536,12 +536,14 @@ class Model(Layer, metaclass=ModelMeta):
                 t.data = a
         if bucket is not None:
             # the eval_buckets contract is "every output is per-sample";
-            # enforce it loudly — a fixed-size output that merely happens
-            # to match the bucket would otherwise be silently truncated
+            # enforce it loudly (ValueError, not assert: -O must not turn
+            # this back into silent truncation of a fixed-size output that
+            # merely matches the bucket)
             for o in outs:
-                assert o.ndim > 0 and o.shape[0] == bucket, (
-                    f"eval_buckets=True requires per-sample outputs; got "
-                    f"shape {o.shape} with batch bucket {bucket}")
+                if o.ndim == 0 or o.shape[0] != bucket:
+                    raise ValueError(
+                        f"eval_buckets=True requires per-sample outputs; "
+                        f"got shape {o.shape} with batch bucket {bucket}")
             outs = [o[:nb] for o in outs]
         tensors = [Tensor(data=a, device=self._device, requires_grad=False)
                    for a in outs]

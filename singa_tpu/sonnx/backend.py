@@ -60,15 +60,12 @@ def _np_slice(node, ins):
         axes = np.atleast_1d(node.attrs["axes"]) \
             if "axes" in node.attrs else range(len(starts))
         steps = [1] * len(starts)
-        sl = [slice(None)] * data.ndim
-        for s, e, a in zip(starts, ends, axes):
-            sl[int(a)] = slice(int(s), int(min(e, np.iinfo(np.int64).max)))
-        return data[tuple(sl)]
-    starts, ends = np.atleast_1d(ins[1]), np.atleast_1d(ins[2])
-    axes = np.atleast_1d(ins[3]) if len(ins) > 3 and ins[3] is not None \
-        else range(len(starts))
-    steps = np.atleast_1d(ins[4]) if len(ins) > 4 and ins[4] is not None \
-        else [1] * len(starts)
+    else:
+        starts, ends = np.atleast_1d(ins[1]), np.atleast_1d(ins[2])
+        axes = np.atleast_1d(ins[3]) if len(ins) > 3 and ins[3] is not None \
+            else range(len(starts))
+        steps = np.atleast_1d(ins[4]) if len(ins) > 4 and ins[4] is not None \
+            else [1] * len(starts)
     sl = [slice(None)] * data.ndim
     for s, e, a, st in zip(starts, ends, axes, steps):
         sl[int(a)] = slice(int(s), int(min(e, np.iinfo(np.int64).max)),
