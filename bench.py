@@ -36,12 +36,29 @@ _PEAK_TFLOPS = [
 ]
 
 
-def _chip_peak_tflops(device_kind: str):
+# HBM bandwidth GB/s by generation (public spec sheets), for the roofline
+# readout: bound = memory when bytes/BW exceeds flops/peak.
+_PEAK_HBM_GBS = [
+    ("v6", 1638.0), ("trillium", 1638.0),
+    ("v5p", 2765.0),
+    ("v5 lite", 819.0), ("v5e", 819.0), ("v5litepod", 819.0),
+    ("v5", 2765.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+]
+
+
+def _chip_peak(device_kind: str, table):
     kind = device_kind.lower()
-    for key, peak in _PEAK_TFLOPS:
+    for key, peak in table:
         if key in kind:
             return peak
     return None
+
+
+def _chip_peak_tflops(device_kind: str):
+    return _chip_peak(device_kind, _PEAK_TFLOPS)
 
 
 def main():
@@ -58,6 +75,11 @@ def main():
                         "distribution")
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--gpt-dim", type=int, default=512,
+                   help="gpt model width (dim 2048 reaches ~62%% MFU on "
+                        "v5e; dim 512 is the parity-scale default)")
+    p.add_argument("--gpt-layers", type=int, default=4)
+    p.add_argument("--gpt-heads", type=int, default=8)
     p.add_argument("--amp", action="store_true", default=None,
                    help="mixed precision: bf16 compute, fp32 master "
                         "weights (compile(amp='bfloat16')). Default: on "
@@ -86,7 +108,8 @@ def main():
         seq = args.size if args.size > 32 else 512
         vocab = 8192
         m = models.create_model("gpt", vocab_size=vocab, max_seq=seq,
-                                dim=512, num_heads=8, num_layers=4)
+                                dim=args.gpt_dim, num_heads=args.gpt_heads,
+                                num_layers=args.gpt_layers)
         ids = rng.randint(0, vocab, (args.batch, seq)).astype(np.int32)
         tgt = np.roll(ids, -1, axis=1).astype(np.int32)
         tx = tensor.from_numpy(ids, device=dev)
@@ -142,7 +165,10 @@ def main():
     # ---- self-validation against physics ---------------------------------
     ca = m.step_cost_analysis()
     flops_per_step = float(ca.get("flops", 0.0)) if ca else 0.0
-    peak = _chip_peak_tflops(getattr(dev.jax_device, "device_kind", ""))
+    bytes_per_step = float(ca.get("bytes accessed", 0.0)) if ca else 0.0
+    kind = getattr(dev.jax_device, "device_kind", "")
+    peak = _chip_peak_tflops(kind)
+    peak_bw = _chip_peak(kind, _PEAK_HBM_GBS)
     # achieved rate from the amortized pipelined loop (the fenced per-call
     # numbers include the transfer round-trip, so they underestimate MFU)
     pipelined_s_per_step = elapsed / args.iters
@@ -150,6 +176,20 @@ def main():
                     if flops_per_step else None)
     mfu = model_tflops / peak if (model_tflops and peak) else None
     suspect = bool(mfu and mfu > 1.0)
+
+    # Roofline readout: which wall does this step lean on?  The bytes floor
+    # uses XLA's "bytes accessed" (an over-count of true HBM traffic — fused
+    # intermediates never reach HBM), so an effective BW above the chip's
+    # peak means fusion eliminated that much traffic, not broken physics.
+    compute_floor_ms = (flops_per_step / (peak * 1e12) * 1e3
+                        if (flops_per_step and peak) else None)
+    hbm_floor_ms = (bytes_per_step / (peak_bw * 1e9) * 1e3
+                    if (bytes_per_step and peak_bw) else None)
+    bound = None
+    if compute_floor_ms and hbm_floor_ms:
+        bound = "memory" if hbm_floor_ms > compute_floor_ms else "compute"
+    effective_bw_gbs = (bytes_per_step / pipelined_s_per_step / 1e9
+                        if bytes_per_step else None)
 
     # Headline: pipelined if physically plausible, else the fenced number.
     value = throughput_stepwise if suspect else throughput_pipelined
@@ -188,11 +228,19 @@ def main():
         "roundtrip_ms_p90": round(float(np.percentile(step_ms_arr, 90)), 3),
         "pipelined_ms_per_step": round(pipelined_s_per_step * 1e3, 3),
         "flops_per_step": flops_per_step,
-        "device_kind": getattr(dev.jax_device, "device_kind", "unknown"),
+        "bytes_per_step": bytes_per_step,
+        "device_kind": kind or "unknown",
         "peak_tflops_bf16": peak,
+        "peak_hbm_gbs": peak_bw,
         "model_tflops": round(model_tflops, 3) if model_tflops else None,
         "mfu_vs_peak": round(mfu, 4) if mfu else None,
         "mfu_suspect": suspect,
+        "compute_floor_ms": round(compute_floor_ms, 3)
+        if compute_floor_ms else None,
+        "hbm_floor_ms": round(hbm_floor_ms, 3) if hbm_floor_ms else None,
+        "roofline_bound": bound,
+        "effective_bw_gbs": round(effective_bw_gbs, 1)
+        if effective_bw_gbs else None,
         "final_loss": final_loss,
     }
     if note:

@@ -7,38 +7,42 @@ the identical import + preprocess + classify pipeline, checking parity
 against torch.
 """
 
-import numpy as np
-
 from utils import (check_vs_torch, fake_image, load_or_export,
                    preprocess_imagenet, run_imported, top5)
 
-CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
-       512, 512, 512, "M", 512, 512, 512, "M"]
+CFG_D = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"]
+CFG_E = [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"]
 
 
-def build_torch():
+def build_torch(cfg=CFG_D):
     import torch.nn as nn
     layers, c_in = [], 3
-    for v in CFG:
+    for v in cfg:
         if v == "M":
             layers.append(nn.MaxPool2d(2, 2))
         else:
             layers += [nn.Conv2d(c_in, v, 3, padding=1), nn.ReLU(True)]
             c_in = v
-    return __import__("torch").nn.Sequential(
+    return nn.Sequential(
         *layers, nn.Flatten(),
         nn.Linear(512 * 7 * 7, 4096), nn.ReLU(True), nn.Dropout(),
         nn.Linear(4096, 4096), nn.ReLU(True), nn.Dropout(),
         nn.Linear(4096, 1000))
 
 
-if __name__ == "__main__":
+def main(name="vgg16", cfg=CFG_D):
     import torch
     torch.manual_seed(0)
     x = preprocess_imagenet(fake_image())
-    proto, tm = load_or_export("vgg16", build_torch,
+    proto, tm = load_or_export(name, lambda: build_torch(cfg),
                                torch.from_numpy(x))
     (logits,) = run_imported(proto, [x])
     print("top-5:")
     top5(logits)
-    check_vs_torch(tm, [torch.from_numpy(x)], logits, name="vgg16")
+    check_vs_torch(tm, [torch.from_numpy(x)], logits, name=name)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,37 +1,7 @@
 """VGG19 ONNX import (ref examples/onnx/vgg19.py): vgg16's pipeline with
 the deeper E configuration."""
 
-import numpy as np
-
-from utils import (check_vs_torch, fake_image, load_or_export,
-                   preprocess_imagenet, run_imported, top5)
-
-CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
-       512, 512, 512, 512, "M", 512, 512, 512, 512, "M"]
-
-
-def build_torch():
-    import torch.nn as nn
-    layers, c_in = [], 3
-    for v in CFG:
-        if v == "M":
-            layers.append(nn.MaxPool2d(2, 2))
-        else:
-            layers += [nn.Conv2d(c_in, v, 3, padding=1), nn.ReLU(True)]
-            c_in = v
-    return __import__("torch").nn.Sequential(
-        *layers, nn.Flatten(),
-        nn.Linear(512 * 7 * 7, 4096), nn.ReLU(True), nn.Dropout(),
-        nn.Linear(4096, 4096), nn.ReLU(True), nn.Dropout(),
-        nn.Linear(4096, 1000))
-
+from vgg16 import CFG_E, main
 
 if __name__ == "__main__":
-    import torch
-    torch.manual_seed(0)
-    x = preprocess_imagenet(fake_image())
-    proto, tm = load_or_export("vgg19", build_torch, torch.from_numpy(x))
-    (logits,) = run_imported(proto, [x])
-    print("top-5:")
-    top5(logits)
-    check_vs_torch(tm, [torch.from_numpy(x)], logits, name="vgg19")
+    main(name="vgg19", cfg=CFG_E)
