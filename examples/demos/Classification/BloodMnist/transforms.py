@@ -1,0 +1,56 @@
+"""Image preprocessing transforms (ref examples/demos/Classification/
+BloodMnist/transforms.py).
+
+Same Compose / ToTensor / Normalize surface, numpy-native: each transform
+accepts either a PIL.Image or an HWC uint8 / float numpy array, so the
+pipeline also runs in the zero-egress sandbox where no image files exist.
+"""
+
+import numpy as np
+
+
+class Compose:
+    """Chain transforms; each stage's `forward` feeds the next."""
+
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def forward(self, img):
+        for t in self.transforms:
+            img = t.forward(img)
+        return img
+
+    def __repr__(self):
+        inner = "\n".join("    " + repr(t) for t in self.transforms)
+        return f"{self.__class__.__name__}(\n{inner}\n)"
+
+
+class ToTensor:
+    """PIL.Image or HWC uint8 array -> CHW float32 array in [0, 1]."""
+
+    def forward(self, pic):
+        arr = np.asarray(pic)
+        if arr.ndim == 2:
+            arr = arr[:, :, None].repeat(3, axis=2)
+        arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+        if arr.dtype == np.uint8:
+            return arr.astype(np.float32) / 255.0
+        return arr.astype(np.float32)
+
+    def __repr__(self):
+        return "ToTensor()"
+
+
+class Normalize:
+    """Per-channel (x - mean) / std on a CHW float array."""
+
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, dtype=np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, dtype=np.float32).reshape(-1, 1, 1)
+
+    def forward(self, img):
+        return (img - self.mean) / self.std
+
+    def __repr__(self):
+        return (f"Normalize(mean={self.mean.ravel().tolist()}, "
+                f"std={self.std.ravel().tolist()})")

@@ -1,0 +1,177 @@
+"""Two-phase model selection (ref examples/model_selection/Trails).
+
+TRAILS couples a training-free filtering phase with a training-based
+refinement phase over an MLP search space driven through the singa Model
+API (Trails/internal/ml/model_selection/src/eva_engine/phase1/algo/
+singa_ms/ms_model_mlp/model.py, prune_synflow.py). This is the same
+two-phase engine, TPU-native and self-contained:
+
+- search space: MLPs over a depth x width grid (MSMLP below);
+- phase 1: training-free proxies — SynFlow (|theta . dR/dtheta| with
+  abs-params and an all-ones input; Tanaka et al.) or GradNorm — one
+  forward+backward per candidate, no training;
+- phase 2 (coordinator): top-K survivors train briefly on the real
+  sklearn-digits set; highest validation accuracy wins.
+
+Run: python ms_mlp.py [--metric synflow|gradnorm] [--topk 3] [--epochs 3]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "cnn"))
+
+from singa_tpu import autograd, device, layer, model, opt, tensor  # noqa: E402
+
+
+class MSMLP(model.Model):
+    """Search-space member: `depth` hidden Linear+ReLU blocks of `width`
+    units (mirrors Trails' ms_model_mlp MLP through the Model API)."""
+
+    def __init__(self, depth, width, num_classes=10):
+        super().__init__()
+        self.depth, self.width = depth, width
+        self.hidden = []
+        for i in range(depth):
+            fc = layer.Linear(width)
+            setattr(self, f"fc{i}", fc)
+            self.hidden.append(fc)
+        self.head = layer.Linear(num_classes)
+        self.relu = layer.ReLU()
+        self.loss = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        for fc in self.hidden:
+            x = self.relu(fc(x))
+        return self.head(x)
+
+    def train_one_batch(self, x, y, dist_option="plain", spars=None):
+        out = self.forward(x)
+        loss = self.loss(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+# ---- phase 1: training-free scoring ------------------------------------
+
+def synflow_score(m, input_dim, dev):
+    """SynFlow: params <- |params|, R = sum(forward(ones)), score =
+    sum_theta |theta * dR/dtheta| (Trails prune_synflow.py semantics).
+    Data-free; runs eagerly through the autograd tape."""
+    params = m.get_params()
+    saved = {n: t.numpy().copy() for n, t in params.items()}
+    for t in params.values():
+        t.copy_from_numpy(np.abs(t.numpy()))
+    autograd.training = True
+    ones = tensor.Tensor(data=np.ones((1, input_dim), np.float32),
+                         device=dev)
+    out = m.forward(ones)
+    loss = autograd.reduce_sum(out, keepdims=False)
+    score = 0.0
+    for p, g in autograd.backward(loss):
+        score += float(np.abs(p.numpy() * g.numpy()).sum())
+    autograd.training = False
+    m.set_params(saved)
+    return score
+
+
+def gradnorm_score(m, x, y, dev):
+    """GradNorm proxy: L2 norm of the loss gradient on one real batch."""
+    autograd.training = True
+    tx = tensor.from_numpy(x, device=dev)
+    ty = tensor.from_numpy(y, device=dev)
+    loss = autograd.softmax_cross_entropy(m.forward(tx), ty)
+    score = 0.0
+    for p, g in autograd.backward(loss):
+        score += float((g.numpy() ** 2).sum())
+    autograd.training = False
+    return float(np.sqrt(score))
+
+
+# ---- phase 2: coordinator ----------------------------------------------
+
+def train_candidate(m, data, dev, epochs, batch, lr):
+    xtr, ytr, xva, yva = data
+    if batch > min(len(xtr), len(xva)):
+        raise ValueError(f"batch {batch} exceeds a split "
+                         f"(train {len(xtr)}, val {len(xva)})")
+    tx = tensor.from_numpy(xtr[:batch], device=dev)
+    ty = tensor.from_numpy(ytr[:batch], device=dev)
+    m.set_optimizer(opt.SGD(lr=lr, momentum=0.9))
+    m.compile([tx], is_train=True, use_graph=True)
+    n_batch = len(xtr) // batch
+    for _ in range(epochs):
+        m.train()
+        for b in range(n_batch):
+            tx.copy_from_numpy(xtr[b * batch:(b + 1) * batch])
+            ty.copy_from_numpy(ytr[b * batch:(b + 1) * batch])
+            m(tx, ty)
+    m.eval()
+    correct = 0
+    for b in range(len(xva) // batch):
+        tx.copy_from_numpy(xva[b * batch:(b + 1) * batch])
+        out = tensor.to_numpy(m(tx))
+        correct += int((np.argmax(out, 1)
+                        == yva[b * batch:(b + 1) * batch]).sum())
+    return correct / (len(xva) // batch * batch)
+
+
+def load_digits_flat():
+    from data import digits
+    xtr, ytr, xva, yva = digits.load(upscale=1)
+    return (xtr.reshape(len(xtr), -1), ytr,
+            xva.reshape(len(xva), -1), yva)
+
+
+def search(args):
+    dev = device.best_device()
+    data = load_digits_flat()
+    input_dim = data[0].shape[1]
+    space = [(d, w) for d in args.depths for w in args.widths]
+    print(f"search space: {len(space)} MLPs (depth x width), "
+          f"phase-1 metric: {args.metric}")
+
+    scored = []
+    for d, w in space:
+        m = MSMLP(d, w)
+        tx = tensor.Tensor(data=np.zeros((1, input_dim), np.float32),
+                           device=dev)
+        m.compile([tx], is_train=False, use_graph=False)
+        if args.metric == "synflow":
+            s = synflow_score(m, input_dim, dev)
+        else:
+            s = gradnorm_score(m, data[0][:64], data[1][:64], dev)
+        scored.append((s, d, w))
+        print(f"  depth={d} width={w}: {args.metric}={s:.4g}")
+
+    scored.sort(reverse=True)
+    survivors = scored[:args.topk]
+    print(f"phase 2: training top-{args.topk} on sklearn-digits")
+    best = None
+    for s, d, w in survivors:
+        acc = train_candidate(MSMLP(d, w), data, dev, args.epochs,
+                              args.batch, args.lr)
+        print(f"  depth={d} width={w}: val acc {acc:.4f}")
+        if best is None or acc > best[0]:
+            best = (acc, d, w)
+    print("selected: depth=%d width=%d (val acc %.4f)"
+          % (best[1], best[2], best[0]))
+    return best
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--metric", choices=["synflow", "gradnorm"],
+                   default="synflow")
+    p.add_argument("--depths", type=int, nargs="+", default=[1, 2, 3])
+    p.add_argument("--widths", type=int, nargs="+",
+                   default=[64, 128, 256, 512])
+    p.add_argument("--topk", type=int, default=3)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.05)
+    search(p.parse_args())

@@ -1,0 +1,90 @@
+"""SINGA-Easy explanation demo (ref examples/singa_easy: model plugins with
+LIME explanations for SINGA-Auto).
+
+Trains a small CNN on a synthetic task whose class signal lives in one
+image quadrant, then asks the Lime explainer which superpixels drive the
+prediction. A correct explanation concentrates on the signal quadrant.
+
+Run: python demo.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from singa_tpu import device, layer, model, opt, tensor  # noqa: E402
+from singa_easy.modules.explanations.lime import Lime  # noqa: E402
+
+SIZE = 28
+MEAN, STD = [0.5, 0.5, 0.5], [0.5, 0.5, 0.5]
+
+
+class SmallCNN(model.Model):
+    def __init__(self, num_classes=2):
+        super().__init__()
+        self.conv1 = layer.Conv2d(8, kernel_size=3, padding=1,
+                                  activation="RELU")
+        self.pool = layer.MaxPool2d(kernel_size=2, stride=2)
+        self.conv2 = layer.Conv2d(16, kernel_size=3, padding=1,
+                                  activation="RELU")
+        self.flatten = layer.Flatten()
+        self.fc = layer.Linear(num_classes)
+        self.loss = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        x = self.pool(self.conv1(x))
+        x = self.pool(self.conv2(x))
+        return self.fc(self.flatten(x))
+
+    def train_one_batch(self, x, y, dist_option="plain", spars=None):
+        out = self.forward(x)
+        loss = self.loss(out, y)
+        self.optimizer(loss)
+        return out, loss
+
+
+def make_data(n, seed=0):
+    """Class 1 iff the top-left 10x10 quadrant carries a bright patch."""
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(0, 0.3, (n, SIZE, SIZE, 3)).astype(np.float32)
+    y = rng.randint(0, 2, n).astype(np.int32)
+    x[y == 1, 2:10, 2:10, :] += 0.6
+    return x, y
+
+
+def main():
+    dev = device.best_device()
+    x, y = make_data(512)
+    xn = ((x.transpose(0, 3, 1, 2)
+           - np.asarray(MEAN, np.float32).reshape(-1, 1, 1))
+          / np.asarray(STD, np.float32).reshape(-1, 1, 1))
+
+    m = SmallCNN()
+    m.set_optimizer(opt.Adam(lr=1e-3))
+    tx = tensor.from_numpy(xn[:64], device=dev)
+    ty = tensor.from_numpy(y[:64], device=dev)
+    m.compile([tx], is_train=True, use_graph=True)
+    for epoch in range(5):
+        for b in range(len(x) // 64):
+            tx.copy_from_numpy(xn[b * 64:(b + 1) * 64])
+            ty.copy_from_numpy(y[b * 64:(b + 1) * 64])
+            out, loss = m(tx, ty)
+        print("epoch %d loss %.4f" % (epoch, float(tensor.to_numpy(loss))))
+
+    explainer = Lime(m, SIZE, MEAN, STD, dev, num_samples=128, grid=7)
+    xe, ye = make_data(8, seed=3)
+    pos = xe[ye == 1][:1]
+    _, mask = explainer.get_image_and_mask(pos[0], num_features=5)
+    frac_in_quadrant = mask[:14, :14].mean() / max(mask.mean(), 1e-9)
+    print("explained-region concentration in signal quadrant: %.2fx "
+          "uniform" % frac_in_quadrant)
+    marked = explainer.explain(pos)
+    print("boundary-marked image:", marked.shape, marked.dtype)
+    return frac_in_quadrant
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+"""Tests for the §2.12 long-tail examples: demos/BloodMnist, singa_easy
+LIME explanations, model_selection (TRAILS-style two-phase NAS)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(REPO, "examples", "demos",
+                                "Classification", "BloodMnist"))
+sys.path.insert(0, os.path.join(REPO, "examples", "singa_easy"))
+sys.path.insert(0, os.path.join(REPO, "examples", "model_selection"))
+sys.path.insert(0, os.path.join(REPO, "examples", "cnn"))
+
+
+class TestBloodMnistDemo:
+    def test_transforms(self):
+        from transforms import Compose, Normalize, ToTensor
+        t = Compose([ToTensor(),
+                     Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+        img = (np.random.RandomState(0).uniform(0, 255, (28, 28, 3))
+               .astype(np.uint8))
+        out = t.forward(img)
+        assert out.shape == (3, 28, 28)
+        assert out.dtype == np.float32
+        ref = (img.transpose(2, 0, 1).astype(np.float32) / 255.0 - 0.5) / 0.5
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_synthetic_training_converges(self):
+        import ClassDemo
+        args = ClassDemo.argparse.Namespace(
+            data="/nonexistent", epochs=3, batch=64, lr=1e-3,
+            synthetic_n=512, graph=True)
+        acc = ClassDemo.run(args)
+        assert acc > 0.8, f"BloodMnist demo eval acc {acc}"
+
+
+class TestLime:
+    def _trained_model(self):
+        from demo import SmallCNN, make_data, MEAN, STD, SIZE
+        from singa_tpu import device, opt, tensor
+        dev = device.best_device()
+        x, y = make_data(256)
+        xn = ((x.transpose(0, 3, 1, 2)
+               - np.asarray(MEAN, np.float32).reshape(-1, 1, 1))
+              / np.asarray(STD, np.float32).reshape(-1, 1, 1))
+        m = SmallCNN()
+        m.set_optimizer(opt.Adam(lr=1e-3))
+        tx = tensor.from_numpy(xn[:64], device=dev)
+        ty = tensor.from_numpy(y[:64], device=dev)
+        m.compile([tx], is_train=True, use_graph=True)
+        for _ in range(4):
+            for b in range(len(x) // 64):
+                tx.copy_from_numpy(xn[b * 64:(b + 1) * 64])
+                ty.copy_from_numpy(y[b * 64:(b + 1) * 64])
+                m(tx, ty)
+        return m, dev
+
+    def test_explanation_finds_signal_quadrant(self):
+        from demo import make_data, MEAN, STD, SIZE
+        from singa_easy.modules.explanations.lime import Lime
+        m, dev = self._trained_model()
+        explainer = Lime(m, SIZE, MEAN, STD, dev, num_samples=128, grid=7)
+        xe, ye = make_data(8, seed=3)
+        pos = xe[ye == 1][0]
+        temp, mask = explainer.get_image_and_mask(pos, num_features=5)
+        assert mask.shape == (SIZE, SIZE)
+        assert mask.sum() > 0
+        # the class signal lives in [2:10, 2:10]; the explanation must
+        # weight that quadrant more than uniform
+        concentration = mask[:14, :14].mean() / max(mask.mean(), 1e-9)
+        assert concentration > 1.5, f"concentration {concentration}"
+
+    def test_mark_boundaries(self):
+        from singa_easy.modules.explanations.lime import _mark_boundaries
+        img = np.zeros((8, 8, 3), np.float32)
+        mask = np.zeros((8, 8), np.uint8)
+        mask[2:5, 2:5] = 1
+        out = _mark_boundaries(img, mask)
+        assert out[2, 2].tolist() == [1.0, 1.0, 0.0]  # boundary painted
+        assert out[0, 0].tolist() == [0.0, 0.0, 0.0]  # interior untouched
+        assert out[3, 3].tolist() == [0.0, 0.0, 0.0]
+
+
+class TestModelSelection:
+    def test_synflow_scores_data_free_and_param_preserving(self):
+        import ms_mlp
+        from singa_tpu import device, tensor
+        dev = device.best_device()
+        m = ms_mlp.MSMLP(2, 32)
+        tx = tensor.Tensor(data=np.zeros((1, 64), np.float32), device=dev)
+        m.compile([tx], is_train=False, use_graph=False)
+        before = {n: t.numpy().copy() for n, t in m.get_params().items()}
+        s = ms_mlp.synflow_score(m, 64, dev)
+        assert s > 0
+        after = m.get_params()
+        for n in before:  # scoring must not corrupt the weights
+            np.testing.assert_allclose(before[n], after[n].numpy())
+
+    def test_search_selects_trainable_model(self):
+        import ms_mlp
+        args = ms_mlp.argparse.Namespace(
+            metric="synflow", depths=[1, 2], widths=[32, 64],
+            topk=1, epochs=2, batch=64, lr=0.05)
+        acc, d, w = ms_mlp.search(args)
+        assert acc > 0.8, f"selected model only reached {acc}"
+
+    def test_gradnorm_metric(self):
+        import ms_mlp
+        from singa_tpu import device, tensor
+        dev = device.best_device()
+        m = ms_mlp.MSMLP(1, 32)
+        tx = tensor.Tensor(data=np.zeros((1, 64), np.float32), device=dev)
+        m.compile([tx], is_train=False, use_graph=False)
+        x = np.random.RandomState(0).standard_normal((16, 64)).astype(
+            np.float32)
+        y = np.random.RandomState(1).randint(0, 10, 16).astype(np.int32)
+        assert ms_mlp.gradnorm_score(m, x, y, dev) > 0
