@@ -33,18 +33,20 @@ class ClassDataset:
     def __init__(self, img_folder, transforms):
         self.img_list = []
         self.transforms = transforms
-        for cls in sorted(os.listdir(img_folder)):
+        # label = index into the sorted class-folder list, so non-numeric
+        # and non-0-based folder names both map into [0, num_classes)
+        for label, cls in enumerate(sorted(os.listdir(img_folder))):
             for img in glob(os.path.join(img_folder, cls, "*")):
-                self.img_list.append((img, cls))
+                self.img_list.append((img, label))
 
     def __len__(self):
         return len(self.img_list)
 
     def __getitem__(self, index):
         from PIL import Image
-        img_path, label_str = self.img_list[index]
+        img_path, label = self.img_list[index]
         img = self.transforms.forward(Image.open(img_path))
-        return img, np.array(label_str, dtype=np.int32)
+        return img, np.int32(label)
 
     def batchgenerator(self, indexes, batch_size, data_size):
         batch_x = np.zeros((batch_size,) + data_size, dtype=np.float32)

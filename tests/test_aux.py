@@ -39,6 +39,74 @@ def test_snapshot_roundtrip(tmp_path):
     assert os.path.exists(p + ".meta")
 
 
+def test_snapshot_native_backend(tmp_path):
+    """The C++ binfile backend: multi-dtype roundtrip + corruption CRC."""
+    from singa_tpu import native
+    if native.snapshot_lib() is None:
+        import pytest
+        pytest.skip("no C++ toolchain")
+    import ml_dtypes
+    p = str(tmp_path / "snap")
+    vals = {
+        "w": np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+        "half": np.arange(6, dtype=np.float16),
+        "bf": np.arange(8).astype(ml_dtypes.bfloat16),
+        "ids": np.array([[1, 2], [3, 4]], np.int64),
+        "scalar": np.float32(7.5).reshape(()),
+    }
+    with snapshot.Snapshot(p, True) as s:
+        for k, v in vals.items():
+            s.write(k, v)
+    assert os.path.exists(p + ".bin")       # native format was chosen
+    assert not os.path.exists(p + ".npz")
+    r = snapshot.Snapshot(p, False)
+    assert sorted(r.names()) == sorted(vals)
+    for k, v in vals.items():
+        got = r.read(k).numpy()
+        assert got.shape == v.shape
+        np.testing.assert_array_equal(
+            got.astype(np.float64), np.asarray(v).astype(np.float64))
+
+    # flip one byte inside the last value -> CRC must catch it
+    with open(p + ".bin", "r+b") as f:
+        f.seek(-8, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-8, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+    import pytest
+    with pytest.raises(OSError, match="corrupt"):
+        snapshot.Snapshot(p, False)
+
+
+def test_snapshot_reflush_removes_stale_format(tmp_path, monkeypatch):
+    """npz re-flush of a prefix that previously held a .bin must not leave
+    the stale .bin shadowing the fresh npz on a later native-capable read."""
+    from singa_tpu import native
+    if native.snapshot_lib() is None:
+        import pytest
+        pytest.skip("no C++ toolchain")
+    p = str(tmp_path / "snap")
+    with snapshot.Snapshot(p, True) as s:
+        s.write("w", np.zeros(4, np.float32))
+    assert os.path.exists(p + ".bin")
+    monkeypatch.setattr(native, "snapshot_lib", lambda: None)
+    with snapshot.Snapshot(p, True) as s:
+        s.write("w", np.ones(4, np.float32))
+    monkeypatch.undo()
+    r = snapshot.Snapshot(p, False)
+    np.testing.assert_array_equal(r.read("w").numpy(),
+                                  np.ones(4, np.float32))
+
+
+def test_snapshot_npz_compat(tmp_path):
+    """A .npz written externally still loads (backend auto-detect)."""
+    p = str(tmp_path / "legacy")
+    np.savez(p + ".npz", w=np.ones(4, np.float32))
+    r = snapshot.Snapshot(p, False)
+    np.testing.assert_array_equal(r.read("w").numpy(),
+                                  np.ones(4, np.float32))
+
+
 def test_channel_file(tmp_path, capsys):
     channel.InitChannel(str(tmp_path))
     ch = channel.GetChannel("train")
