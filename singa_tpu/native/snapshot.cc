@@ -39,7 +39,8 @@
 namespace {
 
 constexpr char kMagic[9] = "STPUSNP1";
-constexpr uint64_t kMaxLen = 1ull << 40;   // corrupt-length guard
+constexpr uint64_t kMaxKeyLen = 1ull << 20;   // corrupt-frame guards: keys
+constexpr uint64_t kMaxValLen = 1ull << 34;   // <=1 MB, values <=16 GB
 constexpr uint64_t kQueueCap = 256ull << 20;  // pending-bytes bound (256 MB)
 
 const uint32_t* crc_table() {
@@ -201,16 +202,18 @@ void* snp_reader_open(const char* path) {
   return r;
 }
 
-// Returns 1 with the next record, 0 at EOF, -1 on corruption (bad frame
-// or CRC mismatch). Out-pointers are owned by the reader.
+// Returns 1 with the next record, 0 at EOF, -1 on corruption (bad frame,
+// CRC mismatch, or an unallocatable corrupt length — the try/catch keeps
+// bad_alloc from escaping the C ABI and aborting the host process).
+// Out-pointers are owned by the reader.
 int snp_reader_next(void* h, const char** key, const char** dtype,
                     uint8_t* ndim, const uint64_t** dims,
-                    const char** data, uint64_t* nbytes) {
+                    const char** data, uint64_t* nbytes) try {
   Reader* r = static_cast<Reader*>(h);
   uint32_t klen;
   size_t got = fread(&klen, 4, 1, r->f);
   if (got != 1) return feof(r->f) ? 0 : -1;
-  if (klen > kMaxLen) return -1;
+  if (klen > kMaxKeyLen) return -1;
   r->cur.key.resize(klen);
   if (klen && fread(&r->cur.key[0], 1, klen, r->f) != klen) return -1;
   uint8_t dlen;
@@ -224,7 +227,7 @@ int snp_reader_next(void* h, const char** key, const char** dtype,
     if (fread(&r->cur.dims[i], 8, 1, r->f) != 1) return -1;
   uint64_t nb;
   if (fread(&nb, 8, 1, r->f) != 1) return -1;
-  if (nb > kMaxLen) return -1;
+  if (nb > kMaxValLen) return -1;
   r->cur.val.resize(nb);
   if (nb && fread(&r->cur.val[0], 1, nb, r->f) != nb) return -1;
   uint32_t crc_stored;
@@ -237,6 +240,8 @@ int snp_reader_next(void* h, const char** key, const char** dtype,
   *data = r->cur.val.data();
   *nbytes = nb;
   return 1;
+} catch (...) {
+  return -1;
 }
 
 void snp_reader_close(void* h) {

@@ -58,16 +58,22 @@ class Snapshot:
     def flush(self):
         if not self.mode_write:
             return
-        lb = native.snapshot_lib()
+        # an explicit extension pins the backend; only extensionless
+        # prefixes auto-select (native preferred)
+        lb = None if self.fpath.endswith(".npz") else native.snapshot_lib()
+        if self.fpath.endswith(".bin") and lb is None:
+            raise OSError("explicit .bin path requested but no C++ "
+                          "toolchain is available")
         if lb is not None:
             self._flush_native(lb)
             stale = self._prefix() + ".npz"
         else:
             np.savez(self._prefix() + ".npz", **self._store)
             stale = self._prefix() + ".bin"
-        # a leftover other-format file from an earlier flush would shadow
-        # (or confuse) this one on read — remove it
-        if os.path.exists(stale):
+        # a leftover other-format file from an earlier flush of the same
+        # extensionless prefix would shadow this one on read — remove it
+        if not self.fpath.endswith((".npz", ".bin")) \
+                and os.path.exists(stale):
             os.remove(stale)
         meta = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
                 for k, v in self._store.items()}
@@ -141,6 +147,18 @@ class Snapshot:
                 self._store[key.value.decode()] = arr.reshape(shape).copy()
         finally:
             lb.snp_reader_close(h)
+        # a file truncated exactly at a record boundary reads as clean
+        # EOF; cross-check against the .meta manifest when present
+        meta_path = self._prefix() + ".meta"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                expected = set(json.load(f))
+            missing = expected - set(self._store)
+            if missing:
+                raise OSError(
+                    f"truncated snapshot {path}: missing "
+                    f"{sorted(missing)[:5]} (and possibly more) "
+                    "per the .meta manifest")
 
     def read(self, param_name: str) -> Tensor:
         assert not self.mode_write

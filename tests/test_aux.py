@@ -78,6 +78,40 @@ def test_snapshot_native_backend(tmp_path):
         snapshot.Snapshot(p, False)
 
 
+def test_snapshot_truncation_detected(tmp_path):
+    """A .bin cut at a record boundary must not load silently short."""
+    from singa_tpu import native
+    if native.snapshot_lib() is None:
+        import pytest
+        pytest.skip("no C++ toolchain")
+    p = str(tmp_path / "snap")
+    with snapshot.Snapshot(p, True) as s:
+        s.write("a", np.zeros(4, np.float32))
+        s.write("b", np.ones(4, np.float32))
+    # find where record "a" ends: rewrite the file keeping the first
+    # record only (header 8 + rec_a).
+    # rec = klen(4)+key(1)+dlen(1)+dtype(7)+ndim(1)+dims(8)+nbytes(8)
+    #       +val(16)+crc(4) = 50 bytes
+    raw = open(p + ".bin", "rb").read()
+    rec_a = 4 + len("a") + 1 + len("float32") + 1 + 8 + 8 + 16 + 4
+    with open(p + ".bin", "wb") as f:
+        f.write(raw[:8 + rec_a])
+    import pytest
+    with pytest.raises(OSError, match="truncated"):
+        snapshot.Snapshot(p, False)
+
+
+def test_snapshot_explicit_npz_path_pins_backend(tmp_path):
+    p = str(tmp_path / "snap.npz")
+    with snapshot.Snapshot(p, True) as s:
+        s.write("w", np.ones(3, np.float32))
+    assert os.path.exists(p)
+    assert not os.path.exists(str(tmp_path / "snap.bin"))
+    r = snapshot.Snapshot(p, False)
+    np.testing.assert_array_equal(r.read("w").numpy(),
+                                  np.ones(3, np.float32))
+
+
 def test_snapshot_reflush_removes_stale_format(tmp_path, monkeypatch):
     """npz re-flush of a prefix that previously held a .bin must not leave
     the stale .bin shadowing the fresh npz on a later native-capable read."""

@@ -1,0 +1,88 @@
+"""KV-cached autoregressive decoding vs the full-forward reference path."""
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, models, tensor
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=97, max_seq=64, dim=64,
+                            num_heads=4, num_layers=2)
+    ids = tensor.from_numpy(
+        np.random.RandomState(0).randint(0, 97, (2, 8)).astype(np.int32),
+        device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    return m, dev
+
+
+def _naive_greedy(m, dev, prompt, n_new):
+    """No cache: rerun the full forward on the growing sequence."""
+    ids = prompt.copy()
+    for _ in range(n_new):
+        t = tensor.from_numpy(ids.astype(np.int32), device=dev)
+        logits = tensor.to_numpy(m(t))          # (B, S, V)
+        nxt = np.argmax(logits[:, -1], axis=-1).astype(np.int32)
+        ids = np.concatenate([ids, nxt[:, None]], axis=1)
+    return ids
+
+
+def test_greedy_matches_full_forward(gpt):
+    m, dev = gpt
+    prompt = np.random.RandomState(1).randint(0, 97, (2, 8))
+    want = _naive_greedy(m, dev, prompt, 6)
+    got = m.generate(prompt, 6, temperature=0.0)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_zero_tokens(gpt):
+    m, _ = gpt
+    prompt = np.random.RandomState(5).randint(0, 97, (2, 4))
+    out = m.generate(prompt, 0)
+    np.testing.assert_array_equal(out, prompt)
+
+
+def test_generate_single_token(gpt):
+    m, dev = gpt
+    prompt = np.random.RandomState(2).randint(0, 97, (1, 5))
+    got = m.generate(prompt, 1)
+    assert got.shape == (1, 6)
+    np.testing.assert_array_equal(got, _naive_greedy(m, dev, prompt, 1))
+
+
+def test_sampling_modes(gpt):
+    m, _ = gpt
+    prompt = np.random.RandomState(3).randint(0, 97, (2, 4))
+    a = m.generate(prompt, 5, temperature=0.8, top_k=10, seed=0)
+    b = m.generate(prompt, 5, temperature=0.8, top_k=10, seed=0)
+    c = m.generate(prompt, 5, temperature=0.8, top_k=10, seed=1)
+    assert a.shape == (2, 9)
+    np.testing.assert_array_equal(a, b)     # same seed -> same draw
+    assert (a[:, 4:] >= 0).all() and (a[:, 4:] < 97).all()
+    assert c.shape == a.shape               # different seed: valid draw too
+
+
+def test_bf16_decode(gpt):
+    m, _ = gpt
+    prompt = np.random.RandomState(4).randint(0, 97, (2, 6))
+    a = m.generate(prompt, 4, dtype="bfloat16")
+    b = m.generate(prompt, 4, dtype="bfloat16")
+    assert a.shape == (2, 10)
+    np.testing.assert_array_equal(a, b)  # deterministic greedy
+    assert (a[:, 6:] >= 0).all() and (a[:, 6:] < 97).all()
+
+
+def test_generate_before_compile_raises():
+    m = models.create_model("gpt", vocab_size=17, max_seq=16, dim=32,
+                            num_heads=2, num_layers=1)
+    with pytest.raises(RuntimeError, match="compile"):
+        m.generate(np.zeros((1, 3), np.int32), 2)
+
+
+def test_overlong_generation_raises(gpt):
+    m, _ = gpt
+    with pytest.raises(AssertionError, match="max_seq"):
+        m.generate(np.zeros((1, 60), np.int32), 10)
