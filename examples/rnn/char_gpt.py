@@ -1,0 +1,123 @@
+"""Character-level GPT: train + KV-cached generation, end to end.
+
+The transformer companion to char_rnn.py (the reference has no native
+transformer; SURVEY.md §2.3). Trains the flagship GPT on a text corpus —
+by default this framework's own source code, the one real text available
+in the zero-egress sandbox — then samples continuations through
+`GPT.generate()` (one jitted prefill + scan decode with a KV cache).
+
+Usage: python char_gpt.py [corpus.txt] [--epochs 5] [--sample 256]
+"""
+
+import argparse
+import glob
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from singa_tpu import device, models, opt, tensor  # noqa: E402
+
+
+def load_corpus(path=None, max_bytes=500_000):
+    if path:
+        with open(path) as f:
+            return f.read()[:max_bytes]
+    # self-corpus: the framework's own .py sources
+    root = os.path.join(os.path.dirname(__file__), "..", "..", "singa_tpu")
+    text = []
+    n = 0
+    for p in sorted(glob.glob(os.path.join(root, "**", "*.py"),
+                              recursive=True)):
+        with open(p) as f:
+            s = f.read()
+        text.append(s)
+        n += len(s)
+        if n > max_bytes:
+            break
+    return "".join(text)[:max_bytes]
+
+
+class CharData:
+    def __init__(self, text, batch, seq):
+        chars = sorted(set(text))
+        self.stoi = {c: i for i, c in enumerate(chars)}
+        self.itos = chars
+        self.vocab = len(chars)
+        ids = np.array([self.stoi[c] for c in text], np.int32)
+        n = (len(ids) - 1) // seq
+        self.x = ids[:n * seq].reshape(n, seq)
+        self.y = ids[1:n * seq + 1].reshape(n, seq)
+        self.batch, self.seq = batch, seq
+        self.num_batches = n // batch
+
+    def batches(self, rng):
+        order = rng.permutation(len(self.x))
+        for b in range(self.num_batches):
+            sel = order[b * self.batch:(b + 1) * self.batch]
+            yield self.x[sel], self.y[sel]
+
+    def encode(self, s):
+        return np.array([[self.stoi[c] for c in s if c in self.stoi]],
+                        np.int32)
+
+    def decode(self, ids):
+        return "".join(self.itos[i] for i in ids)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("corpus", nargs="?", default=None)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--seq", type=int, default=256)
+    p.add_argument("--dim", type=int, default=256)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--sample", type=int, default=256,
+                   help="chars to sample after training")
+    p.add_argument("--prompt", default="def forward(self, x):")
+    args = p.parse_args()
+
+    text = load_corpus(args.corpus)
+    data = CharData(text, args.batch, args.seq)
+    print(f"corpus: {len(text)} chars, vocab {data.vocab}, "
+          f"{data.num_batches} batches/epoch")
+
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=data.vocab, max_seq=args.seq,
+                            dim=args.dim, num_heads=max(1, args.dim // 64),
+                            num_layers=args.layers)
+    m.set_optimizer(opt.Adam(lr=args.lr))
+    tx = tensor.Tensor((args.batch, args.seq), device=dev,
+                       dtype=tensor.int32)
+    ty = tensor.Tensor((args.batch, args.seq), device=dev,
+                       dtype=tensor.int32)
+    m.compile([tx], is_train=True, use_graph=True, amp="bfloat16")
+
+    rng = np.random.RandomState(0)
+    for epoch in range(args.epochs):
+        t0, losses = time.time(), []
+        m.train()
+        for xb, yb in data.batches(rng):
+            tx.copy_from_numpy(xb)
+            ty.copy_from_numpy(yb)
+            _, loss = m(tx, ty)
+            losses.append(float(tensor.to_numpy(loss)))
+        print("epoch %d: loss %.3f (%.1fs)"
+              % (epoch, np.mean(losses), time.time() - t0))
+
+    m.eval()
+    prompt = data.encode(args.prompt)
+    n_new = min(args.sample, args.seq - prompt.shape[1])
+    out = m.generate(prompt, n_new, temperature=0.8, top_k=40,
+                     dtype="bfloat16")
+    print("--- sample ---")
+    print(data.decode(out[0]))
+
+
+if __name__ == "__main__":
+    main()

@@ -236,6 +236,8 @@ class GPT(model.Model):
         assert max_new_tokens >= 0, "max_new_tokens must be >= 0"
         if max_new_tokens == 0:
             return ids.astype(np.int32).copy()
+        if top_k is not None:
+            top_k = max(1, min(int(top_k), self.vocab_size))
         B, S0 = ids.shape
         sig = (B, S0, max_new_tokens, float(temperature), top_k, dtype)
         cache = getattr(self, "_decode_cache", None)

@@ -154,6 +154,9 @@ int snp_writer_write(void* h, const char* key, const char* dtype,
                      uint8_t ndim, const uint64_t* dims, const char* data,
                      uint64_t nbytes) {
   Writer* w = static_cast<Writer*>(h);
+  // mirror the reader's frame guards: anything accepted here must be
+  // readable back
+  if ((key && strlen(key) > kMaxKeyLen) || nbytes > kMaxValLen) return -1;
   Entry e;
   e.key = key ? key : "";
   e.dtype = dtype ? dtype : "";

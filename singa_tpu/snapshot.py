@@ -104,17 +104,16 @@ class Snapshot:
 
     def _load(self):
         prefix = self._prefix()
-        bin_path = self.fpath if self.fpath.endswith(".bin") \
-            else prefix + ".bin"
-        npz_path = self.fpath if self.fpath.endswith(".npz") \
-            else prefix + ".npz"
+        # explicit extension pins the backend on read too (mirrors flush)
+        bin_path = None if self.fpath.endswith(".npz") else prefix + ".bin"
+        npz_path = None if self.fpath.endswith(".bin") else prefix + ".npz"
         lb = native.snapshot_lib()
-        if os.path.exists(bin_path) and lb is not None:
+        if bin_path and os.path.exists(bin_path) and lb is not None:
             self._load_native(lb, bin_path)
-        elif os.path.exists(npz_path):
+        elif npz_path and os.path.exists(npz_path):
             with np.load(npz_path) as z:
                 self._store = {k: z[k] for k in z.files}
-        elif os.path.exists(bin_path):
+        elif bin_path and os.path.exists(bin_path):
             raise OSError(f"{bin_path} needs the native reader but no "
                           "C++ toolchain is available")
         else:

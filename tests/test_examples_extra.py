@@ -13,6 +13,7 @@ sys.path.insert(0, os.path.join(REPO, "examples", "demos",
 sys.path.insert(0, os.path.join(REPO, "examples", "singa_easy"))
 sys.path.insert(0, os.path.join(REPO, "examples", "model_selection"))
 sys.path.insert(0, os.path.join(REPO, "examples", "cnn"))
+sys.path.insert(0, os.path.join(REPO, "examples", "rnn"))
 
 
 class TestBloodMnistDemo:
@@ -82,6 +83,36 @@ class TestLime:
         assert out[2, 2].tolist() == [1.0, 1.0, 0.0]  # boundary painted
         assert out[0, 0].tolist() == [0.0, 0.0, 0.0]  # interior untouched
         assert out[3, 3].tolist() == [0.0, 0.0, 0.0]
+
+
+class TestCharGPT:
+    def test_train_and_sample(self):
+        import char_gpt
+        from singa_tpu import device, models, opt, tensor
+        text = char_gpt.load_corpus(max_bytes=20_000)
+        assert len(text) > 1000      # self-corpus found
+        data = char_gpt.CharData(text, batch=8, seq=64)
+        dev = device.best_device()
+        m = models.create_model("gpt", vocab_size=data.vocab, max_seq=64,
+                                dim=64, num_heads=2, num_layers=2)
+        m.set_optimizer(opt.Adam(lr=3e-3))
+        tx = tensor.Tensor((8, 64), device=dev, dtype=tensor.int32)
+        ty = tensor.Tensor((8, 64), device=dev, dtype=tensor.int32)
+        m.compile([tx], is_train=True, use_graph=True)
+        rng = np.random.RandomState(0)
+        first = last = None
+        for xb, yb in data.batches(rng):
+            tx.copy_from_numpy(xb)
+            ty.copy_from_numpy(yb)
+            _, loss = m(tx, ty)
+            last = float(tensor.to_numpy(loss))
+            first = first if first is not None else last
+        assert last < first          # learns within one epoch
+        m.eval()
+        prompt = data.encode("def ")
+        out = m.generate(prompt, 16, temperature=0.8, top_k=10)
+        text_out = data.decode(out[0])
+        assert len(text_out) == len("def ") + 16
 
 
 class TestModelSelection:
