@@ -84,6 +84,10 @@ def main():
 
     text = load_corpus(args.corpus)
     data = CharData(text, args.batch, args.seq)
+    if data.num_batches == 0:
+        sys.exit(f"corpus too small: need > batch*seq+1 = "
+                 f"{args.batch * args.seq + 1} chars, got {len(text)} "
+                 "(shrink --batch/--seq)")
     print(f"corpus: {len(text)} chars, vocab {data.vocab}, "
           f"{data.num_batches} batches/epoch")
 
@@ -112,6 +116,11 @@ def main():
 
     m.eval()
     prompt = data.encode(args.prompt)
+    if prompt.shape[1] == 0:
+        sys.exit(f"prompt {args.prompt!r} shares no characters with the "
+                 "corpus vocabulary")
+    # keep at most the prompt's last seq//2 chars so sampling has room
+    prompt = prompt[:, -(args.seq // 2):]
     n_new = min(args.sample, args.seq - prompt.shape[1])
     out = m.generate(prompt, n_new, temperature=0.8, top_k=40,
                      dtype="bfloat16")

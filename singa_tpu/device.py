@@ -16,6 +16,9 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+# process-global: jax.profiler allows one active trace per process
+_active_trace_dir: "str | None" = None
+
 
 class Device:
     """A compute device. Holds placement + graph/profiling policy + RNG."""
@@ -103,6 +106,31 @@ class Device:
             for k, v in sorted(self.cost_analysis.items()):
                 if isinstance(v, (int, float)):
                     print(f"  {k}: {v:.3g}")
+
+    # ---- trace capture ---------------------------------------------------
+    # The reference's deepest profiling level is per-op CUDA-event tables
+    # (scheduler.cc:276-295). The TPU analog is an xplane trace: per-HLO
+    # timelines viewable in TensorBoard/xprof/Perfetto. jax.profiler is
+    # process-global, so the active-trace flag lives at module level —
+    # Start/Stop pair up correctly across different Device objects.
+    def StartTrace(self, log_dir: str):
+        """Begin capturing a jax profiler trace into `log_dir`."""
+        global _active_trace_dir
+        if _active_trace_dir is not None:
+            raise RuntimeError(
+                f"a trace into {_active_trace_dir} is already active; "
+                "StopTrace() it first (the profiler is process-global)")
+        jax.profiler.start_trace(log_dir)
+        _active_trace_dir = log_dir
+
+    def StopTrace(self) -> "str | None":
+        """Stop the capture; returns the log dir (None if none active)."""
+        global _active_trace_dir
+        out = _active_trace_dir
+        if out is not None:
+            jax.profiler.stop_trace()
+            _active_trace_dir = None
+        return out
 
     # ---- info ------------------------------------------------------------
     @property

@@ -236,7 +236,10 @@ class GPT(model.Model):
         assert max_new_tokens >= 0, "max_new_tokens must be >= 0"
         if max_new_tokens == 0:
             return ids.astype(np.int32).copy()
-        if top_k is not None:
+        assert ids.shape[1] >= 1, "prompt must contain at least one token"
+        if temperature == 0.0:
+            top_k = None  # greedy ignores top_k; don't fragment the cache
+        elif top_k is not None:
             top_k = max(1, min(int(top_k), self.vocab_size))
         B, S0 = ids.shape
         sig = (B, S0, max_new_tokens, float(temperature), top_k, dtype)

@@ -141,6 +141,18 @@ def test_snapshot_npz_compat(tmp_path):
                                   np.ones(4, np.float32))
 
 
+def test_trace_capture(tmp_path):
+    from singa_tpu import device
+    dev = device.best_device()
+    dev.StartTrace(str(tmp_path))
+    x = tensor.from_numpy(np.ones((8, 8), np.float32), device=dev)
+    _ = tensor.mult(x, x).numpy()
+    assert dev.StopTrace() == str(tmp_path)
+    assert dev.StopTrace() is None           # idempotent
+    files = [f for _, _, fs in os.walk(tmp_path) for f in fs]
+    assert any("xplane" in f or "trace" in f for f in files), files
+
+
 def test_channel_file(tmp_path, capsys):
     channel.InitChannel(str(tmp_path))
     ch = channel.GetChannel("train")
