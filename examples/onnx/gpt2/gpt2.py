@@ -18,6 +18,7 @@ from utils import check_vs_torch, load_or_export, run_imported  # noqa: E402
 
 N_CTX = 64
 VOCAB = 5000
+D, H, L = 128, 4, 4  # width / heads / layers (shared with serve_native.py)
 
 
 def build_torch():
@@ -30,7 +31,6 @@ def build_torch():
     import torch.nn as nn
 
     torch.manual_seed(0)
-    D, H, L = 128, 4, 4
 
     class Block(nn.Module):
         def __init__(self):

@@ -128,8 +128,10 @@ class Device:
         global _active_trace_dir
         out = _active_trace_dir
         if out is not None:
-            jax.profiler.stop_trace()
-            _active_trace_dir = None
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                _active_trace_dir = None  # never wedge future StartTrace
         return out
 
     # ---- info ------------------------------------------------------------

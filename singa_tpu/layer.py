@@ -612,26 +612,35 @@ class MultiHeadAttention(Layer):
     row-parallel (one psum). Composes with `seq_axis` ring attention."""
 
     def __init__(self, num_heads, causal=False, seq_axis=None, tp_axis=None,
-                 name=None):
+                 bias=False, name=None):
         super().__init__(name)
         self.num_heads = num_heads
         self.causal = causal
         self.seq_axis = seq_axis
         self.tp_axis = tp_axis
+        self.use_bias = bias  # GPT-2-style projection biases
 
     def initialize(self, x):
         e = x.shape[-1]
         assert e % self.num_heads == 0
-        spec_col = spec_row = None
+        spec_col = spec_row = spec_colb = None
         if self.tp_axis is not None:
             from jax.sharding import PartitionSpec as P
             spec_col = P(None, self.tp_axis)
             spec_row = P(self.tp_axis, None)
+            spec_colb = P(self.tp_axis)
         for attr in ("Wq", "Wk", "Wv", "Wo"):
             W = Tensor((e, e), device=x.device, dtype=x.dtype)
             initializer.glorot_uniform(W)
             W.spec = spec_row if attr == "Wo" else spec_col
             self._register_param(attr, W)
+            if self.use_bias:
+                b = Tensor((e,), device=x.device, dtype=x.dtype)
+                b.set_value(0.0)
+                # q/k/v biases shard with the heads (column); the output
+                # bias is added after the row-parallel psum: replicated
+                b.spec = None if attr == "Wo" else spec_colb
+                self._register_param("b" + attr[1].lower(), b)
 
     def _split(self, t, B, S, heads):
         t = autograd.reshape(t, (B, S, heads, -1))
@@ -650,9 +659,19 @@ class MultiHeadAttention(Layer):
             x = autograd.tp_copy(x, self.tp_axis)
         x, Wq, Wk, Wv, Wo = autograd.compute_cast(
             x, self.Wq, self.Wk, self.Wv, self.Wo)
-        q = self._split(autograd.matmul(x, Wq), B, S, heads)
-        k = self._split(autograd.matmul(x, Wk), B, S, heads)
-        v = self._split(autograd.matmul(x, Wv), B, S, heads)
+
+        def proj(W, b):
+            y = autograd.matmul(x, W)
+            if b is not None:
+                y = autograd.add_bias(y, autograd.compute_cast(b), axis=0)
+            return y
+
+        bq = bk = bv = bo = None
+        if self.use_bias:
+            bq, bk, bv, bo = self.bq, self.bk, self.bv, self.bo
+        q = self._split(proj(Wq, bq), B, S, heads)
+        k = self._split(proj(Wk, bk), B, S, heads)
+        v = self._split(proj(Wv, bv), B, S, heads)
         o = autograd.attention(q, k, v, causal=self.causal,
                                seq_axis=self.seq_axis)
         o = autograd.transpose(o, (0, 2, 1, 3))
@@ -660,6 +679,8 @@ class MultiHeadAttention(Layer):
         y = autograd.matmul(o, Wo)
         if tp:
             y = autograd.tp_reduce(y, self.tp_axis)
+        if bo is not None:
+            y = autograd.add_bias(y, autograd.compute_cast(bo), axis=0)
         return y
 
 
@@ -669,11 +690,12 @@ class TransformerBlock(Layer):
     block total, the Megatron layout)."""
 
     def __init__(self, num_heads, mlp_ratio=4, causal=True, seq_axis=None,
-                 tp_axis=None, name=None):
+                 tp_axis=None, attn_bias=False, name=None):
         super().__init__(name)
         self.ln1 = LayerNorm()
         self.attn = MultiHeadAttention(num_heads, causal=causal,
-                                       seq_axis=seq_axis, tp_axis=tp_axis)
+                                       seq_axis=seq_axis, tp_axis=tp_axis,
+                                       bias=attn_bias)
         self.ln2 = LayerNorm()
         self.mlp_ratio = mlp_ratio
         self.tp_axis = tp_axis

@@ -37,14 +37,15 @@ class GPT(model.Model):
 
     def __init__(self, vocab_size, max_seq=1024, dim=256, num_heads=8,
                  num_layers=4, mlp_ratio=4, seq_axis=None, tp_axis=None,
-                 name=None):
+                 attn_bias=False, name=None):
         super().__init__(name)
         self.vocab_size = vocab_size
         self.max_seq = max_seq
         self.dim = dim
         self.tok_embed = layer.Embedding(vocab_size, dim)
         blocks = [layer.TransformerBlock(num_heads, mlp_ratio, causal=True,
-                                         seq_axis=seq_axis, tp_axis=tp_axis)
+                                         seq_axis=seq_axis, tp_axis=tp_axis,
+                                         attn_bias=attn_bias)
                   for _ in range(num_layers)]
         self.blocks = blocks
         self.register_layers(*blocks)
@@ -98,12 +99,20 @@ class GPT(model.Model):
             raise RuntimeError(
                 "generate() needs initialized weights - call "
                 "Model.compile([ids], ...) (or run a forward) first")
+        import jax.numpy as jnp
         blocks = []
+        zeros = jnp.zeros((self.dim,),
+                          self.blocks[0].attn.Wq.data.dtype)
         for b in self.blocks:
+            ab = b.attn.use_bias
             blocks.append({
                 "g1": b.ln1.gamma.data, "b1": b.ln1.beta.data,
                 "Wq": b.attn.Wq.data, "Wk": b.attn.Wk.data,
                 "Wv": b.attn.Wv.data, "Wo": b.attn.Wo.data,
+                "bq": b.attn.bq.data if ab else zeros,
+                "bk": b.attn.bk.data if ab else zeros,
+                "bv": b.attn.bv.data if ab else zeros,
+                "bo": b.attn.bo.data if ab else zeros,
                 "g2": b.ln2.gamma.data, "b2": b.ln2.beta.data,
                 "W1": b.fc1.W.data, "bb1": b.fc1.b.data,
                 "W2": b.fc2.W.data, "bb2": b.fc2.b.data,
@@ -166,12 +175,14 @@ class GPT(model.Model):
             cmask = jnp.tril(jnp.ones((S0, S0), bool))
             for bp in p["blocks"]:
                 x = ln(h, bp["g1"], bp["b1"])
-                q, k, v = (heads(x @ bp[w])
-                           for w in ("Wq", "Wk", "Wv"))   # (B,H,S0,D)
+                q, k, v = (heads(x @ bp[w] + bp[bb])
+                           for w, bb in (("Wq", "bq"), ("Wk", "bk"),
+                                         ("Wv", "bv")))  # (B,H,S0,D)
                 s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
                 a = jax.nn.softmax(jnp.where(cmask, s, -jnp.inf), axis=-1)
                 o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
-                h = h + o.swapaxes(1, 2).reshape(B, S0, E) @ bp["Wo"]
+                h = h + o.swapaxes(1, 2).reshape(B, S0, E) @ bp["Wo"] \
+                    + bp["bo"]
                 x = ln(h, bp["g2"], bp["b2"])
                 h = h + jax.nn.gelu(x @ bp["W1"] + bp["bb1"]) \
                     @ bp["W2"] + bp["bb2"]
@@ -191,16 +202,16 @@ class GPT(model.Model):
                 kmask = (jnp.arange(T) <= pos_idx)        # attend to <= pos
                 for (K, V), bp in zip(caches, p["blocks"]):
                     x = ln(h, bp["g1"], bp["b1"])
-                    q = (x @ bp["Wq"]).reshape(B, H, D)
-                    kn = (x @ bp["Wk"]).reshape(B, H, 1, D)
-                    vn = (x @ bp["Wv"]).reshape(B, H, 1, D)
+                    q = (x @ bp["Wq"] + bp["bq"]).reshape(B, H, D)
+                    kn = (x @ bp["Wk"] + bp["bk"]).reshape(B, H, 1, D)
+                    vn = (x @ bp["Wv"] + bp["bv"]).reshape(B, H, 1, D)
                     K = lax.dynamic_update_slice(K, kn, (0, 0, pos_idx, 0))
                     V = lax.dynamic_update_slice(V, vn, (0, 0, pos_idx, 0))
                     s = jnp.einsum("bhd,bhkd->bhk", q, K) * scale
                     a = jax.nn.softmax(
                         jnp.where(kmask, s, -jnp.inf), axis=-1)
                     o = jnp.einsum("bhk,bhkd->bhd", a, V).reshape(B, E)
-                    h = h + o @ bp["Wo"]
+                    h = h + o @ bp["Wo"] + bp["bo"]
                     x = ln(h, bp["g2"], bp["b2"])
                     h = h + jax.nn.gelu(x @ bp["W1"] + bp["bb1"]) \
                         @ bp["W2"] + bp["bb2"]
@@ -414,6 +425,73 @@ class PipelinedGPT(model.Model):
         return logits, loss
 
 
+def load_gpt2_weights(m: "GPT", state: dict):
+    """Load GPT-2-convention weights into a native GPT for fast serving.
+
+    `state` maps torch-style GPT-2 names to numpy arrays (e.g.
+    `{k: v.numpy() for k, v in torch_model.state_dict().items()}`, or
+    initializers pulled from an ONNX file): `wte.weight`, `wpe.weight`,
+    `blocks.{i}.{ln1,ln2}.{weight,bias}`, `blocks.{i}.attn.{weight,bias}`
+    (fused qkv, (3E,E)/(3E,)), `blocks.{i}.proj.{weight,bias}`,
+    `blocks.{i}.{ff1,ff2}.{weight,bias}`, `ln_f.{weight,bias}`; the LM
+    head is tied to wte. Torch Linear stores (out,in) so weights are
+    transposed into this framework's (in,out) layout. The model must be
+    built with `attn_bias=True` and compiled (weights initialized) first.
+
+    This is the migration path from the reference's ONNX-imported GPT-2
+    (examples/onnx/gpt2) onto the KV-cached `generate()` serving stack.
+    """
+    import numpy as np
+
+    if not m._pos_init:
+        raise RuntimeError("compile() the model before loading weights")
+    E = m.dim
+
+    def put(t, arr):
+        arr = np.asarray(arr, np.float32)
+        assert tuple(t.shape) == arr.shape, \
+            f"shape mismatch: param {tuple(t.shape)} vs weight {arr.shape}"
+        t.copy_from_numpy(arr)
+
+    put(m.tok_embed.W, state["wte.weight"])
+    n_wpe = state["wpe.weight"].shape[0]
+    if m.max_seq > n_wpe:
+        raise ValueError(
+            f"model max_seq={m.max_seq} exceeds the checkpoint's "
+            f"{n_wpe} position embeddings; positions past {n_wpe} would "
+            f"stay randomly initialized — build the GPT with "
+            f"max_seq<={n_wpe}")
+    pos = m.pos_embed.numpy().copy()
+    pos[:] = np.asarray(state["wpe.weight"], np.float32)[:m.max_seq]
+    m.pos_embed.copy_from_numpy(pos)
+    put(m.head.W, np.asarray(state["wte.weight"]).T)
+    put(m.ln_f.gamma, state["ln_f.weight"])
+    put(m.ln_f.beta, state["ln_f.bias"])
+    for i, blk in enumerate(m.blocks):
+        assert blk.attn.use_bias, \
+            "build the GPT with attn_bias=True for GPT-2 weights"
+        pre = f"blocks.{i}."
+        put(blk.ln1.gamma, state[pre + "ln1.weight"])
+        put(blk.ln1.beta, state[pre + "ln1.bias"])
+        put(blk.ln2.gamma, state[pre + "ln2.weight"])
+        put(blk.ln2.beta, state[pre + "ln2.bias"])
+        qkv_w = np.asarray(state[pre + "attn.weight"], np.float32)
+        qkv_b = np.asarray(state[pre + "attn.bias"], np.float32)
+        assert qkv_w.shape == (3 * E, E), qkv_w.shape
+        for j, (W, b) in enumerate(((blk.attn.Wq, blk.attn.bq),
+                                    (blk.attn.Wk, blk.attn.bk),
+                                    (blk.attn.Wv, blk.attn.bv))):
+            put(W, qkv_w[j * E:(j + 1) * E].T)
+            put(b, qkv_b[j * E:(j + 1) * E])
+        put(blk.attn.Wo, np.asarray(state[pre + "proj.weight"]).T)
+        put(blk.attn.bo, state[pre + "proj.bias"])
+        put(blk.fc1.W, np.asarray(state[pre + "ff1.weight"]).T)
+        put(blk.fc1.b, state[pre + "ff1.bias"])
+        put(blk.fc2.W, np.asarray(state[pre + "ff2.weight"]).T)
+        put(blk.fc2.b, state[pre + "ff2.bias"])
+    return m
+
+
 def create_model(vocab_size=256, **kwargs):
     return GPT(vocab_size, **kwargs)
 
@@ -422,4 +500,5 @@ def create_pipelined(vocab_size=256, **kwargs):
     return PipelinedGPT(vocab_size, **kwargs)
 
 
-__all__ = ["GPT", "PipelinedGPT", "create_model", "create_pipelined"]
+__all__ = ["GPT", "PipelinedGPT", "create_model", "create_pipelined",
+           "load_gpt2_weights"]

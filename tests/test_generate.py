@@ -75,6 +75,71 @@ def test_bf16_decode(gpt):
     assert (a[:, 6:] >= 0).all() and (a[:, 6:] < 97).all()
 
 
+def test_attn_bias_greedy_matches_full_forward():
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=53, max_seq=32, dim=32,
+                            num_heads=2, num_layers=2, attn_bias=True)
+    ids = tensor.from_numpy(np.zeros((1, 6), np.int32), device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    # non-zero biases so the bias path actually matters
+    rng = np.random.RandomState(7)
+    for blk in m.blocks:
+        for b in (blk.attn.bq, blk.attn.bk, blk.attn.bv, blk.attn.bo):
+            b.copy_from_numpy(rng.standard_normal(b.shape[0])
+                              .astype(np.float32) * 0.3)
+    prompt = rng.randint(0, 53, (1, 6))
+    want = _naive_greedy(m, dev, prompt, 5)
+    np.testing.assert_array_equal(m.generate(prompt, 5), want)
+
+
+def test_gpt2_weight_migration():
+    """torch GPT-2 state_dict -> native GPT: logits match, serving runs."""
+    torch = pytest.importorskip("torch")
+    from singa_tpu.models.transformer import load_gpt2_weights
+    import importlib.util
+    import jax
+    import os
+    import sys
+    # gpt2.py imports examples/onnx/utils.py, which mutates sys.path and
+    # jax_default_matmul_precision at import — snapshot and restore so the
+    # rest of the suite is unaffected by test ordering
+    path_before = list(sys.path)
+    prec_before = jax.config.jax_default_matmul_precision
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "gpt2_example",
+            os.path.join(os.path.dirname(__file__), "..",
+                         "examples", "onnx", "gpt2", "gpt2.py"))
+        ex = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(ex)
+    finally:
+        sys.path[:] = path_before
+        sys.modules.pop("utils", None)
+        jax.config.update("jax_default_matmul_precision", prec_before)
+
+    tm = ex.build_torch().eval()
+    state = {k: v.numpy() for k, v in tm.state_dict().items()}
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=ex.VOCAB, max_seq=ex.N_CTX,
+                            dim=ex.D, num_heads=ex.H, num_layers=ex.L,
+                            attn_bias=True)
+    ids = tensor.from_numpy(np.zeros((1, 8), np.int32), device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    load_gpt2_weights(m, state)
+
+    probe = np.random.RandomState(0).randint(0, ex.VOCAB, (1, 12))
+    with torch.no_grad():
+        want = tm(torch.from_numpy(probe)).numpy()
+    got = tensor.to_numpy(m(tensor.from_numpy(probe.astype(np.int32),
+                                              device=dev)))
+    err = np.abs(got - want).max() / np.abs(want).std()
+    assert err < 0.05, f"normalized max err {err}"
+    out = m.generate(probe, 4)
+    assert out.shape == (1, 16)
+
+
 def test_generate_before_compile_raises():
     m = models.create_model("gpt", vocab_size=17, max_seq=16, dim=32,
                             num_heads=2, num_layers=1)
