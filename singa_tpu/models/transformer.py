@@ -33,6 +33,138 @@ class _PosSlice(autograd.Operator):
         return lax.dynamic_slice_in_dim(table, off, self.length, axis=0)
 
 
+class _DecodeCore:
+    """Shared functional decode math for greedy/sampled and beam decoding.
+
+    One implementation of the fp32-island LayerNorm, the causal prefill
+    (which also fills the KV caches), and the single-token cached block
+    step — so every decode flavor shares numerics by construction (the
+    beam-1 == greedy test leans on this).
+    """
+
+    def __init__(self, H, E, S0, T, scale):
+        self.H, self.E, self.S0, self.T, self.scale = H, E, S0, T, scale
+
+    def cast(self, p, dtype):
+        import jax
+        import jax.numpy as jnp
+        if dtype is None:
+            return p
+        # weight-bandwidth-bound: each decode step re-reads every weight,
+        # so bf16 params halve the time per token; LN stays fp32 inside.
+        cd = jnp.dtype(dtype)
+        return jax.tree.map(
+            lambda a: a.astype(cd)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+
+    def ln(self, x, g, b, eps=1e-5):
+        # fp32 island like autograd.LayerNorm: variance in bf16 is
+        # catastrophically lossy
+        import jax.numpy as jnp
+        from jax import lax
+        x32 = x.astype(jnp.float32)
+        m = jnp.mean(x32, axis=-1, keepdims=True)
+        v = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - m) * lax.rsqrt(v + eps) * g.astype(jnp.float32) \
+            + b.astype(jnp.float32)
+        return y.astype(x.dtype)
+
+    def prefill(self, p, prompt, n):
+        """Causal pass over the (n, S0) prompt; returns the last-position
+        logits (n, V) and per-block KV caches of time-length T."""
+        import jax
+        import jax.numpy as jnp
+        H, D, S0, T = self.H, self.E // self.H, self.S0, self.T
+        ln = self.ln
+        h = p["emb"][prompt] + p["pos"][:S0]
+
+        def heads(x):
+            return x.reshape(*x.shape[:-1], H, D).swapaxes(-3, -2)
+
+        caches = []
+        cmask = jnp.tril(jnp.ones((S0, S0), bool))
+        for bp in p["blocks"]:
+            x = ln(h, bp["g1"], bp["b1"])
+            q, k, v = (heads(x @ bp[w] + bp[bb])
+                       for w, bb in (("Wq", "bq"), ("Wk", "bk"),
+                                     ("Wv", "bv")))      # (n,H,S0,D)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * self.scale
+            a = jax.nn.softmax(jnp.where(cmask, s, -jnp.inf), axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
+            h = h + o.swapaxes(1, 2).reshape(n, S0, self.E) @ bp["Wo"] \
+                + bp["bo"]
+            x = ln(h, bp["g2"], bp["b2"])
+            h = h + jax.nn.gelu(x @ bp["W1"] + bp["bb1"]) @ bp["W2"] \
+                + bp["bb2"]
+            Kc = jnp.zeros((n, H, T, D), k.dtype).at[:, :, :S0].set(k)
+            Vc = jnp.zeros((n, H, T, D), v.dtype).at[:, :, :S0].set(v)
+            caches.append((Kc, Vc))
+        logits0 = ln(h[:, -1], p["gf"], p["bf"]) @ p["head"]
+        return logits0, caches
+
+    def token_step(self, p, tok, caches, i, n):
+        """Feed token `tok` (n,) at generated-index `i` (position S0+i)
+        through all blocks against the caches; returns (logits (n, V),
+        new caches)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        H, D, E = self.H, self.E // self.H, self.E
+        ln = self.ln
+        pos_idx = self.S0 + i
+        h = p["emb"][tok] + p["pos"][pos_idx]
+        kmask = (jnp.arange(self.T) <= pos_idx)
+        new_caches = []
+        for (Kc, Vc), bp in zip(caches, p["blocks"]):
+            x = ln(h, bp["g1"], bp["b1"])
+            q = (x @ bp["Wq"] + bp["bq"]).reshape(n, H, D)
+            kn = (x @ bp["Wk"] + bp["bk"]).reshape(n, H, 1, D)
+            vn = (x @ bp["Wv"] + bp["bv"]).reshape(n, H, 1, D)
+            Kc = lax.dynamic_update_slice(Kc, kn, (0, 0, pos_idx, 0))
+            Vc = lax.dynamic_update_slice(Vc, vn, (0, 0, pos_idx, 0))
+            s = jnp.einsum("nhd,nhkd->nhk", q, Kc) * self.scale
+            a = jax.nn.softmax(jnp.where(kmask, s, -jnp.inf), axis=-1)
+            o = jnp.einsum("nhk,nhkd->nhd", a, Vc).reshape(n, E)
+            h = h + o @ bp["Wo"] + bp["bo"]
+            x = ln(h, bp["g2"], bp["b2"])
+            h = h + jax.nn.gelu(x @ bp["W1"] + bp["bb1"]) @ bp["W2"] \
+                + bp["bb2"]
+            new_caches.append((Kc, Vc))
+        logits = ln(h, p["gf"], p["bf"]) @ p["head"]
+        return logits, new_caches
+
+
+def _set_col(buf, i, vals):
+    """buf (B,K,L) with column `i` (traced index) set to vals (B,K)."""
+    from jax import lax
+    return lax.dynamic_update_slice_in_dim(
+        buf, vals[..., None], i, axis=2)
+
+
+def _pool_merge(pool_tok, pool_norm, pool_raw, cand_tok, cand_norm,
+                cand_raw, K):
+    """Merge candidate finished hypotheses into the K-slot pool, keeping
+    the K best by normalized score. Shapes: pool (B,K,L)/(B,K); cand
+    (B,kk,L)/(B,kk). Candidates not actually finished carry NEG norm."""
+    import jax.numpy as jnp
+    all_norm = jnp.concatenate([pool_norm, cand_norm], axis=1)
+    all_raw = jnp.concatenate([pool_raw, cand_raw], axis=1)
+    all_tok = jnp.concatenate([pool_tok, cand_tok], axis=1)
+    from jax import lax
+    top_norm, pick = lax.top_k(all_norm, K)
+    new_raw = jnp.take_along_axis(all_raw, pick, axis=1)
+    new_tok = jnp.take_along_axis(all_tok, pick[..., None], axis=1)
+    return new_tok, top_norm, new_raw
+
+
+def _decode_core(m: "GPT", S0, max_new):
+    H = m.blocks[0].attn.num_heads
+    T = S0 + max_new
+    assert T <= m.max_seq, \
+        f"prompt {S0} + new {max_new} exceeds max_seq {m.max_seq}"
+    return _DecodeCore(H, m.dim, S0, T, (m.dim // H) ** -0.5)
+
+
 class GPT(model.Model):
 
     def __init__(self, vocab_size, max_seq=1024, dim=256, num_heads=8,
@@ -129,26 +261,7 @@ class GPT(model.Model):
         import jax.numpy as jnp
         from jax import lax
 
-        H = self.blocks[0].attn.num_heads
-        E = self.dim
-        D = E // H
-        T = S0 + max_new
-        assert T <= self.max_seq, \
-            f"prompt {S0} + new {max_new} exceeds max_seq {self.max_seq}"
-        scale = D ** -0.5
-
-        def ln(x, g, b, eps=1e-5):
-            # fp32 island like autograd.LayerNorm: variance in bf16 is
-            # catastrophically lossy
-            x32 = x.astype(jnp.float32)
-            m = jnp.mean(x32, axis=-1, keepdims=True)
-            v = jnp.var(x32, axis=-1, keepdims=True)
-            y = (x32 - m) * lax.rsqrt(v + eps) * g.astype(jnp.float32) \
-                + b.astype(jnp.float32)
-            return y.astype(x.dtype)
-
-        def heads(x):  # (..., S, E) -> (..., H, S, D)
-            return x.reshape(*x.shape[:-1], H, D).swapaxes(-3, -2)
+        core = _decode_core(self, S0, max_new)
 
         def sample(logits, key):
             logits = logits.astype(jnp.float32)
@@ -161,65 +274,18 @@ class GPT(model.Model):
             return jax.random.categorical(key, logits).astype(jnp.int32)
 
         def decode(p, prompt, key):
-            if dtype is not None:
-                # weight-bandwidth-bound: each decode step re-reads every
-                # weight, so bf16 params halve the time per token. The
-                # logits head stays in the cast dtype; sampling upcasts.
-                cd = jnp.dtype(dtype)
-                p = jax.tree.map(
-                    lambda a: a.astype(cd)
-                    if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
-            # ---- prefill: full causal pass over the prompt ----
-            h = p["emb"][prompt] + p["pos"][:S0]          # (B,S0,E)
-            caches = []
-            cmask = jnp.tril(jnp.ones((S0, S0), bool))
-            for bp in p["blocks"]:
-                x = ln(h, bp["g1"], bp["b1"])
-                q, k, v = (heads(x @ bp[w] + bp[bb])
-                           for w, bb in (("Wq", "bq"), ("Wk", "bk"),
-                                         ("Wv", "bv")))  # (B,H,S0,D)
-                s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-                a = jax.nn.softmax(jnp.where(cmask, s, -jnp.inf), axis=-1)
-                o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
-                h = h + o.swapaxes(1, 2).reshape(B, S0, E) @ bp["Wo"] \
-                    + bp["bo"]
-                x = ln(h, bp["g2"], bp["b2"])
-                h = h + jax.nn.gelu(x @ bp["W1"] + bp["bb1"]) \
-                    @ bp["W2"] + bp["bb2"]
-                K = jnp.zeros((B, H, T, D), k.dtype).at[:, :, :S0].set(k)
-                V = jnp.zeros((B, H, T, D), v.dtype).at[:, :, :S0].set(v)
-                caches.append((K, V))
-            logits0 = ln(h[:, -1], p["gf"], p["bf"]) @ p["head"]
+            p = core.cast(p, dtype)
+            logits0, caches = core.prefill(p, prompt, B)
             key, sub = jax.random.split(key)
             tok0 = sample(logits0, sub)                   # (B,)
 
             # ---- decode: one token per scan step, O(T) attention ----
             def step(carry, i):
                 tok, caches, key = carry
-                pos_idx = S0 + i                          # token's position
-                h = p["emb"][tok] + p["pos"][pos_idx]     # (B,E)
-                new_caches = []
-                kmask = (jnp.arange(T) <= pos_idx)        # attend to <= pos
-                for (K, V), bp in zip(caches, p["blocks"]):
-                    x = ln(h, bp["g1"], bp["b1"])
-                    q = (x @ bp["Wq"] + bp["bq"]).reshape(B, H, D)
-                    kn = (x @ bp["Wk"] + bp["bk"]).reshape(B, H, 1, D)
-                    vn = (x @ bp["Wv"] + bp["bv"]).reshape(B, H, 1, D)
-                    K = lax.dynamic_update_slice(K, kn, (0, 0, pos_idx, 0))
-                    V = lax.dynamic_update_slice(V, vn, (0, 0, pos_idx, 0))
-                    s = jnp.einsum("bhd,bhkd->bhk", q, K) * scale
-                    a = jax.nn.softmax(
-                        jnp.where(kmask, s, -jnp.inf), axis=-1)
-                    o = jnp.einsum("bhk,bhkd->bhd", a, V).reshape(B, E)
-                    h = h + o @ bp["Wo"] + bp["bo"]
-                    x = ln(h, bp["g2"], bp["b2"])
-                    h = h + jax.nn.gelu(x @ bp["W1"] + bp["bb1"]) \
-                        @ bp["W2"] + bp["bb2"]
-                    new_caches.append((K, V))
-                logits = ln(h, p["gf"], p["bf"]) @ p["head"]
+                logits, caches = core.token_step(p, tok, caches, i, B)
                 key, sub = jax.random.split(key)
                 nxt = sample(logits, sub)
-                return (nxt, new_caches, key), nxt
+                return (nxt, caches, key), nxt
 
             if max_new > 1:
                 (_, _, _), toks = lax.scan(
@@ -230,6 +296,159 @@ class GPT(model.Model):
             return jnp.concatenate([prompt, toks], axis=1)
 
         return jax.jit(decode)
+
+    def _build_beam_decode(self, B, S0, max_new, num_beams, length_penalty,
+                           eos_id, dtype, pad_id=None):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        V = self.vocab_size
+        K = num_beams
+        core = _decode_core(self, S0, max_new)
+        NEG = jnp.float32(-1e9)
+        pad = 0 if eos_id is None else (pad_id if pad_id is not None
+                                        else eos_id)
+
+        def norm_len(score, length):
+            return score / (length.astype(jnp.float32) ** length_penalty)
+
+        def decode(p, prompt):
+            p = core.cast(p, dtype)
+            # ---- prefill on the B prompts, then tile caches to B*K ----
+            logits0, caches = core.prefill(p, prompt, B)
+            caches = [(jnp.repeat(Kc, K, axis=0), jnp.repeat(Vc, K, axis=0))
+                      for (Kc, Vc) in caches]  # beam b*K+k from prompt b
+            logp0 = jax.nn.log_softmax(
+                logits0.astype(jnp.float32), axis=-1)     # (B,V)
+            tokens = jnp.full((B, K, max_new), pad, jnp.int32)
+            # finished-hypothesis pool (HF-style): finished beams move
+            # here with a length-normalized score and stop competing by
+            # raw score against still-growing beams
+            pool_tok = jnp.full((B, K, max_new), pad, jnp.int32)
+            pool_norm = jnp.full((B, K), NEG)
+            pool_raw = jnp.full((B, K), NEG)
+
+            if eos_id is None:
+                s0, t0 = lax.top_k(logp0, K)              # (B,K)
+                alive_scores = s0
+                tokens = tokens.at[:, :, 0].set(t0)
+            else:
+                # consider 2K candidates so K alive beams survive even if
+                # eos ranks high
+                kk = min(2 * K, V)
+                cs, ct = lax.top_k(logp0, kk)             # (B,kk)
+                is_eos = ct == eos_id
+                # finished at length 1 -> pool
+                cand_pool_tok = jnp.broadcast_to(
+                    jnp.full((max_new,), pad, jnp.int32)
+                    .at[0].set(eos_id)[None, None],
+                    (B, kk, max_new))
+                pool_tok, pool_norm, pool_raw = _pool_merge(
+                    pool_tok, pool_norm, pool_raw,
+                    cand_pool_tok,
+                    jnp.where(is_eos, norm_len(cs, jnp.asarray(1)), NEG),
+                    cs, K)
+                # alive beams: best K non-eos
+                alive_cs = jnp.where(is_eos, NEG, cs)
+                s0, pick = lax.top_k(alive_cs, K)         # (B,K) of [0,kk)
+                t0 = jnp.take_along_axis(ct, pick, axis=1)
+                alive_scores = s0
+                tokens = tokens.at[:, :, 0].set(t0)
+
+            def step(carry, i):
+                tokens, scores, caches, pool_tok, pool_norm, pool_raw = \
+                    carry
+                tok = lax.dynamic_index_in_dim(
+                    tokens, i, axis=2, keepdims=False)    # (B,K)
+                logits, caches = core.token_step(
+                    p, tok.reshape(B * K), caches, i, B * K)
+                logp = jax.nn.log_softmax(
+                    logits.astype(jnp.float32), axis=-1).reshape(B, K, V)
+                total = scores[..., None] + logp          # (B,K,V)
+                flat = total.reshape(B, K * V)
+                kk = min(2 * K, K * V)
+                cs, idx = lax.top_k(flat, kk)             # (B,kk)
+                beam_idx = idx // V
+                cand_tok = (idx % V).astype(jnp.int32)
+                gather = jnp.take_along_axis
+                cand_hist = gather(tokens, beam_idx[..., None], axis=1)
+                cand_hist = _set_col(cand_hist, i + 1, cand_tok)
+
+                if eos_id is not None:
+                    is_eos = cand_tok == eos_id
+                    pool_tok, pool_norm, pool_raw = _pool_merge(
+                        pool_tok, pool_norm, pool_raw, cand_hist,
+                        jnp.where(is_eos,
+                                  norm_len(cs, jnp.asarray(i + 2)), NEG),
+                        cs, K)
+                    cs = jnp.where(is_eos, NEG, cs)
+                new_scores, pick = lax.top_k(cs, K)       # (B,K)
+                keep_beam = gather(beam_idx, pick, axis=1)
+                tokens = gather(cand_hist, pick[..., None], axis=1)
+                src = (jnp.arange(B)[:, None] * K
+                       + keep_beam).reshape(B * K)        # flat rows
+                caches = [(Kc[src], Vc[src]) for (Kc, Vc) in caches]
+                return (tokens, new_scores, caches,
+                        pool_tok, pool_norm, pool_raw), None
+
+            carry = (tokens, alive_scores, caches,
+                     pool_tok, pool_norm, pool_raw)
+            if max_new > 1:
+                carry, _ = lax.scan(step, carry, jnp.arange(max_new - 1))
+            tokens, scores, _, pool_tok, pool_norm, pool_raw = carry
+
+            # final selection: best of {pool, alive} by normalized score
+            alive_norm = norm_len(scores, jnp.asarray(max_new))
+            all_norm = jnp.concatenate([pool_norm, alive_norm], axis=1)
+            all_raw = jnp.concatenate([pool_raw, scores], axis=1)
+            all_tok = jnp.concatenate([pool_tok, tokens], axis=1)
+            best = jnp.argmax(all_norm, axis=1)           # (B,)
+            out = jnp.take_along_axis(
+                all_tok, best[:, None, None], axis=1)[:, 0]
+            best_score = jnp.take_along_axis(
+                all_raw, best[:, None], axis=1)[:, 0]
+            return jnp.concatenate([prompt, out], axis=1), best_score
+
+        return jax.jit(decode)
+
+    def generate_beam(self, prompt, max_new_tokens, num_beams=4,
+                      length_penalty=1.0, eos_id=None, pad_id=None,
+                      dtype=None, return_scores=False):
+        """Beam-search decoding (no reference equivalent; its GPT-2
+        example is greedy). One jitted function: prefill once, tile the
+        KV cache across beams, and a `lax.scan` whose carry reorders
+        cache rows by winning parent beam each step. With `eos_id`,
+        finished hypotheses move to a length-normalized pool (HF
+        semantics) and the tail after eos is filled with `pad_id`
+        (default: eos_id). Returns (B, S0+max_new_tokens) token ids
+        (+ the chosen hypothesis' joint log-prob when
+        `return_scores`)."""
+        import jax
+        import numpy as np
+        ids = prompt.numpy() if isinstance(prompt, Tensor) \
+            else np.asarray(prompt)
+        assert ids.ndim == 2 and ids.shape[1] >= 1, \
+            "prompt must be (batch, length>=1)"
+        assert max_new_tokens >= 1 and num_beams >= 1
+        assert num_beams <= self.vocab_size, \
+            f"num_beams {num_beams} exceeds vocab_size {self.vocab_size}"
+        B, S0 = ids.shape
+        sig = ("beam", B, S0, max_new_tokens, num_beams,
+               float(length_penalty), eos_id, pad_id, dtype)
+        cache = getattr(self, "_decode_cache", None)
+        if cache is None:
+            cache = self._decode_cache = {}
+        fn = cache.get(sig)
+        if fn is None:
+            fn = cache[sig] = self._build_beam_decode(
+                B, S0, max_new_tokens, num_beams, float(length_penalty),
+                eos_id, dtype, pad_id)
+        out, scores = fn(self._decode_params(), ids.astype(np.int32))
+        out = np.asarray(jax.device_get(out))
+        if return_scores:
+            return out, np.asarray(jax.device_get(scores))
+        return out
 
     def generate(self, prompt, max_new_tokens, temperature=0.0, top_k=None,
                  seed=0, dtype=None):

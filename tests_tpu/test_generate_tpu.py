@@ -29,3 +29,8 @@ def test_generate_on_chip():
         # deterministic greedy: repeat run matches
         np.testing.assert_array_equal(
             out, m.generate(prompt, 24, temperature=0.0, dtype=dtype))
+    # beam search compiles and runs on the chip; beam-1 == greedy
+    np.testing.assert_array_equal(
+        m.generate_beam(prompt, 12, num_beams=1),
+        m.generate(prompt, 12, temperature=0.0))
+    assert m.generate_beam(prompt, 12, num_beams=4).shape == (2, 28)
