@@ -741,7 +741,8 @@ class Identity(Operator):
 
 class Mean(Operator):
     def forward(self, *xs):
-        return sum(xs) / len(xs)
+        import builtins
+        return builtins.sum(xs) / len(xs)
 
 
 class Sum(Operator):
@@ -1665,7 +1666,9 @@ class LRN(Operator):
         sq = x * x
         pad = [(0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)]
         sq = jnp.pad(sq, pad)
-        acc = sum(sq[:, i:i + x.shape[1]] for i in range(self.size))
+        import builtins
+        acc = builtins.sum(sq[:, i:i + x.shape[1]]
+                           for i in range(self.size))
         return x / jnp.power(self.bias + self.alpha / self.size * acc,
                              self.beta)
 
@@ -1840,3 +1843,79 @@ def compute_cast(*xs):
             x = ComputeCast(tgt)(x)
         out.append(x)
     return tuple(out) if len(out) > 1 else out[0]
+
+
+# ---- reference-name functional parity (python/singa/autograd.py) --------
+# Snake-case wrappers and helpers whose class-level ops already exist, so
+# a reference user's `autograd.<name>(...)` calls resolve here too.
+
+def axis_helper(y_shape, x_shape):
+    """Axes along which x was broadcast to produce y (ref autograd.py:34)."""
+    res = []
+    j = len(x_shape) - 1
+    for i in range(len(y_shape) - 1, -1, -1):
+        if j < 0 or x_shape[j] != y_shape[i]:
+            res.append(i)
+        j -= 1
+    return tuple(res[::-1])
+
+
+def back_broadcast(y_shape, x_shape, x):
+    """Reduce a broadcast result back to x_shape (ref autograd.py:52)."""
+    if tuple(y_shape) == tuple(x_shape):
+        return x
+    y = reduce_sum(x, axes=axis_helper(y_shape, x_shape), keepdims=False)
+    return reshape(y, x_shape)
+
+
+def sum(*xs):  # noqa: A001  (name mandated by reference parity)
+    """Element-wise sum of the input tensors (ref autograd.py:1144)."""
+    return Sum()(*xs)
+
+
+def add_all(*xs):
+    assert len(xs) > 2
+    y = add(xs[0], xs[1])
+    for x in xs[2:]:
+        y = add(y, x)
+    return y
+
+
+def ctensor2numpy(x):
+    """Raw backing array -> numpy (ref autograd.py:1363; the 'ctensor'
+    here is a jax.Array)."""
+    import numpy as np
+    return np.asarray(x)
+
+
+def scatter_elements(x, indices, updates, axis=0):
+    idx = indices.numpy() if hasattr(indices, "numpy") else indices
+    return ScatterElements(idx, axis)(x, updates)
+
+
+def shape(x):
+    return Shape()(x)
+
+
+def constant_of_shape(x, value=0):
+    return ConstantOfShape(value)(x)
+
+
+def ceil(x):
+    return Ceil()(x)
+
+
+def floor(x):
+    return Floor()(x)
+
+
+def round(x):  # noqa: A001  (name mandated by reference parity)
+    return Round()(x)
+
+
+def rounde(x):
+    return Rounde()(x)
+
+
+def nonzero(x):
+    return NonZero()(x)

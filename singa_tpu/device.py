@@ -221,3 +221,63 @@ def best_device() -> Device:
 def enable_lazy_alloc(flag: bool):
     """No-op: XLA allocates lazily by construction (ref device.py:133)."""
     del flag
+
+
+# ---- reference-name query parity (python/singa/device.py:29-99) ---------
+# "GPU" queries answer for the attached accelerators (TPU chips here);
+# OpenCL was never compiled into the reference's Python wheels either, so
+# those queries mirror its disabled-build behavior.
+
+def get_num_gpus() -> int:
+    return platform.GetNumGPUs()
+
+
+def get_gpu_ids():
+    return list(range(platform.GetNumGPUs()))
+
+
+def get_gpu_mem_size(id: int):  # noqa: A002  (name mandated by parity)
+    dev = platform.device("accel", id)
+    stats = getattr(dev.jax_device, "memory_stats", lambda: None)()
+    if stats:
+        return (stats.get("bytes_limit", 0), stats.get("bytes_in_use", 0))
+    return (0, 0)
+
+
+def device_query(id: int, verbose=False):  # noqa: A002
+    dev = platform.device("accel", id)
+    info = {"id": id, "kind": getattr(dev.jax_device, "device_kind", "?"),
+            "platform": dev.platform}
+    if verbose:
+        print(info)
+    return info
+
+
+def create_cuda_gpus(num: int):
+    """A list of the first `num` accelerator Devices."""
+    return [platform.device("accel", i) for i in range(num)]
+
+
+def create_cuda_gpus_on(device_ids):
+    return [platform.device("accel", i) for i in device_ids]
+
+
+def get_num_opencl_platforms():
+    raise AssertionError(
+        "built without OpenCL (parity with the reference's USE_OPENCL=OFF "
+        "wheels); use the TPU/CPU devices")
+
+
+def get_num_opencl_devices():
+    raise AssertionError(
+        "built without OpenCL (parity with the reference's USE_OPENCL=OFF "
+        "wheels); use the TPU/CPU devices")
+
+
+def create_opencl_device():
+    raise AssertionError(
+        "built without OpenCL (parity with the reference's USE_OPENCL=OFF "
+        "wheels); use the TPU/CPU devices")
+
+
+create_tpu_devices = create_cuda_gpus

@@ -109,3 +109,12 @@ def xavier(t: Tensor):
 
 def msra(t: Tensor):
     return he_normal(t)
+
+
+def glorot(t: Tensor):
+    """Legacy: gaussian(0,1) scaled by sqrt(2/(rows+cols))
+    (ref initializer.py:222)."""
+    import math
+    scale = math.sqrt(2.0 / (t.shape[0] + t.shape[1]))
+    t.gaussian(0, 1)
+    t.copy_from_numpy(t.numpy() * scale)

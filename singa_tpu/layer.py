@@ -344,6 +344,7 @@ class Conv2d(Layer):
             odd = self._same_odd_padding(x)
         self.handle = _ConvGeometry(self.stride, self.padding, self.group,
                                     odd, self.dilation)
+        self.handle.kernel = self.kernel_size  # for same_pad_shape_check
 
     def forward(self, x):
         b = self.b if self.bias else None

@@ -42,3 +42,31 @@ class SONNXModel(model_module.Model):
         truncated-backbone retraining hook (ref sonnx.py:2212)."""
         outs = self.backend.run(list(x), last_layers=last_layers)
         return outs[0] if len(outs) == 1 else outs
+
+
+# ---- reference-name aliases (python/singa/sonnx.py) ----------------------
+from .backend import OnnxNode  # noqa: F401,E402
+from . import frontend as _frontend_module  # noqa: E402
+
+# The reference's exporter is a class of staticmethods (sonnx.py:75); the
+# functional exporter here plays that role.
+SingaFrontend = _frontend_module
+
+
+class OnnxAttributes(dict):
+    """Plain-dict view of a node's ONNX attributes (ref sonnx.py:1023)."""
+
+    @staticmethod
+    def from_onnx(args):
+        d = OnnxAttributes()
+        for arg in args:
+            d[arg.name] = arg.value()  # AttributeProto.value
+        return d
+
+
+def onnx_type_to_singa_type(onnx_type):
+    """ONNX TensorProto dtype enum -> framework dtype name
+    (ref sonnx.py:64)."""
+    import numpy as np
+    np_dtype = onnx_pb._ONNX2NP.get(onnx_type)
+    return str(np.dtype(np_dtype)) if np_dtype is not None else None

@@ -561,3 +561,58 @@ def softmax_cross_entropy_bwd(logits, targets):
     else:
         onehot = targets
     return p - onehot
+
+
+# ---- reference-name module-fn parity (python/singa/tensor.py) -----------
+
+def from_raw_tensor(t):
+    """Wrap a raw backing array (jax.Array / numpy) as a Tensor in place —
+    zero-copy, placement preserved (ref tensor.py:789; the 'raw tensor'
+    here is a jax.Array)."""
+    if isinstance(t, np.ndarray):
+        return from_numpy(t)
+    return from_raw(t)
+
+
+def from_raw_tensors(tt):
+    return [from_raw_tensor(t) for t in list(tt)]
+
+
+def product(shape):
+    """Number of elements for a shape (ref tensor.py:814)."""
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def contiguous(t: Tensor) -> Tensor:
+    """jax.Arrays are always contiguous; returns a copy for parity with
+    the reference's semantics of producing a new tensor (ref :830)."""
+    return from_numpy(t.numpy().copy(), device=t.device)
+
+
+def to_host(t: Tensor) -> Tensor:
+    """Copy to a host (CPU) tensor (ref tensor.py:910)."""
+    from . import device as device_module
+    return from_numpy(t.numpy(), device=device_module.create_cpu_device())
+
+
+def average(t: Tensor, axis=None):
+    """Mean of all elements (float) or along `axis` (Tensor)
+    (ref tensor.py:1128)."""
+    if axis is None or t.data.ndim <= 1:
+        return float(jnp.mean(t.data))
+    return Tensor(data=jnp.mean(t.data, axis=axis), device=t.device)
+
+
+def copy_from_numpy(data, np_array):
+    """Static-method-style copy into an existing Tensor (ref :1777)."""
+    data.copy_from_numpy(np.asarray(np_array).reshape(data.shape))
+
+
+def random(shape, device: "Device | None" = None) -> Tensor:
+    """Uniform [0,1) tensor of `shape` (ref tensor.py:1817)."""
+    t = Tensor(shape, device=device)
+    t.uniform(0.0, 1.0)
+    return t
