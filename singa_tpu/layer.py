@@ -88,6 +88,14 @@ class Layer(metaclass=LayerMeta):
     # Names are scoped by *attribute path* (e.g. "conv1.W"), which is what
     # the reference's __setattr__-based registration produces (layer.py:241)
     # and what the checkpoint format keys on.
+    def dtype_check(self, *inputs):
+        """Coerce all inputs to the first input's dtype, in place
+        (ref layer.py:171)."""
+        x_dtype = inputs[0].dtype
+        for inp in inputs[1:]:
+            if inp.dtype != x_dtype:
+                inp.to_type(x_dtype)
+
     def get_params(self) -> "OrderedDict[str, Tensor]":
         out = OrderedDict()
         for attr in self._param_names:

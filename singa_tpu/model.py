@@ -112,6 +112,16 @@ class Model(Layer, metaclass=ModelMeta):
     def optimizer(self):
         return self._optimizer
 
+    def graph(self, mode=True, sequential=False):
+        """Turn graph (jit) execution on/off after compile
+        (ref model.py:224). `sequential=True` is the serial debug mode
+        (jax.disable_jit), mirroring the reference's RunInSerial."""
+        self.graph_mode = mode
+        self.sequential = sequential
+        if isinstance(self._compiled_step, dict):
+            self._compiled_step = {}   # drop stale-flag executables
+        self._compiled_eval = None
+
     def compile(self, inputs, is_train=True, use_graph=False,
                 sequential=False, pipeline_axis=None, n_micro=1, amp=None,
                 eval_buckets=False):
@@ -528,7 +538,12 @@ class Model(Layer, metaclass=ModelMeta):
             else:
                 bucket = None
         try:
-            outs = self._compiled_eval(concrete, arrs)
+            if self.sequential:
+                # serial debug mode applies to inference too (RunInSerial)
+                with jax.disable_jit():
+                    outs = self._compiled_eval(concrete, arrs)
+            else:
+                outs = self._compiled_eval(concrete, arrs)
         finally:
             # tracing assigns tracers into the state Tensors; put the real
             # arrays back so later eager/train calls see concrete buffers

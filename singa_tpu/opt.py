@@ -411,6 +411,64 @@ class DistOpt(Optimizer):
         self.opt.step()
 
     # -- strategy 4: sparsified allreduce w/ error feedback (ref :994) -----
+    # -- low-level reference surface (ref opt.py:738-817) ------------------
+    # The reference exposes the raw communicator verbs on DistOpt; here
+    # each verb is a pure collective applied to the Tensor's backing array
+    # (meaningful inside a mesh-mapped step; identity at world_size 1).
+
+    def update(self, param, grad):
+        """Single optimization step on one (param, grad); divides the
+        allreduce-SUMMED gradient by world_size first, like the reference
+        (opt.py:738-746) — pairs with `all_reduce`."""
+        if self.world_size > 1:
+            grad.data = grad.data / self.world_size
+        self.apply(param, grad)
+
+    def all_reduce(self, tensor):
+        """In-place allreduce-sum of one Tensor (ref `synch`)."""
+        tensor.data = self.communicator.all_reduce(tensor.data)
+
+    def fused_all_reduce(self, tensors, send=True):
+        """Allreduce a list of Tensors; buffer fusion is XLA's all-reduce
+        combiner, so this is one psum per tensor that the compiler packs
+        (ref `fusedSynch`). `send` kept for signature parity."""
+        del send
+        for t in tensors:
+            t.data = self.communicator.all_reduce(t.data)
+
+    def all_reduce_half(self, tensor):
+        tensor.data = self.communicator.all_reduce_half(tensor.data)
+
+    def fused_all_reduce_half(self, tensors, send=True):
+        del send
+        for t in tensors:
+            t.data = self.communicator.all_reduce_half(t.data)
+
+    def sparsification(self, tensor, accumulation, spars, topK):
+        """Sparsified allreduce of one Tensor with optional error-feedback
+        accumulation Tensor (ref opt.py:786 / communicator.cc:619-807)."""
+        x = tensor.data if accumulation is None \
+            else tensor.data + accumulation.data
+        if topK:
+            out, residual = self.communicator.sparse_all_reduce_topk(
+                x, spars)
+        else:
+            out, residual = self.communicator.sparse_all_reduce_threshold(
+                x, spars)
+        if accumulation is not None:
+            accumulation.data = residual
+        tensor.data = out
+
+    def fused_sparsification(self, tensors, accumulation, spars, topK):
+        for i, t in enumerate(tensors):
+            acc = accumulation[i] if accumulation is not None else None
+            self.sparsification(t, acc, spars, topK)
+
+    def wait(self):
+        """Stream fence (ref `wait`): no-op — XLA dataflow ordering
+        subsumes the reference's cross-stream events."""
+        self.communicator.wait()
+
     def backward_and_sparse_update(self, loss: Tensor, spars: float = 0.05,
                                    topK: bool = True, corr: bool = True):
         by_id = getattr(self.opt, "_params_by_id", {})

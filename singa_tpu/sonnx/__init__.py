@@ -48,9 +48,35 @@ class SONNXModel(model_module.Model):
 from .backend import OnnxNode  # noqa: F401,E402
 from . import frontend as _frontend_module  # noqa: E402
 
-# The reference's exporter is a class of staticmethods (sonnx.py:75); the
-# functional exporter here plays that role.
-SingaFrontend = _frontend_module
+class SingaFrontend:
+    """Exporter entry points as classmethods, matching the reference's
+    class-of-staticmethods surface (sonnx.py:75/886-968); each delegates
+    to the functional exporter in frontend.py."""
+
+    @classmethod
+    def singa_to_onnx_model(cls, inputs, y, model_name="sonnx"):
+        return _frontend_module.to_onnx_model(inputs, y,
+                                              model_name=model_name)
+
+    @classmethod
+    def singa_to_onnx_graph(cls, inputs, y, model_name="sonnx"):
+        return cls.singa_to_onnx_model(inputs, y, model_name).graph
+
+    @classmethod
+    def handle_special_ops(cls, op, X, W):
+        raise NotImplementedError(
+            "special-op rewriting happens inside to_onnx_model here "
+            "(frontend.py); this hook is internal to the reference's "
+            "exporter and has no standalone equivalent")
+
+    @classmethod
+    def singa_op_to_onnx_node(cls, op, op_t):
+        """Export one traced op: returns the NodeProto list the exporter
+        emits for it (ref sonnx.py:886)."""
+        outs = op_t if isinstance(op_t, (list, tuple)) else [op_t]
+        model = _frontend_module.to_onnx_model(
+            [x for _, _, x, _ in op.src], list(outs))
+        return list(model.graph.node)
 
 
 class OnnxAttributes(dict):

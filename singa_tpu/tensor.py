@@ -150,6 +150,49 @@ class Tensor:
     def copy(self) -> "Tensor":
         return self.clone()
 
+    def deepcopy(self) -> "Tensor":
+        """Same as clone() (ref tensor.py:488)."""
+        return self.clone()
+
+    def contiguous(self) -> "Tensor":
+        """jax.Arrays are always contiguous; a copy for parity (ref :227)."""
+        return self.clone()
+
+    def is_dummy(self) -> bool:
+        """True iff this tensor is a tape leaf placeholder (ref :159)."""
+        from . import autograd
+        return isinstance(self.creator, autograd.Dummy)
+
+    def to_type(self, dtype):
+        """In-place dtype change (ref tensor.py:286)."""
+        self.data = self.data.astype(_resolve_dtype(dtype))
+        return self
+
+    def copy_data(self, t: "Tensor"):
+        """Copy data from another Tensor (ref tensor.py:380)."""
+        assert t.size() == self.size(), "tensor shape should be the same"
+        self.data = _put(t.data.reshape(self.shape).astype(self.dtype),
+                         self.device)
+
+    # (DEPRECATED in the reference too — broadcast helpers, ref :550-595)
+    def add_column(self, v: "Tensor"):
+        self.data = self.data + v.data[:, None]
+
+    def add_row(self, v: "Tensor"):
+        self.data = self.data + v.data[None, :]
+
+    def div_column(self, v: "Tensor"):
+        self.data = self.data / v.data[:, None]
+
+    def div_row(self, v: "Tensor"):
+        self.data = self.data / v.data[None, :]
+
+    def mult_column(self, v: "Tensor"):
+        self.data = self.data * v.data[:, None]
+
+    def mult_row(self, v: "Tensor"):
+        self.data = self.data * v.data[None, :]
+
     def copy_from(self, t: "Tensor"):
         self.data = _put(t.data, self.device)
 
@@ -587,9 +630,9 @@ def product(shape):
 
 
 def contiguous(t: Tensor) -> Tensor:
-    """jax.Arrays are always contiguous; returns a copy for parity with
-    the reference's semantics of producing a new tensor (ref :830)."""
-    return from_numpy(t.numpy().copy(), device=t.device)
+    """jax.Arrays are always contiguous; returns a device-side copy for
+    parity with the reference's new-tensor semantics (ref :830)."""
+    return t.clone()
 
 
 def to_host(t: Tensor) -> Tensor:

@@ -20,11 +20,33 @@ def test_public_names_present(name):
     if not os.path.exists(path):
         pytest.skip("reference checkout not present")
     import importlib
-    mine = importlib.import_module(
-        f"singa_tpu.{name}" if name != "sonnx" else "singa_tpu.sonnx")
+    mine = importlib.import_module(f"singa_tpu.{name}")
     tree = ast.parse(open(path).read())
     pub = [n.name for n in tree.body
            if isinstance(n, (ast.FunctionDef, ast.ClassDef))
            and not n.name.startswith("_")]
     missing = [n for n in pub if not hasattr(mine, n)]
     assert not missing, f"{name}: reference names missing: {missing}"
+
+
+CLASSES = [("tensor", "Tensor"), ("opt", "SGD"), ("opt", "Adam"),
+           ("opt", "DistOpt"), ("layer", "Layer"), ("model", "Model"),
+           ("device", "Device")]
+
+
+@pytest.mark.parametrize("mod,cls", CLASSES)
+def test_public_methods_present(mod, cls):
+    path = os.path.join(REF, mod + ".py")
+    if not os.path.exists(path):
+        pytest.skip("reference checkout not present")
+    import importlib
+    mine = getattr(importlib.import_module(f"singa_tpu.{mod}"), cls)
+    tree = ast.parse(open(path).read())
+    pub = []
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            pub = [n.name for n in node.body
+                   if isinstance(n, ast.FunctionDef)
+                   and not n.name.startswith("_")]
+    missing = [n for n in pub if not hasattr(mine, n)]
+    assert not missing, f"{mod}.{cls}: methods missing: {missing}"
