@@ -116,6 +116,8 @@ class Model(Layer, metaclass=ModelMeta):
         """Turn graph (jit) execution on/off after compile
         (ref model.py:224). `sequential=True` is the serial debug mode
         (jax.disable_jit), mirroring the reference's RunInSerial."""
+        if mode == self.graph_mode and sequential == self.sequential:
+            return  # idempotent: keep the compiled executables
         self.graph_mode = mode
         self.sequential = sequential
         if isinstance(self._compiled_step, dict):

@@ -460,6 +460,15 @@ class DistOpt(Optimizer):
         tensor.data = out
 
     def fused_sparsification(self, tensors, accumulation, spars, topK):
+        """Sparsified allreduce over a list of Tensors. `accumulation`
+        must be a matching LIST of residual Tensors (or None) — the
+        reference's single fused buffer has no analog here because there
+        is no manual buffer packing (XLA fuses the collectives)."""
+        if accumulation is not None:
+            assert isinstance(accumulation, (list, tuple)) \
+                and len(accumulation) == len(tensors), \
+                "accumulation must be a list of per-tensor residual " \
+                "Tensors matching `tensors` (no fused-buffer packing here)"
         for i, t in enumerate(tensors):
             acc = accumulation[i] if accumulation is not None else None
             self.sparsification(t, acc, spars, topK)

@@ -71,12 +71,20 @@ class SingaFrontend:
 
     @classmethod
     def singa_op_to_onnx_node(cls, op, op_t):
-        """Export one traced op: returns the NodeProto list the exporter
-        emits for it (ref sonnx.py:886)."""
-        outs = op_t if isinstance(op_t, (list, tuple)) else [op_t]
-        model = _frontend_module.to_onnx_model(
-            [x for _, _, x, _ in op.src], list(outs))
-        return list(model.graph.node)
+        """Export ONE traced op: the NodeProto list the exporter emits for
+        exactly this op, its inputs named from the tape edges
+        (ref sonnx.py:886)."""
+        del op_t  # the op carries its own outputs
+        f = _frontend_module
+        ctx = f._Ctx(None)
+        # name upstream producers' outputs without walking their subgraphs
+        for i, (src_op, x_id, _x, _s) in enumerate(op.src):
+            if not isinstance(src_op, f.autograd.Dummy):
+                key = (src_op, src_op.y_id2idx[x_id])
+                ctx.names.setdefault(key, ctx.fresh(f"in{i}"))
+        outs = f._out_names(ctx, op)
+        ins = [f._input_name(ctx, op, i, {}) for i in range(len(op.src))]
+        return list(f._emit(ctx, op, ins, outs))
 
 
 class OnnxAttributes(dict):

@@ -37,6 +37,9 @@ def _data(n=32):
 
 @pytest.mark.parametrize("amp", [None, "bfloat16"])
 def test_conv_training_on_tpu(amp):
+    # pin the device RNG stream: earlier tests in the session consume it,
+    # and an unlucky init draw diverges at this lr
+    DEV.SetRandSeed(0)
     x_np, y_np = _data()
     x = tensor.from_numpy(x_np, device=DEV)
     y = tensor.from_numpy(y_np, device=DEV)
