@@ -464,11 +464,14 @@ class DistOpt(Optimizer):
         must be a matching LIST of residual Tensors (or None) — the
         reference's single fused buffer has no analog here because there
         is no manual buffer packing (XLA fuses the collectives)."""
-        if accumulation is not None:
-            assert isinstance(accumulation, (list, tuple)) \
-                and len(accumulation) == len(tensors), \
-                "accumulation must be a list of per-tensor residual " \
-                "Tensors matching `tensors` (no fused-buffer packing here)"
+        if accumulation is not None and (
+                not isinstance(accumulation, (list, tuple))
+                or len(accumulation) != len(tensors)):
+            # a hard raise, not assert: a single fused-buffer Tensor would
+            # otherwise row-slice silently via Tensor.__getitem__
+            raise TypeError(
+                "accumulation must be a list of per-tensor residual "
+                "Tensors matching `tensors` (no fused-buffer packing here)")
         for i, t in enumerate(tensors):
             acc = accumulation[i] if accumulation is not None else None
             self.sparsification(t, acc, spars, topK)

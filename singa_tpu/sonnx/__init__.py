@@ -77,13 +77,19 @@ class SingaFrontend:
         del op_t  # the op carries its own outputs
         f = _frontend_module
         ctx = f._Ctx(None)
-        # name upstream producers' outputs without walking their subgraphs
+        # name upstream producers' outputs without walking their
+        # subgraphs, and register Dummy leaves as graph INPUTS (cheap
+        # ValueInfo) rather than serialized initializers
+        input_ids = {}
         for i, (src_op, x_id, _x, _s) in enumerate(op.src):
-            if not isinstance(src_op, f.autograd.Dummy):
+            if isinstance(src_op, f.autograd.Dummy):
+                input_ids[x_id] = i
+            else:
                 key = (src_op, src_op.y_id2idx[x_id])
                 ctx.names.setdefault(key, ctx.fresh(f"in{i}"))
         outs = f._out_names(ctx, op)
-        ins = [f._input_name(ctx, op, i, {}) for i in range(len(op.src))]
+        ins = [f._input_name(ctx, op, i, input_ids)
+               for i in range(len(op.src))]
         return list(f._emit(ctx, op, ins, outs))
 
 

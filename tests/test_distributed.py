@@ -1,0 +1,68 @@
+"""singa_tpu.distributed helpers on a single process (the multi-process
+path runs in examples/multihost/demo_2proc.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from singa_tpu import distributed
+
+
+def test_process_queries_single_process():
+    assert distributed.process_index() == 0
+    assert distributed.process_count() == 1
+
+
+def test_global_mesh_default_and_shaped():
+    mesh = distributed.global_mesh()
+    assert mesh.shape["data"] == len(jax.devices())
+    mesh2 = distributed.global_mesh({"data": 4, "model": 2})
+    assert mesh2.shape == {"data": 4, "model": 2}
+
+
+def test_global_mesh_bad_size_raises():
+    with pytest.raises(AssertionError, match="devices"):
+        distributed.global_mesh({"data": 3})
+
+
+def test_global_batch_sharding():
+    mesh = distributed.global_mesh()
+    n = mesh.shape["data"]
+    host = np.arange(n * 4 * 2, dtype=np.float32).reshape(n * 4, 2)
+    arr = distributed.global_batch(host, mesh)
+    assert arr.shape == host.shape
+    np.testing.assert_array_equal(np.asarray(arr), host)
+    # sharded along axis 0 across all devices
+    assert len(arr.sharding.device_set) == n
+
+
+def test_global_batch_indivisible_raises():
+    mesh = distributed.global_mesh()
+    bad = np.zeros((mesh.shape["data"] * 4 + 1, 2), np.float32)
+    with pytest.raises(AssertionError, match="divide"):
+        distributed.global_batch(bad, mesh)
+
+
+def test_init_env_fallbacks_parse(monkeypatch):
+    """init() must read the SINGA_* env contract; intercept the jax call
+    so no real cluster forms."""
+    seen = {}
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None, local_device_ids=None):
+        seen.update(addr=coordinator_address, n=num_processes,
+                    pid=process_id)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(distributed, "_initialized", False)
+    monkeypatch.setenv("SINGA_COORDINATOR", "h0:1234")
+    monkeypatch.setenv("SINGA_NPROCS", "2")
+    monkeypatch.setenv("SINGA_PROC_ID", "1")
+    distributed.init()
+    assert seen == {"addr": "h0:1234", "n": 2, "pid": 1}
+    # idempotent: second call must not re-invoke initialize
+    seen.clear()
+    distributed.init()
+    assert seen == {}
+    monkeypatch.setattr(distributed, "_initialized", False)
