@@ -1093,6 +1093,156 @@ def tp_reduce(x, axis):
     return _TPReduce(axis)(x)
 
 
+class _VocabParallelEmbedding(Operator):
+    """Megatron vocab-parallel embedding (no reference counterpart — SINGA
+    replicates every table, SURVEY.md §2.3): the (V, E) table is row-sharded
+    over the TP axis (spec P(tp_axis, None)), each device gathers only the
+    ids that land in its shard and a psum assembles the full activations.
+    The vjp (auto-derived) scatter-adds each device's masked cotangent into
+    ITS shard only — embedding grads never cross the TP axis."""
+
+    def __init__(self, axis):
+        super().__init__("VocabParallelEmbedding")
+        self.axis = axis
+        self._cache = None
+
+    def forward(self, ids, table):
+        vp = table.shape[0]                       # local rows = V / tp
+        off = lax.axis_index(self.axis) * vp
+        local = ids - off
+        ok = (local >= 0) & (local < vp)
+        safe = jnp.clip(local, 0, vp - 1)
+        self._cache = (safe, ok, table.shape, table.dtype)
+        out = jnp.take(table, safe, axis=0)
+        out = jnp.where(ok[..., None], out, jnp.zeros((), out.dtype))
+        return lax.psum(out, self.axis)
+
+    def backward(self, dy):
+        # HAND rule (like _TPCopy/_TPReduce): the activations' cotangent is
+        # already replicated across the TP axis, so the psum's transpose is
+        # identity here — the auto-vjp would psum it again, scaling the
+        # table grad by tp_size. Scatter-add the masked rows locally.
+        safe, ok, tshape, tdtype = self._cache
+        dyv = jnp.where(ok[..., None], dy, jnp.zeros((), dy.dtype))
+        flat_idx = safe.reshape(-1)
+        flat_dy = dyv.reshape(-1, dy.shape[-1])
+        dtable = jnp.zeros(tshape, dy.dtype).at[flat_idx].add(flat_dy)
+        return None, dtable.astype(tdtype)
+
+
+class _VocabParallelSCE(Operator):
+    """Fused softmax-CE over VOCAB-SHARDED logits (Megatron's parallel
+    cross-entropy): x is this device's (N, V/tp) logits slice, t the global
+    target ids. Max/sum-exp/target-logit each need one scalar-per-row psum —
+    the full (N, V) logits are never materialized on any device. Columns at
+    global index >= valid_vocab (tying/padding rows) are masked out of the
+    partition function. Hand backward mirrors SoftMaxCrossEntropy: local
+    (softmax - onehot)/N, no collective."""
+
+    def __init__(self, axis, valid_vocab=None):
+        super().__init__("VocabParallelSCE")
+        self.axis = axis
+        self.valid_vocab = valid_vocab
+        self._cache = None
+
+    def forward(self, x, t):
+        assert x.ndim == 2, "flatten logits to (N, V/tp) first"
+        self._in_dtype = x.dtype
+        x = x.astype(jnp.float32)
+        vp = x.shape[-1]
+        off = lax.axis_index(self.axis) * vp
+        if self.valid_vocab is not None:
+            gcol = off + jnp.arange(vp)[None, :]
+            x = jnp.where(gcol < self.valid_vocab, x, -jnp.inf)
+        m = lax.pmax(jnp.max(x, axis=-1), self.axis)        # (N,)
+        z = jnp.exp(x - m[:, None])                          # exp(-inf)=0
+        s = lax.psum(jnp.sum(z, axis=-1), self.axis)         # (N,)
+        local_t = t - off
+        ok = (local_t >= 0) & (local_t < vp)
+        safe = jnp.clip(local_t, 0, vp - 1)
+        tl = jnp.where(ok,
+                       jnp.take_along_axis(x, safe[:, None], -1)[:, 0],
+                       0.0)
+        tl = lax.psum(tl, self.axis)                         # (N,)
+        self._cache = (z, s, safe, ok)
+        return jnp.mean(jnp.log(s) + m - tl)
+
+    def backward(self, dy):
+        z, s, safe, ok = self._cache
+        n = z.shape[0]
+        p = z / s[:, None]                      # local softmax slice
+        onehot = ((jnp.arange(z.shape[-1])[None, :] == safe[:, None])
+                  & ok[:, None])
+        dx = (p - onehot.astype(p.dtype)) * (dy / n)
+        return dx.astype(self._in_dtype), None  # no grad for targets
+
+
+class _GatherLastDim(Operator):
+    """all_gather shards over `axis` onto the last dim (tiled) — used to
+    assemble full logits from a vocab-parallel head for the caller-facing
+    output. Hand backward: each shard keeps its slice of the replicated
+    cotangent."""
+
+    def __init__(self, axis):
+        super().__init__("GatherLastDim")
+        self.axis = axis
+        self._local = None
+
+    def forward(self, x):
+        self._local = x.shape[-1]
+        return lax.all_gather(x, self.axis, axis=x.ndim - 1, tiled=True)
+
+    def backward(self, dy):
+        # replicated cotangent -> each shard keeps its own slice (hand
+        # rule for the same reason as _VocabParallelEmbedding.backward)
+        off = lax.axis_index(self.axis) * self._local
+        return lax.dynamic_slice_in_dim(dy, off, self._local,
+                                        axis=dy.ndim - 1)
+
+
+class _VocabParallelArgmax(Operator):
+    """Global argmax over vocab-sharded logits: each device reduces its
+    (…, V/tp) slice, a tiny (tp, …) all_gather of the per-shard winners
+    picks the global one — the cheap alternative to gathering full logits
+    when the caller only wants predictions."""
+
+    never_requires_grad = True
+
+    def __init__(self, axis, valid_vocab=None):
+        super().__init__("VocabParallelArgmax")
+        self.axis = axis
+        self.valid_vocab = valid_vocab
+
+    def forward(self, x):
+        vp = x.shape[-1]
+        off = lax.axis_index(self.axis) * vp
+        if self.valid_vocab is not None:
+            gcol = off + jnp.arange(vp)
+            x = jnp.where(gcol < self.valid_vocab, x, -jnp.inf)
+        v = jnp.max(x, axis=-1)
+        a = jnp.argmax(x, axis=-1).astype(jnp.int32) + off.astype(jnp.int32)
+        vs = lax.all_gather(v, self.axis)            # (tp, ...)
+        gs = lax.all_gather(a, self.axis)
+        w = jnp.argmax(vs, axis=0)                   # (...)
+        return jnp.take_along_axis(gs, w[None], axis=0)[0]
+
+
+def vocab_parallel_embedding(ids, table, axis):
+    return _VocabParallelEmbedding(axis)(ids, table)
+
+
+def vocab_parallel_argmax(x, axis, valid_vocab=None):
+    return _VocabParallelArgmax(axis, valid_vocab)(x)
+
+
+def vocab_parallel_sce(x, t, axis, valid_vocab=None):
+    return _VocabParallelSCE(axis, valid_vocab)(x, t)
+
+
+def gather_last(x, axis):
+    return _GatherLastDim(axis)(x)
+
+
 class _FlashAttention(Operator):
     """Fused attention on the tape; forward is the Pallas flash kernel (or
     its reference fallback), backward is its custom_vjp (ops/attention.py)."""

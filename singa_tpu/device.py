@@ -14,6 +14,7 @@ stream (the reference keeps curand state in `Context`, common.h:99-128).
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 # process-global: jax.profiler allows one active trace per process
@@ -58,6 +59,19 @@ class Device:
 
     @rng_state.setter
     def rng_state(self, key):
+        # Normalize RAW uint32 keys (legacy jax.random.PRNGKey) to TYPED
+        # keys: the framework threads rng_state through jitted/shard_mapped
+        # steps, and a mid-stream dtype flip (typed <-> raw) fragments the
+        # executable cache into variants with different buffer layouts —
+        # an INVALID_ARGUMENT buffer-count crash at dispatch time.
+        try:
+            if (isinstance(key, jax.Array)
+                    and not jnp.issubdtype(key.dtype, jax.dtypes.prng_key)
+                    and key.ndim == 1 and key.shape[0] == 2
+                    and key.dtype == jnp.uint32):
+                key = jax.random.wrap_key_data(key)
+        except Exception:
+            pass  # tracers/None/host values pass through untouched
         self._rng_key = key
 
     # ---- graph control (parity with core_device.i) ----------------------

@@ -256,22 +256,36 @@ class Gemm(Layer):
 
 
 class Embedding(Layer):
-    """Token-id -> vector table lookup (ref layer.py:466)."""
+    """Token-id -> vector table lookup (ref layer.py:466).
 
-    def __init__(self, input_dim, output_dim, initializer_fn=None, name=None):
+    `tp_axis` row-shards the (V, E) table over that mesh axis
+    (Megatron vocab-parallel embedding): each device gathers only ids in
+    its vocab range and one psum assembles the activations — the model's
+    largest tensor stops being replicated. V must divide by the axis size
+    (pad the vocab, e.g. to a multiple of 128, as GPT(vocab_tp=) does)."""
+
+    def __init__(self, input_dim, output_dim, initializer_fn=None, name=None,
+                 tp_axis: "str | None" = None):
         super().__init__(name)
         self.input_dim = input_dim
         self.output_dim = output_dim
         self.initializer_fn = initializer_fn
+        self.tp_axis = tp_axis
 
     def initialize(self, x):
         W = Tensor((self.input_dim, self.output_dim), device=x.device,
                    dtype=tensor_module.float32)
         (self.initializer_fn or initializer.glorot_uniform)(W)
+        if self.tp_axis is not None:
+            from jax.sharding import PartitionSpec as P
+            W.spec = P(self.tp_axis, None)
         self._register_param("W", W)
 
     def forward(self, x):
         # cast AFTER the lookup: (B,S,D) activations, not the (V,D) table
+        if self.tp_axis is not None and autograd.axis_bound(self.tp_axis):
+            return autograd.compute_cast(
+                autograd.vocab_parallel_embedding(x, self.W, self.tp_axis))
         return autograd.compute_cast(autograd.embedding(x, self.W))
 
 
@@ -696,10 +710,13 @@ class MultiHeadAttention(Layer):
 class TransformerBlock(Layer):
     """Pre-LN block: x + MHA(LN(x)); x + MLP(LN(x)). `tp_axis` makes the
     attention head-parallel and the MLP column→row parallel (two psums per
-    block total, the Megatron layout)."""
+    block total, the Megatron layout). `moe_experts > 0` replaces the dense
+    MLP with a top-`moe_k` MoE FFN (expert-parallel over `ep_axis`); the
+    router losses surface on `self.moe.{aux_loss,z_loss}` after forward."""
 
     def __init__(self, num_heads, mlp_ratio=4, causal=True, seq_axis=None,
-                 tp_axis=None, attn_bias=False, name=None):
+                 tp_axis=None, attn_bias=False, moe_experts=0, moe_k=1,
+                 ep_axis=None, moe_capacity_factor=1.25, name=None):
         super().__init__(name)
         self.ln1 = LayerNorm()
         self.attn = MultiHeadAttention(num_heads, causal=causal,
@@ -708,15 +725,24 @@ class TransformerBlock(Layer):
         self.ln2 = LayerNorm()
         self.mlp_ratio = mlp_ratio
         self.tp_axis = tp_axis
+        self.moe_experts = moe_experts
+        if moe_experts:
+            self.moe = MoE(moe_experts, capacity_factor=moe_capacity_factor,
+                           ep_axis=ep_axis, k=moe_k)
 
     def initialize(self, x):
         e = x.shape[-1]
+        if self.moe_experts:
+            self.moe.hidden = e * self.mlp_ratio
+            return
         self.fc1 = Linear(e * self.mlp_ratio, tp_axis=self.tp_axis,
                           tp_mode="column")
         self.fc2 = Linear(e, tp_axis=self.tp_axis, tp_mode="row")
 
     def forward(self, x):
         x = autograd.add(x, self.attn(self.ln1(x)))
+        if self.moe_experts:
+            return autograd.add(x, self.moe(self.ln2(x)))
         h = autograd.gelu(self.fc1(self.ln2(x)))
         return autograd.add(x, self.fc2(h))
 
@@ -726,22 +752,31 @@ class MoE(Layer):
 
     `ep_axis` shards experts over that mesh axis (all_to_all dispatch,
     parallel/moe.py); out of mesh scope it falls back to the dense path.
-    After forward, `self.aux_loss` holds the load-balancing loss as a tape
-    Tensor — add `autograd.mul(moe.aux_loss, weight)` into the training
-    loss INSIDE train_one_batch (it participates in the same trace; reading
-    it outside a jitted step is undefined). Under ep_axis, expert-param
-    gradients are pre-scaled so a mean-reduction over the axis (DistOpt
-    semantics) recovers the dense-equivalent gradient.
+    `k` routes each token to its top-k experts with renormalized gates
+    (k=1: Switch; k=2: GShard/ST-MoE default). After forward,
+    `self.aux_loss` holds the load-balancing loss and `self.z_loss` the
+    router z-loss as tape Tensors — add `autograd.mul(moe.aux_loss, w)`
+    (and optionally the z-loss, ST-MoE weight ~1e-3) into the training
+    loss INSIDE train_one_batch (they participate in the same trace;
+    reading them outside a jitted step is undefined); `self.overflow` is
+    the dropped-route fraction for monitoring. To TRAIN under ep_axis on a
+    {data, ep} mesh, the gradient reduction must cover BOTH axes:
+    `DistOpt(axis=(data_axis, ep_axis), mesh=mesh)` — reducing over data
+    alone leaves expert grads (and every replicated param) diverging
+    across the ep axis.
     """
 
     def __init__(self, num_experts, hidden=None, capacity_factor=1.25,
-                 ep_axis=None, name=None):
+                 ep_axis=None, k=1, name=None):
         super().__init__(name)
         self.num_experts = num_experts
         self.hidden = hidden
         self.capacity_factor = capacity_factor
         self.ep_axis = ep_axis
+        self.k = k
         self.aux_loss = None
+        self.z_loss = None
+        self.overflow = None
 
     def initialize(self, x):
         d = x.shape[-1]
@@ -765,22 +800,11 @@ class MoE(Layer):
 
     def forward(self, x):
         op = _MoEOp(self)
-        y, aux = op(x, self.Wg, self.W1, self.b1, self.W2, self.b2)
-        self.aux_loss = aux  # tape Tensor; see class docstring
+        y, aux, z, ovf = op(x, self.Wg, self.W1, self.b1, self.W2, self.b2)
+        self.aux_loss = aux  # tape Tensors; see class docstring
+        self.z_loss = z
+        self.overflow = ovf
         return y
-
-
-def _grad_scale(x, factor):
-    """Identity whose cotangent is scaled by `factor` (compensates a later
-    mean-reduction over a mesh axis)."""
-    import jax
-
-    @jax.custom_vjp
-    def f(v):
-        return v
-
-    f.defvjp(lambda v: (v, None), lambda _, g: (g * factor,))
-    return f(x)
 
 
 class _MoEOp(autograd.Operator):
@@ -803,17 +827,22 @@ class _MoEOp(autograd.Operator):
                 in_mesh = False
         if in_mesh:
             # params are replicated; each device computes only its expert
-            # slice; grad-scale by n so the step's pmean over ep_axis
-            # yields the dense-equivalent expert gradient
+            # slice. No grad pre-scaling: under the required
+            # DistOpt(axis=(data, ep)) tuple reduction, slice-e cotangents
+            # exist on exactly the `data`-group devices (each covering a
+            # disjoint token set via the all_to_all transpose), so the
+            # psum/world_size mean already equals the serial token-mean
+            # gradient (verified by test_moe_gpt_model_api).
             my = _lax.axis_index(lyr.ep_axis)
             el = W1.shape[0] // n
-            sl = lambda a: _grad_scale(
-                _lax.dynamic_slice_in_dim(a, my * el, el, 0), n)
-            y, aux = moe_ffn_ep(flat, Wg, sl(W1), sl(b1), sl(W2), sl(b2),
-                                lyr.ep_axis, lyr.capacity_factor)
+            sl = lambda a: _lax.dynamic_slice_in_dim(a, my * el, el, 0)
+            y, aux, (z, ovf) = moe_ffn_ep(
+                flat, Wg, sl(W1), sl(b1), sl(W2), sl(b2),
+                lyr.ep_axis, lyr.capacity_factor, k=lyr.k)
         else:
-            y, aux = moe_ffn(flat, Wg, W1, b1, W2, b2, lyr.capacity_factor)
-        return y.reshape(shape), aux
+            y, aux, (z, ovf) = moe_ffn(flat, Wg, W1, b1, W2, b2,
+                                       lyr.capacity_factor, k=lyr.k)
+        return y.reshape(shape), aux, z, ovf
 
 
 # ---- recurrent (ref layer.py:1115-1347 + CudnnRNN:1550) ------------------

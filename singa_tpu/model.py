@@ -125,14 +125,19 @@ class Model(Layer, metaclass=ModelMeta):
         self._compiled_eval = None
 
     def compile(self, inputs, is_train=True, use_graph=False,
-                sequential=False, pipeline_axis=None, n_micro=1, amp=None,
-                eval_buckets=False):
+                sequential=False, pipeline_axis=None, n_micro=1,
+                pipeline_schedule="gpipe", amp=None,
+                eval_buckets="auto"):
         """Dummy forward with concrete inputs to init all params
         (ref model.py:156-184).
 
-        pipeline_axis/n_micro: mesh axis + microbatch count for GPipe
-        pipeline execution; consumed by pipeline-capable models (e.g.
+        pipeline_axis/n_micro: mesh axis + microbatch count for pipeline
+        execution; consumed by pipeline-capable models (e.g.
         models.transformer.PipelinedGPT) at param-init time.
+        pipeline_schedule: "gpipe" (autodiff through the forward scan; all
+        microbatch residuals live until backward) or "1f1b" (fused
+        fwd+bwd interleave with in-schedule loss; in-flight activations
+        bounded by ~2*stages, stage vjp rematerialized).
 
         amp: compute dtype for mixed-precision training ("bfloat16"):
         fp32 master weights with differentiable casts at matmul/conv
@@ -141,13 +146,19 @@ class Model(Layer, metaclass=ModelMeta):
         eval_buckets: pad varying eval batch sizes to power-of-two buckets
         (O(log B) compiled variants instead of a retrace per size). Only
         valid when forward's outputs are all per-sample — a forward that
-        reduces over the batch dim would average in the padding."""
+        reduces over the batch dim would average in the padding. Default
+        "auto": the first eval call detects whether every output is
+        per-sample (leading dim == batch) and enables bucketing for later
+        batch sizes only if so; True forces it (loud error on
+        non-per-sample outputs), False disables it."""
         assert len(inputs) > 0 and isinstance(inputs[0], Tensor)
         self._device = inputs[0].device
         self.graph_mode = use_graph
         self.sequential = sequential
+        assert pipeline_schedule in ("gpipe", "1f1b"), pipeline_schedule
         self.pipeline_axis = pipeline_axis
         self.n_micro = n_micro
+        self.pipeline_schedule = pipeline_schedule
         if amp in ("bf16", True):
             amp = "bfloat16"
         self.amp = amp
@@ -203,6 +214,27 @@ class Model(Layer, metaclass=ModelMeta):
         dist = (isinstance(opt, DistOpt)
                 and opt.communicator.mesh is not None
                 and opt.communicator.mesh.size > 1)
+        if dist:
+            # Expert-parallel layers REQUIRE the gradient reduction to
+            # cover their ep axis (tuple DistOpt axis): reducing over data
+            # alone leaves each ep rank's replicated expert tables updated
+            # from only its own slice grads — silent divergence, so refuse.
+            mesh_axes = set(opt.communicator.mesh.shape.keys())
+            red_axes = set(opt.axis if isinstance(opt.axis, tuple)
+                           else (opt.axis,))
+            stack = [self]
+            while stack:
+                lyr = stack.pop()
+                stack.extend(getattr(lyr, "_layers", {}).values())
+                ep = getattr(lyr, "ep_axis", None)
+                if (ep is not None and hasattr(lyr, "num_experts")
+                        and ep in mesh_axes and ep not in red_axes):
+                    raise ValueError(
+                        f"MoE layer routes experts over mesh axis '{ep}' "
+                        f"but DistOpt reduces only over {sorted(red_axes)}"
+                        f"; expert gradients would diverge across '{ep}'. "
+                        f"Use DistOpt(axis={tuple(sorted(red_axes) + [ep])}"
+                        f", mesh=mesh)")
 
         states = self.get_states()
         state_tensors = list(states.values())
@@ -230,8 +262,10 @@ class Model(Layer, metaclass=ModelMeta):
                 if opt is not None:
                     opt._partial_static_idx = tag
                 if dist:
+                    # flattened rank (communicator handles tuple axes for
+                    # multi-axis reductions like DP+EP)
                     dev.rng_state = jax.random.fold_in(
-                        rng, lax.axis_index(opt.axis))
+                        rng, opt.communicator.rank())
                 else:
                     dev.rng_state = rng
                 for t, a in zip(state_tensors, state_arrs):
@@ -498,6 +532,10 @@ class Model(Layer, metaclass=ModelMeta):
             eval_tensors = list(states.values())
 
             def efwd(state_arrs, input_arrs):
+                # host-side trace counter: jit re-runs this body only on a
+                # retrace, so tests can assert bucketing avoids retraces
+                self._eval_trace_count = \
+                    getattr(self, "_eval_trace_count", 0) + 1
                 for t, a in zip(eval_tensors, state_arrs):
                     t.data = a
                 prev = autograd.training
@@ -518,16 +556,22 @@ class Model(Layer, metaclass=ModelMeta):
             self._eval_tensors = eval_tensors
             self._compiled_eval = jax.jit(efwd)
         concrete = [t.data for t in self._eval_tensors]
-        # batch-shape bucketing (opt-in, compile(eval_buckets=True)): pad
-        # the batch dim up to the next power of two so varying eval sizes
-        # (e.g. the last partial batch) reuse O(log B) compiled variants
-        # instead of retracing per size. Only sound when every output is
-        # per-sample (leading dim == batch); a forward that reduces over
-        # the batch would see the zero padding.
+        # batch-shape bucketing: pad the batch dim up to the next power of
+        # two so varying eval sizes (e.g. the last partial batch) reuse
+        # O(log B) compiled variants instead of retracing per size. Only
+        # sound when every output is per-sample (leading dim == batch); a
+        # forward that reduces over the batch would see the zero padding —
+        # so the default "auto" mode probes the first (unbucketed) call's
+        # output shapes and enables bucketing only when they are all
+        # per-sample; compile(eval_buckets=True) forces it.
         arrs = [a.data for a in args]
         nb = arrs[0].shape[0] if arrs and arrs[0].ndim > 0 else None
+        mode = getattr(self, "eval_buckets", "auto")
+        enabled = (mode is True or
+                   (mode == "auto"
+                    and getattr(self, "_eval_per_sample", None) is True))
         bucket = None
-        if getattr(self, "eval_buckets", False) and nb is not None \
+        if enabled and nb is not None \
                 and nb > 0 and all(
                 a.ndim > 0 and a.shape[0] == nb for a in arrs):
             bucket = 1
@@ -559,9 +603,36 @@ class Model(Layer, metaclass=ModelMeta):
             for o in outs:
                 if o.ndim == 0 or o.shape[0] != bucket:
                     raise ValueError(
-                        f"eval_buckets=True requires per-sample outputs; "
-                        f"got shape {o.shape} with batch bucket {bucket}")
+                        f"eval_buckets requires per-sample outputs; "
+                        f"got shape {o.shape} with batch bucket {bucket} "
+                        f"(compile with eval_buckets=False to retrace "
+                        f"per shape instead)")
             outs = [o[:nb] for o in outs]
+        elif mode == "auto" and nb is not None and \
+                getattr(self, "_eval_per_sample", None) is None:
+            # auto-detect on the first (unbucketed) call. Shape alone is
+            # not proof — a batch-coupled output (softmax over axis 0) is
+            # batch-shaped too — so PROBE semantics: re-run on the first
+            # half of the batch and require out(x[:h]) == out(x)[:h].
+            # Costs one extra half-size compile on the first eval only.
+            shaped = all(o.ndim > 0 and o.shape[0] == nb for o in outs)
+            ok = False
+            if shaped and nb > 1:
+                h = nb // 2
+                try:
+                    houts = self._compiled_eval(
+                        concrete, [a[:h] for a in arrs])
+                    ok = all(
+                        np.allclose(np.asarray(jax.device_get(ho)),
+                                    np.asarray(jax.device_get(o))[:h],
+                                    rtol=1e-4, atol=1e-5)
+                        for ho, o in zip(houts, outs))
+                except Exception:
+                    ok = False
+                finally:
+                    for t, a in zip(self._eval_tensors, concrete):
+                        t.data = a
+            self._eval_per_sample = shaped and ok
         tensors = [Tensor(data=a, device=self._device, requires_grad=False)
                    for a in outs]
         return _rebuild_out(self._eval_template, tensors)

@@ -56,6 +56,160 @@ def gpipe(stage_fn, stage_params, x_micro, axis_name):
     return outs
 
 
+def one_f_one_b(stage_fn, last_fn, stage_params, last_params, x_micro,
+                tgt_micro, axis_name):
+    """1F1B schedule as one fused fwd+bwd scan (Megatron's memory-bounded
+    pipeline, in SPMD form).
+
+    GPipe-by-autodiff (`gpipe` + jax.vjp) must finish ALL forwards before
+    any backward, so every stage holds n_micro residual sets. Here forward
+    of microbatch m+Δ overlaps backward of microbatch m inside ONE scan:
+
+        t_fwd(stage s, mb m)  = s + m
+        t_bwd(stage s, mb m)  = 2n - 1 - s + m
+
+    so in steady state every slot does one fwd AND one bwd (both useful
+    work), the cotangent ring runs opposite to the activation ring, and a
+    stage's in-flight saved activations are bounded by t_bwd - t_fwd =
+    2(n - s) - 1 <= 2n - 1 — independent of n_micro. Only the stage INPUT
+    is saved (activation checkpointing at stage boundaries); the stage vjp
+    is recomputed when the cotangent arrives.
+
+    The LOSS lives inside the schedule: `last_fn(last_params, y, tgt)` is
+    applied by the last stage (LN/head/CE for a GPT), because 1F1B's
+    interleaving is only possible when the backward can start while other
+    microbatches are still going forward — a tape op that returns
+    activations and waits for a cotangent cannot interleave by
+    construction.
+
+    Returns (loss_mean, outs, d_stage_params, d_last_params, dx_micro):
+      loss_mean  — mean over microbatches, broadcast to every stage
+      outs       — (n_micro, mb, ...) last-stage activations (for the
+                   caller-facing logits path), valid on the last stage
+      d_stage_params — this device's stage-param cotangents (local slice)
+      d_last_params  — last_fn param cotangents, psum'd over the axis so
+                   replicated params see replicated grads
+      dx_micro   — cotangent of x_micro, nonzero on stage 0 (psum it over
+                   the axis if the producer is replicated — Model's
+                   tp_copy on the pipeline input already does)
+    """
+    import jax
+
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = x_micro.shape[0]
+    BUF = min(2 * n, M) if M > 0 else 1
+    T = M + 2 * n - 2        # last slot index: t_bwd(0, M-1) = (2n-1)+(M-1)
+    perm_fwd = [(i, (i + 1) % n) for i in range(n)]
+    perm_bwd = [(i, (i - 1) % n) for i in range(n)]
+    is_last = stage == n - 1
+    is_first = stage == 0
+
+    zero_stage_g = jax.tree.map(jnp.zeros_like, stage_params)
+    zero_last_g = jax.tree.map(jnp.zeros_like, last_params)
+
+    act_buf = jnp.zeros((BUF,) + x_micro.shape[1:], x_micro.dtype)
+    outs = jnp.zeros_like(x_micro)
+    dx_out = jnp.zeros_like(x_micro)
+    fwd_buf = jnp.zeros_like(x_micro[0])
+    bwd_buf = jnp.zeros_like(x_micro[0])
+    loss_acc = jnp.zeros((), jnp.float32)
+
+    def slot(carry, t):
+        (act_buf, outs, dx_out, fwd_buf, bwd_buf, d_stage, d_last,
+         loss_acc) = carry
+
+        # ---- backward half, part 1: read mb m_b's saved input BEFORE the
+        # forward half reuses its circular-buffer slot (when M < 2n the
+        # consuming and producing microbatch can share a slot in the same
+        # scan iteration) ----
+        m_b = t - (2 * n - 1 - stage)
+        b_on = (m_b >= 0) & (m_b < M)
+        m_b_safe = jnp.clip(m_b, 0, M - 1)
+        x_saved = lax.dynamic_index_in_dim(act_buf, m_b_safe % BUF, 0,
+                                           keepdims=False)
+        tgt_b = lax.dynamic_index_in_dim(tgt_micro, m_b_safe, 0,
+                                         keepdims=False)
+
+        # ---- forward half: mb m_f enters this stage ----
+        m_f = t - stage
+        f_on = (m_f >= 0) & (m_f < M)
+        m_f_safe = jnp.clip(m_f, 0, M - 1)
+        x_in = jnp.where(is_first,
+                         lax.dynamic_index_in_dim(x_micro, m_f_safe, 0,
+                                                  keepdims=False),
+                         fwd_buf)
+        y = stage_fn(stage_params, x_in)
+        # save the stage INPUT for the remat vjp at backward time
+        slot_i = m_f_safe % BUF
+        prev = lax.dynamic_index_in_dim(act_buf, slot_i, 0, keepdims=False)
+        act_buf = lax.dynamic_update_index_in_dim(
+            act_buf, jnp.where(f_on, x_in, prev), slot_i, 0)
+        o_prev = lax.dynamic_index_in_dim(outs, m_f_safe, 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(f_on & is_last, y, o_prev), m_f_safe, 0)
+
+        # ---- backward half, part 2: remat + vjp ----
+
+        # remat: rebuild this stage's vjp from the saved input
+        y_b, stage_vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        # last stage seeds the cotangent from the in-schedule loss
+        loss_m, last_vjp = jax.vjp(last_fn, last_params, y_b, tgt_b)
+        dlast_m, dy_loss, _ = last_vjp(jnp.float32(1.0 / M))
+        dy_in = jnp.where(is_last, dy_loss.astype(bwd_buf.dtype), bwd_buf)
+        dparams_m, dx_m = stage_vjp(dy_in.astype(y_b.dtype))
+
+        gate = b_on.astype(jnp.float32)
+        lgate = (b_on & is_last).astype(jnp.float32)
+        d_stage = jax.tree.map(
+            lambda acc, g: acc + g * gate.astype(g.dtype),
+            d_stage, dparams_m)
+        d_last = jax.tree.map(
+            lambda acc, g: acc + g * lgate.astype(g.dtype),
+            d_last, dlast_m)
+        loss_acc = loss_acc + loss_m.astype(jnp.float32) * lgate / M
+        dxp = lax.dynamic_index_in_dim(dx_out, m_b_safe, 0, keepdims=False)
+        dx_out = lax.dynamic_update_index_in_dim(
+            dx_out, jnp.where(b_on & is_first, dx_m, dxp), m_b_safe, 0)
+
+        # rings: activations flow down-stage, cotangents up-stage
+        fwd_buf = lax.ppermute(jnp.where(f_on, y, jnp.zeros_like(y)),
+                               axis_name, perm_fwd)
+        bwd_buf = lax.ppermute(
+            jnp.where(b_on, dx_m, jnp.zeros_like(dx_m)).astype(
+                bwd_buf.dtype),
+            axis_name, perm_bwd)
+        return (act_buf, outs, dx_out, fwd_buf, bwd_buf, d_stage, d_last,
+                loss_acc), None
+
+    carry = (act_buf, outs, dx_out, fwd_buf, bwd_buf, zero_stage_g,
+             zero_last_g, loss_acc)
+    carry, _ = lax.scan(slot, carry, jnp.arange(T + 1))
+    (act_buf, outs, dx_out, fwd_buf, bwd_buf, d_stage, d_last,
+     loss_acc) = carry
+    loss_mean = last_stage_value(loss_acc, axis_name)
+    d_last = jax.tree.map(lambda g: lax.psum(g, axis_name), d_last)
+    return loss_mean, outs, d_stage, d_last, dx_out
+
+
+def pipeline_bubble_fraction(n_stages: int, n_micro: int,
+                             schedule: str = "gpipe") -> float:
+    """Idle fraction of the pipeline schedule (reported by the dryrun).
+
+    gpipe: (n-1) warmup + (n-1) drain slots around n_micro useful slots,
+    in each of the forward and backward phases -> (n-1)/(n_micro+n-1).
+    1f1b: the fused scan runs n_micro + 2n - 1 slots (arange(T+1) in
+    one_f_one_b), each slot worth one microbatch of fwd+bwd when fully
+    utilized, n_micro of them useful -> (2n-1)/(n_micro+2n-1).
+    """
+    n, M = n_stages, n_micro
+    if n <= 1 or M <= 0:
+        return 0.0
+    if schedule == "1f1b":
+        return (2 * n - 1) / (M + 2 * n - 1)
+    return (n - 1) / (M + n - 1)
+
+
 def last_stage_value(x, axis_name):
     """Broadcast the last stage's value to every device (psum of a one-hot
     mask — cheap for scalars/small outputs like a loss)."""

@@ -146,3 +146,24 @@ def post_order_recursive(root, root_t):
         for src_op, _, x, _ in reversed(op.src):
             stack.append((src_op, x, False))
     return out
+
+
+def dense_allreduce_types(hlo: str):
+    """Operand types of every NON-SCALAR all-reduce in lowered executable
+    text — the wire-level detector behind the sparse-allreduce regression
+    gate (a packed sparse step may contain only scalar all-reduces, e.g.
+    the loss pmean). Handles both classic HLO (`f32[10,16] all-reduce(`)
+    and StableHLO (`"stablehlo.all_reduce"(...) ... }) : (tensor<10x16xf32>)`).
+    Used by tests/test_dist.py and the driver dryrun (__graft_entry__)."""
+    import re
+    dense = []
+    for mt in re.finditer(r"(\S+)\s+all-reduce(?:-start)?\(", hlo):
+        shape = mt.group(1)
+        if not re.match(r"(f32|bf16|pred|s32|u32)\[\]", shape):
+            dense.append(shape)
+    for mt in re.finditer(r'"stablehlo\.all_reduce"', hlo):
+        seg = hlo[mt.start():mt.start() + 6000]
+        t = re.search(r"\}\) : \(tensor<([^>]+)>", seg)
+        if t and "x" in t.group(1):
+            dense.append(f"tensor<{t.group(1)}>")
+    return dense

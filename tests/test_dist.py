@@ -179,6 +179,31 @@ def test_threshold_payload_is_packed(dev, rng, mesh):
     assert f"8x{cap}x" in hlo
 
 
+from singa_tpu.utils import dense_allreduce_types as _dense_allreduce_types
+
+
+def test_sparse_step_hlo_is_packed(dev, mesh, data):
+    """Wire-level guarantee for strategy 4 THROUGH the compiled Model step
+    (VERDICT r2 #8): the executable's gradient collectives are capacity-
+    sized all-gathers of (index, value) pairs — k = n*spars elements per
+    shard — and NO param-shaped dense all-reduce exists. Fails if anyone
+    regresses the sparse path to dense (ref communicator.cc:619-719)."""
+    X, Y = data
+    m, _, _ = _run(MLPSparse, dev, mesh, X, Y, steps=2)
+    hlo = m.lower_step().as_text()
+    assert "stablehlo.all_reduce" in hlo or "all-reduce" in hlo  # sanity:
+    # the scalar loss pmean must be present, so the detector can't be
+    # vacuously green on a renamed dialect
+    dense = _dense_allreduce_types(hlo)
+    assert not dense, f"dense all-reduce of {dense} in sparse step"
+
+    # the packed payloads: top-25% of each param, gathered over 8 shards
+    # l1.W (10,16): k=40; l1.b (16,): k=4; l2.W (16,4): k=16; l2.b: k=1
+    for k in (40, 16, 4):
+        assert f"8x{k}]" in hlo or f"8x{k}x" in hlo.replace("]", "x"), \
+            f"missing capacity-{k} gathered payload"
+
+
 def test_partial_update_compiles_per_partition(dev, mesh, data):
     """Strategy 3 must produce k compiled step variants whose collectives
     cover different parameter partitions (true bandwidth rotation)."""
@@ -191,6 +216,40 @@ def test_partial_update_compiles_per_partition(dev, mesh, data):
         assert "all_reduce" in texts[tag] or "all-reduce" in texts[tag]
     # the synced shapes differ between partitions (l2 vs l1 params)
     assert texts[0] != texts[1]
+
+
+def test_broadcast_tree(dev, rng, mesh):
+    """Tree broadcast (VERDICT r2 #10): every device ends with ROOT's
+    value for any root, and the executable uses collective-permute rounds
+    (ceil(log2 n) of them) — no allreduce-of-masked-zeros."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    comm = Communicator(mesh=mesh)
+    x = rng.randn(8, 16).astype(np.float32)  # row i = device i's value
+
+    for root in (0, 3, 7):
+        def f(xs):
+            return comm.broadcast(xs, root=root)
+
+        out = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False))(x)
+        out = np.asarray(out)
+        for i in range(8):
+            np.testing.assert_allclose(out[i], x[root], atol=0,
+                                       err_msg=f"root={root} dev={i}")
+
+    hlo = jax.jit(jax.shard_map(
+        lambda xs: comm.broadcast(xs, root=0), mesh=mesh,
+        in_specs=P("data"), out_specs=P("data"),
+        check_vma=False)).lower(x).as_text()
+    assert "all-reduce" not in hlo and "all_reduce" not in hlo, \
+        "broadcast must not be a masked psum"
+    n_perm = sum(hlo.count(p) for p in
+                 ("collective-permute(", "collective-permute-start(",
+                  "collective_permute\"("))
+    assert 1 <= n_perm <= 3, f"expected <=log2(8) permute rounds, {n_perm}"
 
 
 def test_topk_error_feedback_identity(dev, rng, mesh):

@@ -127,6 +127,119 @@ def test_tp_gpt_through_model_api():
         (float(l_ser.numpy()), float(l_tp.numpy()))
 
 
+def test_tp_gpt_vocab_parallel():
+    """GPT(vocab_tp=True): the (V, E) embedding is row-sharded over tp and
+    the head is tied to it (Megatron vocab parallelism, VERDICT r2 #5).
+    Vocab 50 is NOT divisible by tp=4 — internal padding to a multiple of 8
+    (->56) must be invisible: losses match the same model run serially, and
+    the per-device embedding shard is V_pad/tp rows (param bytes drop)."""
+    from singa_tpu import models, opt, tensor
+    from singa_tpu.device import get_default_device
+
+    dev = get_default_device()
+    rng = np.random.RandomState(7)
+    V, B, S = 50, 4, 16
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    tx = tensor.from_numpy(ids, dev)
+    ty = tensor.from_numpy(tgt, dev)
+
+    def build(dist=False):
+        m = models.create_model(
+            "gpt", vocab_size=V, max_seq=S, dim=32, num_heads=4,
+            num_layers=2, tp_axis="tp", vocab_tp=True,
+            vocab_pad_multiple=8)
+        if dist:
+            mesh = make_mesh({"data": 2, "tp": 4})
+            m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.05), axis="data",
+                                        mesh=mesh))
+        else:
+            m.set_optimizer(opt.SGD(lr=0.05))
+        m.compile([tx], is_train=True, use_graph=True)
+        return m
+
+    m_ser = build()
+    assert m_ser.head is None, "vocab_tp must tie the head"
+    assert m_ser.padded_vocab == 56
+    w0 = {k: v.numpy().copy() for k, v in m_ser.get_params().items()}
+    assert not any("head" in k for k in w0), w0.keys()
+    m_tp = build(dist=True)
+    m_tp.set_params(w0)
+
+    for _ in range(3):
+        out_ser, l_ser = m_ser(tx, ty)
+        out_tp, l_tp = m_tp(tx, ty)
+    assert abs(float(l_ser.numpy()) - float(l_tp.numpy())) < 2e-3, \
+        (float(l_ser.numpy()), float(l_tp.numpy()))
+    # caller-facing logits are gathered + sliced back to the true vocab
+    assert out_ser.shape[-1] == V and out_tp.shape[-1] == V
+    np.testing.assert_allclose(out_ser.numpy()[:B], out_tp.numpy()[:B],
+                               atol=5e-3)
+
+    # the whole point: per-device embedding bytes dropped 4x (tp=4)
+    emb = m_tp.get_params()["tok_embed.W"] \
+        if "tok_embed.W" in m_tp.get_params() else None
+    if emb is None:  # param naming may be flat; find the (56, 32) table
+        emb = next(v for v in m_tp.get_params().values()
+                   if tuple(v.shape) == (56, 32))
+    shard = emb.data.addressable_shards[0].data
+    assert shard.shape[0] == 56 // 4, shard.shape
+
+    # trained embedding stays consistent with the serial run
+    e_ser = next(v for v in m_ser.get_params().values()
+                 if tuple(v.shape) == (56, 32))
+    np.testing.assert_allclose(e_ser.numpy(), emb.numpy(), atol=2e-3)
+
+
+def test_vocab_tp_requires_tp_axis():
+    """vocab_tp without tp_axis must raise, not silently build a
+    different (untied, unpadded) parameter set."""
+    import pytest
+    from singa_tpu import models
+    with pytest.raises(ValueError, match="tp_axis"):
+        models.create_model("gpt", vocab_size=50, vocab_tp=True)
+
+
+def test_tp_gpt_vocab_parallel_predictions_only():
+    """vocab_tp_return_logits=False: the train step never materializes
+    (B,S,V) logits — it returns per-token argmax predictions (B,S) int32
+    computed from the shards, and they match the gathered-logits argmax."""
+    from singa_tpu import models, opt, tensor
+    from singa_tpu.device import get_default_device
+
+    dev = get_default_device()
+    rng = np.random.RandomState(9)
+    V, B, S = 48, 4, 8
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    tx = tensor.from_numpy(ids, dev)
+    ty = tensor.from_numpy(tgt, dev)
+
+    def build(return_logits):
+        m = models.create_model(
+            "gpt", vocab_size=V, max_seq=S, dim=32, num_heads=4,
+            num_layers=1, tp_axis="tp", vocab_tp=True,
+            vocab_pad_multiple=8,
+            vocab_tp_return_logits=return_logits)
+        mesh = make_mesh({"data": 2, "tp": 4})
+        m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.0), axis="data",
+                                    mesh=mesh))
+        m.compile([tx], is_train=True, use_graph=True)
+        return m
+
+    m_full = build(True)
+    w0 = {k: v.numpy().copy() for k, v in m_full.get_params().items()}
+    m_pred = build(False)
+    m_pred.set_params(w0)
+
+    logits, l1 = m_full(tx, ty)
+    preds, l2 = m_pred(tx, ty)
+    assert abs(float(l1.numpy()) - float(l2.numpy())) < 1e-5
+    assert preds.shape == (B, S) and preds.numpy().dtype == np.int32
+    np.testing.assert_array_equal(preds.numpy(),
+                                  np.argmax(logits.numpy(), axis=-1))
+
+
 def test_pp_gpt_through_model_api():
     """PipelinedGPT on a {data:1, pp:4} mesh via Model.compile(
     pipeline_axis=, n_micro=) matches the same model run serially."""
@@ -170,6 +283,108 @@ def test_pp_gpt_through_model_api():
         np.testing.assert_allclose(m_ser.get_params()[k].numpy(),
                                    m_pp.get_params()[k].numpy(),
                                    atol=2e-3, err_msg=k)
+
+
+def test_pp_gpt_1f1b_matches_serial():
+    """pipeline_schedule="1f1b": the fused fwd+bwd interleaved schedule
+    (loss inside the pipeline, remat per stage, in-flight activations
+    bounded by ~2*stages) trains to the same losses/params as the serial
+    model — and therefore as GPipe (VERDICT r2 #6)."""
+    from singa_tpu import models, opt, tensor
+    from singa_tpu.device import get_default_device
+
+    dev = get_default_device()
+    rng = np.random.RandomState(11)
+    V, B, S = 40, 8, 8
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    tx = tensor.from_numpy(ids, dev)
+    ty = tensor.from_numpy(tgt, dev)
+
+    def build(pp=False):
+        m = models.create_model("gpt_pipe", vocab_size=V, max_seq=S,
+                                dim=16, num_heads=2, num_layers=4)
+        if pp:
+            mesh = make_mesh({"data": 1, "pp": 4})
+            m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.05), axis="data",
+                                        mesh=mesh))
+            m.compile([tx], is_train=True, use_graph=True,
+                      pipeline_axis="pp", n_micro=4,
+                      pipeline_schedule="1f1b")
+        else:
+            m.set_optimizer(opt.SGD(lr=0.05))
+            m.compile([tx], is_train=True, use_graph=True)
+        return m
+
+    m_ser = build()
+    w0 = {k: v.numpy().copy() for k, v in m_ser.get_params().items()}
+    m_pp = build(pp=True)
+    m_pp.set_params(w0)
+
+    for _ in range(3):
+        _, l_ser = m_ser(tx, ty)
+        _, l_pp = m_pp(tx, ty)
+    assert abs(float(l_ser.numpy()) - float(l_pp.numpy())) < 2e-3, \
+        (float(l_ser.numpy()), float(l_pp.numpy()))
+    for k in ("Wq", "W1", "ln_f.gamma", "tok_embed.W"):
+        np.testing.assert_allclose(m_ser.get_params()[k].numpy(),
+                                   m_pp.get_params()[k].numpy(),
+                                   atol=2e-3, err_msg=k)
+
+
+def test_pp_non_uniform_stages():
+    """num_layers % stages != 0 (VERDICT r2 #6): 5 layers over 4 stages —
+    stacks padded to 8 rows, masked to identity past row 5; numerics match
+    the serial model for BOTH schedules."""
+    from singa_tpu import models, opt, tensor
+    from singa_tpu.device import get_default_device
+
+    dev = get_default_device()
+    rng = np.random.RandomState(13)
+    V, B, S, L = 40, 8, 8, 5
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    tx = tensor.from_numpy(ids, dev)
+    ty = tensor.from_numpy(tgt, dev)
+
+    def build(schedule=None):
+        m = models.create_model("gpt_pipe", vocab_size=V, max_seq=S,
+                                dim=16, num_heads=2, num_layers=L)
+        if schedule:
+            mesh = make_mesh({"data": 1, "pp": 4})
+            m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.05), axis="data",
+                                        mesh=mesh))
+            m.compile([tx], is_train=True, use_graph=True,
+                      pipeline_axis="pp", n_micro=2,
+                      pipeline_schedule=schedule)
+        else:
+            m.set_optimizer(opt.SGD(lr=0.05))
+            m.compile([tx], is_train=True, use_graph=True)
+        return m
+
+    m_ser = build()
+    assert m_ser.get_params()["Wq"].shape[0] == L  # no padding serially
+    w0 = {k: v.numpy().copy() for k, v in m_ser.get_params().items()}
+
+    for schedule in ("gpipe", "1f1b"):
+        m_pp = build(schedule)
+        assert m_pp.get_params()["Wq"].shape[0] == 8, \
+            m_pp.get_params()["Wq"].shape  # padded to 4*ceil(5/4)
+        m_pp.set_params(w0)  # (5,...) loads into (8,...) real rows
+        losses = []
+        for _ in range(3):
+            _, l_ser = m_ser(tx, ty)
+            _, l_pp = m_pp(tx, ty)
+            losses = [float(l_ser.numpy()), float(l_pp.numpy())]
+        assert abs(losses[0] - losses[1]) < 2e-3, (schedule, losses)
+        # trained real rows match; padding rows untouched (zero weights)
+        wq_pp = m_pp.get_params()["Wq"].numpy()
+        np.testing.assert_allclose(m_ser.get_params()["Wq"].numpy(),
+                                   wq_pp[:L], atol=2e-3,
+                                   err_msg=schedule)
+        assert np.all(wq_pp[L:] == 0.0), schedule
+        # reset the serial model for the second schedule pass
+        m_ser.set_params(w0)
 
 
 def _stage_apply(params, x):
