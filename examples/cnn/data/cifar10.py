@@ -45,11 +45,19 @@ def normalize(x):
     return (x - MEAN) / STD
 
 
+#: True when the LAST load() returned the synthetic fallback — consumed by
+#: train drivers to tag accuracy printouts as not-meaningful.
+last_load_synthetic = False
+
+
 def load():
+    global last_load_synthetic
     d = _dir()
     if d is None:
         print("cifar10: dataset not found on disk; using synthetic data")
+        last_load_synthetic = True
         return synthetic()
+    last_load_synthetic = False
     xs, ys = [], []
     for i in range(1, 6):
         x, y = _read_batch(os.path.join(d, f"data_batch_{i}"))

@@ -12,7 +12,12 @@ SEARCH_DIRS = [
 ]
 
 
+#: True when the LAST load() returned the synthetic fallback.
+last_load_synthetic = False
+
+
 def load():
+    global last_load_synthetic
     d = None
     for c in SEARCH_DIRS:
         if os.path.exists(os.path.join(c, "train")):
@@ -20,7 +25,9 @@ def load():
             break
     if d is None:
         print("cifar100: dataset not found on disk; using synthetic data")
+        last_load_synthetic = True
         return cifar10.synthetic(num_classes=100)
+    last_load_synthetic = False
     tx, ty = cifar10._read_batch(os.path.join(d, "train"))
     vx, vy = cifar10._read_batch(os.path.join(d, "test"))
     return cifar10.normalize(tx), ty, cifar10.normalize(vx), vy

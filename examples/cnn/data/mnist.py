@@ -53,11 +53,19 @@ def synthetic(n_train=2048, n_val=512, seed=0):
     return tx, ty, vx, vy
 
 
+#: True when the LAST load() returned the synthetic fallback — consumed by
+#: train drivers to tag accuracy printouts as not-meaningful.
+last_load_synthetic = False
+
+
 def load():
+    global last_load_synthetic
     paths = {k: _find(v) for k, v in FILES.items()}
     if any(p is None for p in paths.values()):
         print("mnist: dataset not found on disk; using synthetic data")
+        last_load_synthetic = True
         return synthetic()
+    last_load_synthetic = False
     train_x = _read_idx(paths["train_x"]).astype(np.float32) / 255.0
     train_y = _read_idx(paths["train_y"]).astype(np.int32)
     val_x = _read_idx(paths["val_x"]).astype(np.float32) / 255.0

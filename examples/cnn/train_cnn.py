@@ -44,6 +44,11 @@ def run(args):
     loader = {"mnist": mnist, "cifar10": cifar10, "cifar100": cifar100,
               "digits": digits}
     train_x, train_y, val_x, val_y = loader[args.data].load()
+    # synthetic-fallback guard (zero-egress sandbox): accuracy printed on
+    # random tensors must never read like a real result
+    synth_tag = (" [SYNTHETIC-DATA: accuracy not meaningful]"
+                 if getattr(loader[args.data], "last_load_synthetic", False)
+                 else "")
 
     num_channels = train_x.shape[1]
     num_classes = int(np.max(train_y)) + 1
@@ -107,8 +112,8 @@ def run(args):
             tx.copy_from_numpy(x)
             out = model(tx)
             correct += accuracy(out.numpy(), y)
-        print(f"epoch {epoch}: eval acc={correct / (num_val_batch * bs):.4f}",
-              flush=True)
+        print(f"epoch {epoch}: eval acc={correct / (num_val_batch * bs):.4f}"
+              f"{synth_tag}", flush=True)
 
     dev.PrintTimeProfiling()
 

@@ -310,6 +310,24 @@ class SingaBackend:
         return autograd.softmax(self._t(env, node.inputs[0]),
                                 int(_attr(node.proto, "axis", -1)))
 
+    def op_LayerNormalization(self, node, env):
+        # opset 17; this framework's LayerNorm normalizes the last axis
+        axis = int(_attr(node.proto, "axis", -1))
+        x = self._t(env, node.inputs[0])
+        assert axis in (-1, len(x.shape) - 1), \
+            f"LayerNormalization axis {axis} unsupported (last axis only)"
+        if len(node.outputs) > 1:
+            raise NotImplementedError(
+                "LayerNormalization Mean/InvStdDev outputs not supported")
+        gamma = self._t(env, node.inputs[1])
+        if len(node.inputs) > 2 and node.inputs[2]:
+            beta = self._t(env, node.inputs[2])
+        else:  # bias input B is OPTIONAL in the ONNX spec
+            beta = from_numpy(
+                np.zeros(gamma.shape, np.float32), device=x.device)
+        return autograd.layernorm(x, gamma, beta,
+                                  float(_attr(node.proto, "epsilon", 1e-5)))
+
     def op_Clip(self, node, env):
         lo = self._const(env, node, 1, attr="min")
         hi = self._const(env, node, 2, attr="max")

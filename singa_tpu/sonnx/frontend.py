@@ -14,7 +14,7 @@ from .. import autograd
 from ..tensor import Tensor
 from . import onnx_pb as pb
 
-OPSET_VERSION = 13
+OPSET_VERSION = 17  # LayerNormalization needs 17; everything else <= 13
 
 
 class _Ctx:
@@ -219,6 +219,60 @@ def _emit(ctx, op, ins, outs):
     if t == "Cast":
         to = pb._NP2ONNX[np.dtype(op.to)]
         return [mk("Cast", ins, outs, to=to)]
+    if t == "Gelu":
+        # jax.nn.gelu defaults to the tanh approximation; opset<20 has no
+        # Gelu node, so emit the exact same formula:
+        # 0.5*x*(1+tanh(sqrt(2/pi)*(x+0.044715*x^3)))
+        x = ins[0]
+        c = lambda nm, v: _const_input(ctx, nm, np.float32(v))
+        n = lambda: ctx.fresh("gelu")
+        x3, xm, xa, xs, th, t1, hf = n(), n(), n(), n(), n(), n(), n()
+        return [
+            mk("Pow", [x, c("three", 3.0)], [x3]),
+            mk("Mul", [x3, c("k0", 0.044715)], [xm]),
+            mk("Add", [x, xm], [xa]),
+            mk("Mul", [xa, c("k1", 0.7978845608028654)], [xs]),
+            mk("Tanh", [xs], [th]),
+            mk("Add", [th, c("one", 1.0)], [t1]),
+            mk("Mul", [x, t1], [hf]),
+            mk("Mul", [hf, c("half", 0.5)], outs),
+        ]
+    if t == "LayerNorm":
+        # ONNX LayerNormalization (opset 17), normalize last axis
+        return [mk("LayerNormalization", ins, outs, axis=-1,
+                   epsilon=float(op.eps))]
+    if t == "_PosSlice":
+        # export path is single-device (no bound seq axis): rows [0, len)
+        return [mk("Slice", ins + [
+            _const_input(ctx, "starts", np.asarray([0], np.int64)),
+            _const_input(ctx, "ends", np.asarray([op.length], np.int64)),
+            _const_input(ctx, "axes", np.asarray([0], np.int64)),
+        ], outs)]
+    if t == "_FlashAttention":
+        # decompose the fused kernel to the ONNX math it implements:
+        # softmax(q k^T * d^-0.5 [+ causal mask]) v ; q,k,v are (B,H,S,D)
+        q, k, v = ins
+        shape, _ = op._out_shapes[0]
+        S, D = shape[-2], shape[-1]
+        n = lambda: ctx.fresh("attn")
+        kt, sc, sm = n(), n(), n()
+        nodes = [
+            mk("Transpose", [k], [kt], perm=[0, 1, 3, 2]),
+            mk("MatMul", [q, kt], [sc]),
+            mk("Mul", [sc, _const_input(ctx, "scale",
+                                        np.float32(D ** -0.5))], [sm]),
+        ]
+        cur = sm
+        if op.causal:
+            mask = np.triu(np.full((S, S), -1e9, np.float32), k=1)
+            ms = n()
+            nodes.append(mk("Add", [cur, _const_input(ctx, "causal_mask",
+                                                      mask)], [ms]))
+            cur = ms
+        pr = n()
+        nodes.append(mk("Softmax", [cur], [pr], axis=-1))
+        nodes.append(mk("MatMul", [pr, v], outs))
+        return nodes
     raise NotImplementedError(f"export of op {t} not supported yet")
 
 
