@@ -42,23 +42,34 @@ def load_corpus(path=None, max_bytes=500_000):
 
 
 class CharData:
-    def __init__(self, text, batch, seq):
+    def __init__(self, text, batch, seq, val_frac=0.1):
         chars = sorted(set(text))
         self.stoi = {c: i for i, c in enumerate(chars)}
         self.itos = chars
         self.vocab = len(chars)
         ids = np.array([self.stoi[c] for c in text], np.int32)
         n = (len(ids) - 1) // seq
-        self.x = ids[:n * seq].reshape(n, seq)
-        self.y = ids[1:n * seq + 1].reshape(n, seq)
+        x = ids[:n * seq].reshape(n, seq)
+        y = ids[1:n * seq + 1].reshape(n, seq)
+        # held-out tail: a val-loss curve distinguishes learning from
+        # memorization (the train curve alone can't)
+        n_val = min(n - 1, max(1, int(n * val_frac))) if n > 1 else 0
+        self.x, self.y = x[:n - n_val], y[:n - n_val]
+        self.vx, self.vy = x[n - n_val:], y[n - n_val:]
         self.batch, self.seq = batch, seq
-        self.num_batches = n // batch
+        self.num_batches = len(self.x) // batch
+        self.num_val_batches = len(self.vx) // batch
 
     def batches(self, rng):
         order = rng.permutation(len(self.x))
         for b in range(self.num_batches):
             sel = order[b * self.batch:(b + 1) * self.batch]
             yield self.x[sel], self.y[sel]
+
+    def val_batches(self):
+        for b in range(self.num_val_batches):
+            s = slice(b * self.batch, (b + 1) * self.batch)
+            yield self.vx[s], self.vy[s]
 
     def encode(self, s):
         return np.array([[self.stoi[c] for c in s if c in self.stoi]],
@@ -85,9 +96,12 @@ def main():
     text = load_corpus(args.corpus)
     data = CharData(text, args.batch, args.seq)
     if data.num_batches == 0:
-        sys.exit(f"corpus too small: need > batch*seq+1 = "
-                 f"{args.batch * args.seq + 1} chars, got {len(text)} "
-                 "(shrink --batch/--seq)")
+        # the 10% val holdout comes off the top, so the train split needs
+        # batch full sequences AFTER the holdout
+        need = int(args.batch * args.seq / 0.9) + args.seq + 1
+        sys.exit(f"corpus too small: need ~{need} chars for one "
+                 f"batch*seq train split plus the 10% val holdout, got "
+                 f"{len(text)} (shrink --batch/--seq)")
     print(f"corpus: {len(text)} chars, vocab {data.vocab}, "
           f"{data.num_batches} batches/epoch")
 
@@ -102,6 +116,23 @@ def main():
                        dtype=tensor.int32)
     m.compile([tx], is_train=True, use_graph=True, amp="bfloat16")
 
+    def val_loss():
+        """Token-mean CE on the held-out split (jitted eval logits +
+        host-side log-softmax)."""
+        if data.num_val_batches == 0:
+            return float("nan")
+        m.eval()
+        tot, cnt = 0.0, 0
+        for xb, yb in data.val_batches():
+            tx.copy_from_numpy(xb)
+            lg = tensor.to_numpy(m(tx)).astype(np.float64)
+            lg -= lg.max(-1, keepdims=True)
+            lse = np.log(np.exp(lg).sum(-1))
+            tl = np.take_along_axis(lg, yb[..., None], -1)[..., 0]
+            tot += float((lse - tl).sum())
+            cnt += yb.size
+        return tot / cnt
+
     rng = np.random.RandomState(0)
     for epoch in range(args.epochs):
         t0, losses = time.time(), []
@@ -111,8 +142,8 @@ def main():
             ty.copy_from_numpy(yb)
             _, loss = m(tx, ty)
             losses.append(float(tensor.to_numpy(loss)))
-        print("epoch %d: loss %.3f (%.1fs)"
-              % (epoch, np.mean(losses), time.time() - t0))
+        print("epoch %d: train loss %.3f  val loss %.3f (%.1fs)"
+              % (epoch, np.mean(losses), val_loss(), time.time() - t0))
 
     m.eval()
     prompt = data.encode(args.prompt)

@@ -1136,8 +1136,9 @@ class _VocabParallelSCE(Operator):
     target ids. Max/sum-exp/target-logit each need one scalar-per-row psum —
     the full (N, V) logits are never materialized on any device. Columns at
     global index >= valid_vocab (tying/padding rows) are masked out of the
-    partition function. Hand backward mirrors SoftMaxCrossEntropy: local
-    (softmax - onehot)/N, no collective."""
+    partition function. The math is shared with the 1F1B engine's
+    custom_vjp version (parallel.tp.vp_ce_forward/backward) so the two
+    loss paths cannot drift."""
 
     def __init__(self, axis, valid_vocab=None):
         super().__init__("VocabParallelSCE")
@@ -1146,34 +1147,16 @@ class _VocabParallelSCE(Operator):
         self._cache = None
 
     def forward(self, x, t):
+        from .parallel.tp import vp_ce_forward
         assert x.ndim == 2, "flatten logits to (N, V/tp) first"
         self._in_dtype = x.dtype
-        x = x.astype(jnp.float32)
-        vp = x.shape[-1]
-        off = lax.axis_index(self.axis) * vp
-        if self.valid_vocab is not None:
-            gcol = off + jnp.arange(vp)[None, :]
-            x = jnp.where(gcol < self.valid_vocab, x, -jnp.inf)
-        m = lax.pmax(jnp.max(x, axis=-1), self.axis)        # (N,)
-        z = jnp.exp(x - m[:, None])                          # exp(-inf)=0
-        s = lax.psum(jnp.sum(z, axis=-1), self.axis)         # (N,)
-        local_t = t - off
-        ok = (local_t >= 0) & (local_t < vp)
-        safe = jnp.clip(local_t, 0, vp - 1)
-        tl = jnp.where(ok,
-                       jnp.take_along_axis(x, safe[:, None], -1)[:, 0],
-                       0.0)
-        tl = lax.psum(tl, self.axis)                         # (N,)
-        self._cache = (z, s, safe, ok)
-        return jnp.mean(jnp.log(s) + m - tl)
+        loss, self._cache = vp_ce_forward(x, t, self.axis,
+                                          self.valid_vocab)
+        return loss
 
     def backward(self, dy):
-        z, s, safe, ok = self._cache
-        n = z.shape[0]
-        p = z / s[:, None]                      # local softmax slice
-        onehot = ((jnp.arange(z.shape[-1])[None, :] == safe[:, None])
-                  & ok[:, None])
-        dx = (p - onehot.astype(p.dtype)) * (dy / n)
+        from .parallel.tp import vp_ce_backward
+        dx = vp_ce_backward(self._cache, dy)
         return dx.astype(self._in_dtype), None  # no grad for targets
 
 

@@ -165,7 +165,51 @@ def _decode_core(m: "GPT", S0, max_new):
     return _DecodeCore(H, m.dim, S0, T, (m.dim // H) ** -0.5)
 
 
-class GPT(model.Model):
+class _VocabTPMixin:
+    """Shared Megatron vocab-parallel head logic for GPT and PipelinedGPT:
+    one (V_pad, E) table row-sharded over tp_axis serves as embedding AND
+    (transposed) tied head; the loss consumes sharded logits."""
+
+    def _vp_active(self):
+        return self.vocab_tp and autograd.axis_bound(self.tp_axis)
+
+    def _tied_logits(self, h):
+        """Logits through the embedding-tied head: h @ W_emb^T. Under an
+        active tp mesh the table is vocab-sharded, so each device emits
+        its (B, S, V/tp) slice (Megatron f on the input: psum of dL/dh)."""
+        if self._vp_active():
+            h = autograd.tp_copy(h, self.tp_axis)
+        hc, Wc = autograd.compute_cast(h, self.tok_embed.W)
+        return autograd.matmul(hc, autograd.transpose(Wc),
+                               out_dtype="float32")
+
+    def _slice_valid(self, logits):
+        if self.padded_vocab == self.vocab_size:
+            return logits
+        return autograd.slice(logits, [0], [self.vocab_size],
+                              [len(logits.shape) - 1])
+
+    def _vp_loss_and_logits(self, local, targets):
+        """(loss, caller-facing logits) from SHARDED tied-head logits."""
+        tflat = autograd.reshape(targets, (-1,))
+        if self._vp_active():
+            flat = autograd.reshape(local, (-1, local.shape[-1]))
+            loss = autograd.vocab_parallel_sce(
+                flat, tflat, self.tp_axis, valid_vocab=self.vocab_size)
+            if getattr(self, "vocab_tp_return_logits", True):
+                logits = self._slice_valid(
+                    autograd.gather_last(local, self.tp_axis))
+            else:
+                logits = autograd.vocab_parallel_argmax(
+                    local, self.tp_axis, valid_vocab=self.vocab_size)
+        else:
+            logits = self._slice_valid(local)
+            flat = autograd.reshape(logits, (-1, self.vocab_size))
+            loss = self.sce(flat, tflat)
+        return loss, logits
+
+
+class GPT(_VocabTPMixin, model.Model):
 
     def __init__(self, vocab_size, max_seq=1024, dim=256, num_heads=8,
                  num_layers=4, mlp_ratio=4, seq_axis=None, tp_axis=None,
@@ -243,9 +287,6 @@ class GPT(model.Model):
         S = x.shape[1]  # local shard length under sequence parallelism
         return _PosSlice(S, self.seq_axis)(self.pos_embed)
 
-    def _vp_active(self):
-        return self.vocab_tp and autograd.axis_bound(self.tp_axis)
-
     def _backbone(self, ids):
         # ids: (B, S) int32 -> (B, S, E) post-final-LN hidden states
         h = self.tok_embed(ids)
@@ -254,22 +295,6 @@ class GPT(model.Model):
         for b in self.blocks:
             h = b(h)
         return self.ln_f(h)
-
-    def _tied_logits(self, h):
-        """Logits through the embedding-tied head: h @ W_emb^T. Under an
-        active tp mesh the table is vocab-sharded, so each device emits its
-        (B, S, V/tp) slice (Megatron f on the input: psum of dL/dh)."""
-        if self._vp_active():
-            h = autograd.tp_copy(h, self.tp_axis)
-        hc, Wc = autograd.compute_cast(h, self.tok_embed.W)
-        return autograd.matmul(hc, autograd.transpose(Wc),
-                               out_dtype="float32")
-
-    def _slice_valid(self, logits):
-        if self.padded_vocab == self.vocab_size:
-            return logits
-        return autograd.slice(logits, [0], [self.vocab_size],
-                              [len(logits.shape) - 1])
 
     def forward(self, ids):
         h = self._backbone(ids)
@@ -309,23 +334,7 @@ class GPT(model.Model):
         # logits exist only on the caller-facing output edge.
         h = self._backbone(ids)
         local = self._tied_logits(h)
-        tflat = autograd.reshape(targets, (-1,))
-        if self._vp_active():
-            flat = autograd.reshape(
-                local, (-1, local.shape[-1]))
-            loss = autograd.vocab_parallel_sce(
-                flat, tflat, self.tp_axis, valid_vocab=self.vocab_size)
-            if self.vocab_tp_return_logits:
-                logits = self._slice_valid(
-                    autograd.gather_last(local, self.tp_axis))
-            else:
-                # predictions only: no (B,S,V) materialization anywhere
-                logits = autograd.vocab_parallel_argmax(
-                    local, self.tp_axis, valid_vocab=self.vocab_size)
-        else:
-            logits = self._slice_valid(local)
-            flat = autograd.reshape(logits, (-1, self.vocab_size))
-            loss = self.sce(flat, tflat)
+        loss, logits = self._vp_loss_and_logits(local, targets)
         loss = self._moe_losses(loss, ids.device)
         self.optimizer(loss)
         return logits, loss
@@ -623,30 +632,49 @@ def _fn_layernorm(x, g, b, eps=1e-5):
     return (x - m) * lax.rsqrt(v + eps) * g + b
 
 
-def _fn_block(params, h, num_heads):
-    """Functional pre-LN transformer block; h (B, S, E)."""
+def _fn_block(params, h, num_heads, tp_axis=None):
+    """Functional pre-LN transformer block; h (B, S, E) replicated over
+    `tp_axis`. With tp: Wq/Wk/Wv/W1 arrive column-sharded (local heads =
+    num_heads/tp), Wo/W2 row-sharded — the Megatron layout, two psums per
+    block, expressed with custom_vjp f/g so the block stays correct under
+    both autodiff-through-scan (GPipe) and explicit vjp (1F1B engine)."""
     import jax
     import jax.numpy as jnp
     from ..ops.attention import flash_attention
+    from ..parallel.tp import megatron_f, megatron_g
     (g1, b1, Wq, Wk, Wv, Wo, g2, b2, W1, bb1, W2, bb2) = params
     B, S, E = h.shape
+    heads = num_heads
+    if tp_axis is not None:
+        heads = num_heads // jax.lax.axis_size(tp_axis)
     x = _fn_layernorm(h, g1, b1)
-    q = (x @ Wq).reshape(B, S, num_heads, -1).transpose(0, 2, 1, 3)
-    k = (x @ Wk).reshape(B, S, num_heads, -1).transpose(0, 2, 1, 3)
-    v = (x @ Wv).reshape(B, S, num_heads, -1).transpose(0, 2, 1, 3)
+    if tp_axis is not None:
+        x = megatron_f(x, tp_axis)
+    q = (x @ Wq).reshape(B, S, heads, -1).transpose(0, 2, 1, 3)
+    k = (x @ Wk).reshape(B, S, heads, -1).transpose(0, 2, 1, 3)
+    v = (x @ Wv).reshape(B, S, heads, -1).transpose(0, 2, 1, 3)
     o = flash_attention(q, k, v, True)
-    o = o.transpose(0, 2, 1, 3).reshape(B, S, E)
-    h = h + o @ Wo
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
+    o = o @ Wo
+    if tp_axis is not None:
+        o = megatron_g(o, tp_axis)
+    h = h + o
     x = _fn_layernorm(h, g2, b2)
-    return h + jax.nn.gelu(x @ W1 + bb1) @ W2 + bb2
+    if tp_axis is not None:
+        x = megatron_f(x, tp_axis)
+    y = jax.nn.gelu(x @ W1 + bb1) @ W2
+    if tp_axis is not None:
+        y = megatron_g(y, tp_axis)
+    return h + y + bb2
 
 
-def _make_stage_fn(num_heads, axis, total_layers):
+def _make_stage_fn(num_heads, axis, total_layers, tp_axis=None):
     """Per-stage block application with non-uniform stage support: local
     stacks carry padded_layers/n rows; rows whose GLOBAL index (stage*per +
     li) >= total_layers are padding (zero-init, never trained) and are
     where()-masked to the identity, so `num_layers % stages != 0` works —
-    pad rows simply make late stages shorter."""
+    pad rows simply make late stages shorter. `tp_axis` additionally
+    tensor-shards every block (PP x TP)."""
     from jax import lax
     import jax.numpy as jnp
 
@@ -655,7 +683,8 @@ def _make_stage_fn(num_heads, axis, total_layers):
         s = lax.axis_index(axis)
         for li in range(per):
             on = (s * per + li) < total_layers
-            y = _fn_block([st[li] for st in local_stacks], x, num_heads)
+            y = _fn_block([st[li] for st in local_stacks], x, num_heads,
+                          tp_axis)
             x = jnp.where(on, y, x)
         return x
 
@@ -666,12 +695,14 @@ class _PipelineBlocks(autograd.Operator):
     """All transformer blocks as one tape op: GPipe scan inside shard_map
     (parallel/pipeline.py gpipe), serial layer loop outside a mesh."""
 
-    def __init__(self, num_heads, axis=None, n_micro=1, total_layers=None):
+    def __init__(self, num_heads, axis=None, n_micro=1, total_layers=None,
+                 tp_axis=None):
         super().__init__("PipelineBlocks")
         self.num_heads = num_heads
         self.axis = axis
         self.n_micro = n_micro
         self.total_layers = total_layers
+        self.tp_axis = tp_axis
 
     def forward(self, h, *stacks):
         import jax.numpy as jnp
@@ -682,8 +713,11 @@ class _PipelineBlocks(autograd.Operator):
             B = h.shape[0]
             nm = self.n_micro
             assert B % nm == 0, f"batch {B} not divisible by n_micro {nm}"
+            tp = self.tp_axis if (self.tp_axis is not None
+                                  and autograd.axis_bound(self.tp_axis)) \
+                else None
             x_micro = h.reshape(nm, B // nm, *h.shape[1:])
-            stage_fn = _make_stage_fn(nh, self.axis, L)
+            stage_fn = _make_stage_fn(nh, self.axis, L, tp)
             outs = gpipe(stage_fn, list(stacks), x_micro, self.axis)
             outs = bcast_from_last(self.axis, outs)
             return outs.reshape(B, *h.shape[1:])
@@ -705,27 +739,36 @@ class _Pipeline1F1B(autograd.Operator):
     loss directly; parallel/pipeline.one_f_one_b runs the fused scan and
     hands back every cotangent, which backward() replays to the tape."""
 
-    def __init__(self, num_heads, axis, n_micro, total_layers):
+    def __init__(self, num_heads, axis, n_micro, total_layers,
+                 tp_axis=None, tied_vocab=None):
         super().__init__("Pipeline1F1B")
         self.num_heads = num_heads
         self.axis = axis
         self.n_micro = n_micro
         self.total_layers = total_layers
+        self.tp_axis = tp_axis
+        self.tied_vocab = tied_vocab  # true vocab size when headW is the
+        #                               vocab-sharded embedding table
         self._cache = None
 
     def forward(self, h, tgt, gf, bf, headW, *stacks):
         import jax
         import jax.numpy as jnp
         from ..parallel.pipeline import one_f_one_b, last_stage_value
+        from ..parallel.tp import megatron_f, vocab_parallel_ce
         assert autograd.axis_bound(self.axis), \
             "1f1b schedule needs an active pipeline mesh axis"
         B, S, E = h.shape
         nm = self.n_micro
         assert B % nm == 0, f"batch {B} not divisible by n_micro {nm}"
+        tp = self.tp_axis if (self.tp_axis is not None
+                              and autograd.axis_bound(self.tp_axis)) \
+            else None
         x_micro = h.reshape(nm, B // nm, S, E)
         tgt_micro = tgt.reshape(nm, B // nm, S)
         stage_fn = _make_stage_fn(self.num_heads, self.axis,
-                                  self.total_layers)
+                                  self.total_layers, tp)
+        tied = self.tied_vocab is not None and tp is not None
 
         def last_fn(lp, y, t):
             # fp32 loss island: final LN + tied/untied head + token-mean CE
@@ -733,6 +776,14 @@ class _Pipeline1F1B(autograd.Operator):
             g, b, W = lp
             z = _fn_layernorm(y.astype(jnp.float32), g.astype(jnp.float32),
                               b.astype(jnp.float32))
+            if tied:
+                # W is this device's (V_pad/tp, E) table slice: sharded
+                # logits + Megatron vocab-parallel CE (custom-vjp
+                # collectives — this fn is differentiated by the engine)
+                z = megatron_f(z, tp)
+                logits = z @ W.astype(jnp.float32).T
+                return vocab_parallel_ce(logits, t, tp,
+                                         valid_vocab=self.tied_vocab)
             logits = z @ W.astype(jnp.float32)
             lse = jax.nn.logsumexp(logits, axis=-1)
             tl = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
@@ -756,18 +807,26 @@ class _Pipeline1F1B(autograd.Operator):
                 *[g * s for g in d_stage])
 
 
-class PipelinedGPT(model.Model):
-    """GPT with GPipe pipeline parallelism through the Model API: compile
-    with `pipeline_axis="pp", n_micro=M` on a mesh carrying a 'pp' axis
-    (plus a 'data' axis, possibly size 1) and train normally. Embedding and
-    head run replicated on every stage (cheap); the block stack — where the
-    FLOPs are — is sharded layer-wise over the pipeline."""
+class PipelinedGPT(_VocabTPMixin, model.Model):
+    """GPT with pipeline parallelism through the Model API: compile with
+    `pipeline_axis="pp", n_micro=M` on a mesh carrying a 'pp' axis (plus a
+    'data' axis, possibly size 1) and train normally. The block stack —
+    where the FLOPs are — is sharded layer-wise over the pipeline.
+
+    `tp_axis` composes PP x TP (the Megatron 3D layout minus sequence
+    dims): every block's QKV/MLP weights additionally shard over the tp
+    axis (two psums per block via custom-vjp f/g, correct under both
+    schedules), and `vocab_tp=True` row-shards ONE padded (V_pad, E)
+    table over tp serving as embedding and tied head, with the loss on
+    sharded logits — without it the embedding/head replicate per device."""
 
     _STACK_ATTRS = ("g1", "b1", "Wq", "Wk", "Wv", "Wo",
                     "g2", "b2", "W1", "bb1", "W2", "bb2")
 
     def __init__(self, vocab_size, max_seq=1024, dim=256, num_heads=8,
-                 num_layers=4, mlp_ratio=4, name=None):
+                 num_layers=4, mlp_ratio=4, tp_axis=None, vocab_tp=False,
+                 vocab_pad_multiple=128, vocab_tp_return_logits=True,
+                 name=None):
         super().__init__(name)
         self.vocab_size = vocab_size
         self.max_seq = max_seq
@@ -775,25 +834,42 @@ class PipelinedGPT(model.Model):
         self.num_heads = num_heads
         self.num_layers = num_layers
         self.mlp_ratio = mlp_ratio
-        self.tok_embed = layer.Embedding(vocab_size, dim)
+        self.tp_axis = tp_axis
+        if vocab_tp and tp_axis is None:
+            raise ValueError(
+                "vocab_tp=True needs tp_axis (see GPT.__init__)")
+        self.vocab_tp = bool(vocab_tp)
+        self.vocab_tp_return_logits = vocab_tp_return_logits
+        if self.vocab_tp:
+            m = vocab_pad_multiple
+            self.padded_vocab = ((vocab_size + m - 1) // m) * m
+            self.tok_embed = layer.Embedding(self.padded_vocab, dim,
+                                             tp_axis=tp_axis)
+            self.head = None        # tied to tok_embed.W
+        else:
+            self.padded_vocab = vocab_size
+            self.tok_embed = layer.Embedding(vocab_size, dim)
+            # fp32-accumulated logits: under amp the CE loss would
+            # otherwise upcast the full (B,S,V) tensor
+            self.head = layer.Linear(vocab_size, bias=False,
+                                     out_dtype="float32")
         self.ln_f = layer.LayerNorm()
-        # fp32-accumulated logits: under amp the CE loss would otherwise
-        # upcast the full (B,S,V) tensor
-        self.head = layer.Linear(vocab_size, bias=False,
-                                 out_dtype="float32")
         self.sce = layer.SoftMaxCrossEntropy()
         self._stacks_init = False
 
-    def _n_stages(self):
-        """Pipeline degree, readable at param-init time (compile runs
-        after set_optimizer, so the mesh is already attached)."""
-        if self.pipeline_axis is None:
+    def _mesh_axis_size(self, axis):
+        """Mesh degree of `axis`, readable at param-init time (compile
+        runs after set_optimizer, so the mesh is already attached)."""
+        if axis is None:
             return 1
         try:
             mesh = self._optimizer.communicator.mesh
-            return int(mesh.shape[self.pipeline_axis])
+            return int(mesh.shape[axis])
         except Exception:
             return 1
+
+    def _n_stages(self):
+        return self._mesh_axis_size(self.pipeline_axis)
 
     def _init_stacks(self, dev):
         import numpy as np
@@ -806,7 +882,25 @@ class PipelinedGPT(model.Model):
         per = -(-L // n_pp)
         Lp = n_pp * per
         self.padded_layers = Lp
+        tp_n = self._mesh_axis_size(self.tp_axis)
+        if tp_n > 1:
+            assert self.pipeline_axis is not None, (
+                "PipelinedGPT tp_axis requires pipeline_axis (the stacked "
+                "blocks only run tensor-parallel inside the pipeline mesh)")
+            assert E % tp_n == 0 and H % tp_n == 0 \
+                and self.num_heads % tp_n == 0, \
+                f"dim {E}/hidden {H}/heads {self.num_heads} must divide " \
+                f"tp={tp_n}"
         rng = np.random.RandomState(0)
+        from jax.sharding import PartitionSpec as P
+        pp, tp = self.pipeline_axis, self.tp_axis
+        # Megatron layout over the stacked (Lp, ...) params: QKV/W1
+        # column-shard their OUTPUT dim over tp, Wo/W2 row-shard their
+        # INPUT dim; everything else replicates across tp
+        tp_specs = {"Wq": P(pp, None, tp), "Wk": P(pp, None, tp),
+                    "Wv": P(pp, None, tp), "W1": P(pp, None, tp),
+                    "Wo": P(pp, tp, None), "W2": P(pp, tp, None),
+                    "bb1": P(pp, tp)}
 
         def mk(attr, shape, scale=None):
             t = Tensor((Lp,) + shape, device=dev, dtype=float32)
@@ -819,9 +913,8 @@ class PipelinedGPT(model.Model):
                 vals[:L] = (rng.standard_normal((L,) + shape)
                             * scale).astype(np.float32)
                 t.copy_from_numpy(vals)
-            if self.pipeline_axis is not None:
-                from jax.sharding import PartitionSpec as P
-                t.spec = P(self.pipeline_axis)
+            if pp is not None:
+                t.spec = tp_specs.get(attr, P(pp)) if tp_n > 1 else P(pp)
             self._register_param(attr, t)
 
         mk("g1", (E,)), mk("b1", (E,))
@@ -859,10 +952,9 @@ class PipelinedGPT(model.Model):
     def forward(self, ids):
         h = self._embed(ids)
         op = _PipelineBlocks(self.num_heads, self.pipeline_axis,
-                             self.n_micro, self.num_layers)
+                             self.n_micro, self.num_layers, self.tp_axis)
         h = op(h, *[getattr(self, a) for a in self._STACK_ATTRS])
-        h = self.ln_f(h)
-        return self.head(h)
+        return self._caller_logits(h)
 
     def set_params(self, params: dict):
         """Accepts stacks from a model built with a different pipeline
@@ -884,20 +976,43 @@ class PipelinedGPT(model.Model):
             fixed[n] = arr
         super().set_params(fixed)
 
+    def _caller_logits(self, h_out):
+        """Caller-facing logits from post-block activations, OUTSIDE the
+        loss graph."""
+        h_out = self.ln_f(h_out)
+        if not self.vocab_tp:
+            return self.head(h_out)
+        local = self._tied_logits(h_out)
+        if self._vp_active():
+            local = autograd.gather_last(local, self.tp_axis)
+        return self._slice_valid(local)
+
     def train_one_batch(self, ids, targets):
         sched = getattr(self, "pipeline_schedule", "gpipe")
         if sched == "1f1b" and self.pipeline_axis is not None and \
                 autograd.axis_bound(self.pipeline_axis):
             h = self._embed(ids)
-            op = _Pipeline1F1B(self.num_heads, self.pipeline_axis,
-                               self.n_micro, self.num_layers)
+            headW = self.tok_embed.W if self.vocab_tp else self.head.W
+            op = _Pipeline1F1B(
+                self.num_heads, self.pipeline_axis, self.n_micro,
+                self.num_layers, self.tp_axis,
+                tied_vocab=self.vocab_size if self.vocab_tp else None)
             loss, outs = op(h, targets, self.ln_f.gamma, self.ln_f.beta,
-                            self.head.W,
+                            headW,
                             *[getattr(self, a) for a in self._STACK_ATTRS])
-            # caller-facing logits: derived from the schedule's last-stage
-            # activations OUTSIDE the loss graph (the 1F1B backward
-            # already produced every gradient in-schedule)
-            logits = self.head(self.ln_f(outs))
+            # the 1F1B backward already produced every gradient
+            # in-schedule; the logits edge carries no cotangent
+            logits = self._caller_logits(outs)
+            self.optimizer(loss)
+            return logits, loss
+        if self.vocab_tp:
+            h = self._embed(ids)
+            op = _PipelineBlocks(self.num_heads, self.pipeline_axis,
+                                 self.n_micro, self.num_layers,
+                                 self.tp_axis)
+            h = op(h, *[getattr(self, a) for a in self._STACK_ATTRS])
+            local = self._tied_logits(self.ln_f(h))
+            loss, logits = self._vp_loss_and_logits(local, targets)
             self.optimizer(loss)
             return logits, loss
         logits = self.forward(ids)
