@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import time
 import zipfile
 
@@ -344,18 +345,41 @@ class Model(Layer, metaclass=ModelMeta):
             mesh = opt.communicator.mesh
             assert mesh is not None, \
                 "DistOpt needs a mesh for multi-device training"
+
+            def sanitize(spec):
+                """Drop spec axes the mesh doesn't carry: a model built
+                with tp_axis="tp" but trained on a {data, pp} mesh keeps
+                those params REPLICATED (the layer forwards gate their
+                collectives on axis_bound, so the math degrades to the
+                serial path consistently)."""
+                if spec is None:
+                    return None
+                axes = set(mesh.shape.keys())
+                out = []
+                for el in spec:
+                    if el is None:
+                        out.append(None)
+                    elif isinstance(el, tuple):
+                        kept = tuple(a for a in el if a in axes)
+                        out.append(kept if kept else None)
+                    else:
+                        out.append(el if el in axes else None)
+                if not any(e is not None for e in out):
+                    return None
+                return P(*out)
+
             # TP-sharded params (Tensor.spec set by tp_axis layers) enter
             # the shard_map partitioned; everything else is replicated. A
             # plain P() prefix is kept in the no-TP case so strategies with
             # dynamically growing optimizer state (sparse residuals) still
             # pytree-match.
-            state_specs = [getattr(t, "spec", None) or P()
+            state_specs = [sanitize(getattr(t, "spec", None)) or P()
                            for t in state_tensors]
-            has_tp = any(getattr(t, "spec", None) is not None
+            has_tp = any(sanitize(getattr(t, "spec", None)) is not None
                          for t in state_tensors)
             if has_tp:
                 state_in = state_specs
-                opt_in = opt.state_specs()
+                opt_in = [sanitize(s) or P() for s in opt.state_specs()]
                 self._dist_shardings = (
                     NamedSharding(mesh, P()),
                     NamedSharding(mesh, P(opt.axis)),
@@ -653,6 +677,60 @@ class Model(Layer, metaclass=ModelMeta):
         with zipfile.ZipFile(fpath, "w") as zf:
             zf.writestr("tensor_dict.npz", npz_buf.getvalue())
             zf.writestr("states_attr.json", json.dumps(attrs))
+
+    # ---- full training checkpoints (orbax) -------------------------------
+    # save_states/load_states keep the reference's zip(npz+json) layout
+    # for MODEL states; these save the full TRAINING state — params,
+    # layer states, optimizer state, the device RNG — through orbax,
+    # which writes sharded jax.Arrays per-shard (no host gather): the
+    # pod-scale checkpoint path the zip format cannot be.
+    def save_checkpoint(self, ckpt_dir: str, step: int = 0,
+                        overwrite: bool = False):
+        """Write a resumable training checkpoint under `ckpt_dir/step_N`.
+        Captures model states, optimizer state (slot buffers + step
+        counter) and the device PRNG stream, so training resumed from it
+        is bit-identical to uninterrupted training (tests/test_model.py::
+        test_checkpoint_resume_equivalence). An existing step_N directory
+        raises unless `overwrite=True` (a save-latest loop should either
+        thread a real step counter or pass overwrite)."""
+        import jax
+        import orbax.checkpoint as ocp
+        from .device import get_default_device
+        dev = self._device or get_default_device()
+        rng = dev.rng_state
+        if jnp.issubdtype(getattr(rng, "dtype", None), jax.dtypes.prng_key):
+            rng = jax.random.key_data(rng)
+        tree = {
+            "model": {k: t.data for k, t in self.get_states().items()},
+            "opt": (dict(self._optimizer.get_states())
+                    if self._optimizer is not None else {}),
+            "rng": np.asarray(rng),
+        }
+        ck = ocp.StandardCheckpointer()
+        path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+        ck.save(path, tree, force=overwrite)
+        ck.wait_until_finished()
+        return path
+
+    def load_checkpoint(self, path: str):
+        """Restore a `save_checkpoint` directory (a .../step_N path) into
+        this model + its optimizer + the device RNG. The model must be
+        built/compiled to the same topology first (params exist)."""
+        import jax
+        import orbax.checkpoint as ocp
+        ck = ocp.StandardCheckpointer()
+        tree = ck.restore(os.path.abspath(path))
+        self.set_states({k: np.asarray(v)
+                         for k, v in tree["model"].items()})
+        if self._optimizer is not None and tree.get("opt"):
+            self._optimizer.set_states(
+                {k: np.asarray(v) for k, v in tree["opt"].items()})
+        from .device import get_default_device
+        dev = self._device or get_default_device()
+        dev.rng_state = jax.random.wrap_key_data(
+            jnp.asarray(tree["rng"], jnp.uint32))
+        self._compiled_step = None  # drop stale executable state binding
+        return self
 
     def load_states(self, fpath: str) -> dict:
         with zipfile.ZipFile(fpath, "r") as zf:

@@ -768,7 +768,7 @@ class _Pipeline1F1B(autograd.Operator):
         tgt_micro = tgt.reshape(nm, B // nm, S)
         stage_fn = _make_stage_fn(self.num_heads, self.axis,
                                   self.total_layers, tp)
-        tied = self.tied_vocab is not None and tp is not None
+        tied = self.tied_vocab is not None
 
         def last_fn(lp, y, t):
             # fp32 loss island: final LN + tied/untied head + token-mean CE
@@ -776,7 +776,7 @@ class _Pipeline1F1B(autograd.Operator):
             g, b, W = lp
             z = _fn_layernorm(y.astype(jnp.float32), g.astype(jnp.float32),
                               b.astype(jnp.float32))
-            if tied:
+            if tied and tp is not None:
                 # W is this device's (V_pad/tp, E) table slice: sharded
                 # logits + Megatron vocab-parallel CE (custom-vjp
                 # collectives — this fn is differentiated by the engine)
@@ -784,7 +784,17 @@ class _Pipeline1F1B(autograd.Operator):
                 logits = z @ W.astype(jnp.float32).T
                 return vocab_parallel_ce(logits, t, tp,
                                          valid_vocab=self.tied_vocab)
-            logits = z @ W.astype(jnp.float32)
+            if tied:
+                # tp axis not bound (e.g. a {data, pp} mesh): tied head
+                # against the FULL table, padded columns masked out
+                logits = z @ W.astype(jnp.float32).T
+                V_pad = logits.shape[-1]
+                if V_pad != self.tied_vocab:
+                    logits = jnp.where(
+                        jnp.arange(V_pad) < self.tied_vocab,
+                        logits, -jnp.inf)
+            else:
+                logits = z @ W.astype(jnp.float32)
             lse = jax.nn.logsumexp(logits, axis=-1)
             tl = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
             return jnp.mean(lse - tl)

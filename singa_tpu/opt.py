@@ -282,15 +282,22 @@ class DistOpt(Optimizer):
     """
 
     def __init__(self, opt: Optimizer, axis: str = "data", mesh=None,
-                 topk_frac: float = 0.01):
+                 topk_frac: float = 0.01, sparse_residuals: bool = False):
         # NOTE: intentionally not calling super().__init__ — we delegate to
         # the wrapped optimizer's state machinery.
+        # sparse_residuals: pre-create error-feedback residual buffers for
+        # REPLICATED params at setup() time. Only needed to use
+        # backward_and_sparse_update(corr=True) on a model with
+        # TP/PP-sharded params (per-leaf state specs cannot grow
+        # mid-trace); costs one zero buffer per replicated param, so it
+        # is opt-in rather than always-on.
         from .parallel.communicator import Communicator
         self.opt = opt
         self.axis = axis
         self.communicator = Communicator(axis=axis, mesh=mesh)
         self.world_size = self.communicator.world_size
         self.topk_frac = topk_frac
+        self.sparse_residuals = sparse_residuals
         self._spars_residual = {}   # id(param) -> error-feedback residual
         self._spars_order = []
         self._partial_counter = 0
@@ -309,6 +316,23 @@ class DistOpt(Optimizer):
 
     def setup(self, params):
         self.opt.setup(params)
+        # When any param is mesh-sharded, the step compiles with PER-LEAF
+        # opt-state specs, so the sparse strategy's error-feedback
+        # residuals can no longer appear lazily mid-trace (the pytree
+        # would stop matching). With sparse_residuals=True, pre-create
+        # them for the REPLICATED params (in TP/PP models those are the
+        # small ones — norms, biases — the big sharded params take the
+        # dense reduction, see backward_and_sparse_update).
+        if not self.sparse_residuals:
+            return
+        by_id = getattr(self.opt, "_params_by_id", {})
+        if any(getattr(p, "spec", None) is not None for p in by_id.values()):
+            for pid, p in by_id.items():
+                if getattr(p, "spec", None) is None \
+                        and pid not in self._spars_residual:
+                    self._spars_residual[pid] = jnp.zeros(p.shape,
+                                                          dtype=p.dtype)
+                    self._spars_order.append(pid)
 
     def state_arrays(self):
         arrs = list(self.opt.state_arrays())
@@ -484,18 +508,32 @@ class DistOpt(Optimizer):
     def backward_and_sparse_update(self, loss: Tensor, spars: float = 0.05,
                                    topK: bool = True, corr: bool = True):
         by_id = getattr(self.opt, "_params_by_id", {})
-        if any(getattr(p, "spec", None) is not None for p in by_id.values()):
-            # residuals grow state_arrays() lazily inside the trace, which
-            # cannot pytree-match the per-leaf in/out specs a TP/PP mesh
-            # needs — fail loud instead of a cryptic shard_map error
-            raise NotImplementedError(
-                "sparse gradient strategies are not supported together "
-                "with TP/PP-sharded parameters yet; use plain/half/"
-                "partial strategies on tensor/pipeline-parallel models")
+        has_sharded = any(getattr(p, "spec", None) is not None
+                          for p in by_id.values())
         for p, g in autograd.backward(loss):
             pid = id(p)
-            if pid not in self._spars_residual:
-                self._spars_residual[pid] = jnp.zeros(p.shape, dtype=p.dtype)
+            if getattr(p, "spec", None) is not None:
+                # sharded param: its gradient is already a mesh shard —
+                # sparsifying per-shard indices across the data axis is
+                # well-defined, but the payoff is small (in TP/PP models
+                # the sharded tensors dominate FLOPs, not DP wire bytes)
+                # and the residual would have to shard too; take the
+                # dense reduction and keep sparsification for the
+                # replicated params.
+                g.data = self.communicator.all_reduce(g.data) \
+                    / self.world_size
+                self.opt.apply(p, g)
+                continue
+            if corr and pid not in self._spars_residual:
+                if has_sharded:
+                    # per-leaf state specs cannot grow mid-trace: the
+                    # residuals must exist before the step compiles
+                    raise RuntimeError(
+                        "error-feedback residuals on a model with "
+                        "sharded params must be pre-created: construct "
+                        "DistOpt(..., sparse_residuals=True)")
+                self._spars_residual[pid] = jnp.zeros(p.shape,
+                                                      dtype=p.dtype)
                 self._spars_order.append(pid)
             acc = self._spars_residual[pid] if corr else 0.0
             x = g.data + acc

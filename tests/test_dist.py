@@ -218,6 +218,50 @@ def test_partial_update_compiles_per_partition(dev, mesh, data):
     assert texts[0] != texts[1]
 
 
+def test_sparse_with_sharded_params(dev, rng):
+    """Strategy 4 on a TP model (VERDICT r2 weak #7): replicated params
+    keep the packed sparse allreduce (residuals pre-created at setup so
+    the per-leaf spec'd state thread stays pytree-stable), sharded params
+    take the dense reduction — instead of the old hard raise."""
+    from singa_tpu import layer, model, opt, tensor
+
+    class TPMLPSparse(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.l1 = layer.Linear(16, tp_axis="tp", tp_mode="column")
+            self.relu = layer.ReLU()
+            self.l2 = layer.Linear(4, tp_axis="tp", tp_mode="row")
+            self.loss_fn = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            return self.l2(self.relu(self.l1(x)))
+
+        def train_one_batch(self, x, y):
+            loss = self.loss_fn(self.forward(x), y)
+            self._optimizer.backward_and_sparse_update(loss, spars=0.25,
+                                                       topK=True)
+            return loss
+
+    mesh = make_mesh({"data": 2, "tp": 4})
+    X = rng.randn(16, 10).astype(np.float32)
+    Y = np.argmax(X @ rng.randn(10, 4).astype(np.float32), 1) \
+        .astype(np.int32)
+    m = TPMLPSparse()
+    m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.2, momentum=0.9),
+                                axis="data", mesh=mesh,
+                                sparse_residuals=True))
+    tx, ty = tensor.from_numpy(X, dev), tensor.from_numpy(Y, dev)
+    m.compile([tx], is_train=True, use_graph=True)
+    losses = [float(m(tx, ty).numpy()) for _ in range(25)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, losses[::6]
+    # residuals exist only for the REPLICATED params (the two biases)
+    do = m._optimizer
+    by_id = do.opt._params_by_id
+    for pid in do._spars_order:
+        assert getattr(by_id[pid], "spec", None) is None
+
+
 def test_broadcast_tree(dev, rng, mesh):
     """Tree broadcast (VERDICT r2 #10): every device ends with ROOT's
     value for any root, and the executable uses collective-permute rounds

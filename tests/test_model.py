@@ -237,6 +237,102 @@ def test_eval_shape_bucketing(dev):
         np.testing.assert_allclose(got, full[:n], rtol=1e-5, atol=1e-6)
 
 
+def test_checkpoint_resume_equivalence(tmp_path, dev):
+    """Full-training-state checkpoint (orbax): params + optimizer slots +
+    RNG. Training resumed from step 3 in a FRESH model must produce the
+    same losses as the uninterrupted run — momentum and the PRNG stream
+    survive, not just weights (the zip save_states covers model states
+    only, reference parity)."""
+    import numpy as np
+    from singa_tpu import layer, opt, tensor
+
+    class N(model.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = layer.Linear(8)
+            self.relu = layer.ReLU()
+            self.fc2 = layer.Linear(3)
+            self.sce = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            return self.fc2(self.relu(self.fc1(x)))
+
+        def train_one_batch(self, x, y):
+            loss = self.sce(self.forward(x), y)
+            self.optimizer(loss)
+            return loss
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 5).astype(np.float32)
+    Y = rng.randint(0, 3, 16).astype(np.int32)
+
+    def build():
+        import jax as _jax
+        dev.rng_state = _jax.random.key(7)
+        m = N()
+        m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+        tx = tensor.from_numpy(X, dev)
+        ty = tensor.from_numpy(Y, dev)
+        m.compile([tx], is_train=True, use_graph=True)
+        return m, tx, ty
+
+    # uninterrupted: 6 steps
+    m_a, tx, ty = build()
+    ref = [float(m_a(tx, ty).numpy()) for _ in range(6)]
+
+    # interrupted: 3 steps, checkpoint, resume in a FRESH model
+    m_b, tx, ty = build()
+    got = [float(m_b(tx, ty).numpy()) for _ in range(3)]
+    path = m_b.save_checkpoint(str(tmp_path / "ck"), step=3)
+
+    m_c, tx, ty = build()
+    _ = [m_c(tx, ty) for _ in range(1)]  # diverge first: proves restore
+    m_c.load_checkpoint(path)
+    got += [float(m_c(tx, ty).numpy()) for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+
+
+def test_checkpoint_sharded_params(tmp_path, dev):
+    """save_checkpoint on a model whose params carry mesh shardings
+    (vocab-parallel GPT on a {data, tp} mesh): orbax writes the GLOBAL
+    arrays from their shards — no host gather — and restore into a fresh
+    mesh-compiled model resumes training at the checkpointed loss."""
+    import numpy as np
+    from singa_tpu import models, opt, tensor
+    from singa_tpu.parallel import make_mesh
+
+    rng = np.random.RandomState(3)
+    V, B, S = 48, 4, 8
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    def build():
+        import jax as _jax
+        dev.rng_state = _jax.random.key(11)
+        m = models.create_model(
+            "gpt", vocab_size=V, max_seq=S, dim=16, num_heads=4,
+            num_layers=1, tp_axis="tp", vocab_tp=True,
+            vocab_pad_multiple=8)
+        mesh = make_mesh({"data": 2, "tp": 4})
+        m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.05), axis="data",
+                                    mesh=mesh))
+        tx = tensor.from_numpy(ids, dev)
+        ty = tensor.from_numpy(tgt, dev)
+        m.compile([tx], is_train=True, use_graph=True)
+        return m, tx, ty
+
+    m_a, tx, ty = build()
+    ref = [float(m_a(tx, ty)[1].numpy()) for _ in range(4)]
+    # checkpoint mid-training from the SHARDED state
+    m_b, tx, ty = build()
+    _ = [m_b(tx, ty) for _ in range(2)]
+    path = m_b.save_checkpoint(str(tmp_path / "ck3d"), step=2)
+    m_c, tx, ty = build()
+    m_c.load_checkpoint(path)
+    got = [float(m_c(tx, ty)[1].numpy()) for _ in range(2)]
+    np.testing.assert_allclose(got, ref[2:], rtol=1e-5, atol=1e-6)
+
+
 def test_eval_bucketing_auto_default(dev):
     """Default "auto" bucketing (VERDICT r2 #10): per-sample outputs are
     detected on the first eval, and the last partial batch then runs

@@ -452,6 +452,50 @@ def test_pp_tp_3d_gpt():
         models.create_model("gpt_pipe", vocab_size=V, vocab_tp=True)
 
 
+def test_pp_vocab_tp_without_tp_axis_in_mesh():
+    """PipelinedGPT(vocab_tp=True, tp_axis=...) trained on a mesh WITHOUT
+    the tp axis (pp-only): the tied head falls back to the full padded
+    table with masked padding columns — 1F1B's in-schedule loss included —
+    and matches the serial model."""
+    from singa_tpu import models, opt, tensor
+    from singa_tpu.device import get_default_device
+
+    dev = get_default_device()
+    rng = np.random.RandomState(23)
+    V, B, S, L = 50, 8, 8, 2
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    tx = tensor.from_numpy(ids, dev)
+    ty = tensor.from_numpy(tgt, dev)
+
+    def build(pp=False):
+        m = models.create_model(
+            "gpt_pipe", vocab_size=V, max_seq=S, dim=16, num_heads=2,
+            num_layers=L, tp_axis="tp", vocab_tp=True,
+            vocab_pad_multiple=8)
+        if pp:
+            mesh = make_mesh({"data": 2, "pp": 4})  # NO tp axis
+            m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.05), axis="data",
+                                        mesh=mesh))
+            m.compile([tx], is_train=True, use_graph=True,
+                      pipeline_axis="pp", n_micro=2,
+                      pipeline_schedule="1f1b")
+        else:
+            m.set_optimizer(opt.SGD(lr=0.05))
+            m.compile([tx], is_train=True, use_graph=True)
+        return m
+
+    m_ser = build()
+    w0 = {k: v.numpy().copy() for k, v in m_ser.get_params().items()}
+    m_pp = build(pp=True)
+    m_pp.set_params(w0)
+    for _ in range(3):
+        _, l_ser = m_ser(tx, ty)
+        _, l_pp = m_pp(tx, ty)
+    assert abs(float(l_ser.numpy()) - float(l_pp.numpy())) < 3e-3, \
+        (float(l_ser.numpy()), float(l_pp.numpy()))
+
+
 def _stage_apply(params, x):
     W, b = params
     return jnp.tanh(x @ W + b)
