@@ -1,0 +1,127 @@
+"""Flagship 3D-parallel GPT training: DP x PP x TP on one mesh, end to end.
+
+Demonstrates every distribution dimension this framework composes, through
+the same Model API a single-chip script uses (no reference counterpart —
+SINGA is data-parallel only, SURVEY.md §2.3):
+
+  - data parallelism over the 'data' axis (batch sharding + psum grads)
+  - pipeline parallelism over 'pp' (layer-stacked blocks; GPipe or the
+    fused-1F1B schedule with in-schedule loss and per-stage remat)
+  - tensor parallelism over 'tp' inside every block (Megatron column/row)
+  - vocab parallelism: ONE padded (V_pad, E) table row-sharded over tp is
+    the embedding AND the tied head; the loss runs on sharded logits
+  - orbax full-training-state checkpointing with exact resume
+
+Runs on real chips or on the virtual CPU mesh:
+  JAX_PLATFORMS=cpu python train_3d.py --devices 8
+
+With 8 devices the mesh is {data:2, pp:2, tp:2}.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--devices", type=int, default=8,
+                   help="force an n-device CPU mesh when no multi-chip "
+                        "platform is attached")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--seq", type=int, default=64)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--n-micro", type=int, default=4)
+    p.add_argument("--schedule", default="1f1b",
+                   choices=["gpipe", "1f1b"])
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--ckpt", default=None,
+                   help="directory for an orbax checkpoint; saved at the "
+                        "midpoint and restored before the final steps to "
+                        "demonstrate exact resume")
+    args = p.parse_args()
+
+    import jax
+    # must happen BEFORE any backend initialization (jax rejects device-
+    # count changes afterwards), so decide from the environment alone
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", args.devices)
+        except Exception:
+            pass  # a backend is already up (e.g. under pytest's conftest)
+
+    from singa_tpu import device, models, opt, tensor
+    from singa_tpu.parallel import make_mesh
+    from singa_tpu.parallel.pipeline import pipeline_bubble_fraction
+
+    n = len(jax.devices())
+    assert n % 4 == 0, f"need a multiple of 4 devices, have {n}"
+    mesh = make_mesh({"data": n // 4, "pp": 2, "tp": 2})
+    print(f"mesh: data={n // 4} x pp=2 x tp=2 ({n} devices), "
+          f"schedule={args.schedule}, bubble="
+          f"{pipeline_bubble_fraction(2, args.n_micro, args.schedule):.1%}")
+
+    dev = device.best_device()
+    dev.SetRandSeed(0)
+    m = models.create_model(
+        "gpt_pipe", vocab_size=args.vocab, max_seq=args.seq, dim=args.dim,
+        num_heads=args.heads, num_layers=args.layers,
+        tp_axis="tp", vocab_tp=True)
+    m.set_optimizer(opt.DistOpt(opt.SGD(lr=args.lr, momentum=0.9),
+                                axis="data", mesh=mesh))
+
+    rng = np.random.RandomState(0)
+    # synthetic LM data with learnable structure: next token = f(current)
+    perm = rng.permutation(args.vocab)
+    ids = rng.randint(0, args.vocab, (args.batch, args.seq)) \
+        .astype(np.int32)
+    tgt = perm[ids].astype(np.int32)
+    tx = tensor.from_numpy(ids, dev)
+    ty = tensor.from_numpy(tgt, dev)
+    m.compile([tx], is_train=True, use_graph=True,
+              pipeline_axis="pp", n_micro=args.n_micro,
+              pipeline_schedule=args.schedule)
+
+    half = args.steps // 2
+    ckpt_path = None
+    for step in range(args.steps):
+        _, loss = m(tx, ty)
+        if step == 0:
+            # params carry their mesh sharding after the first step
+            emb = next(t for t in m.get_params().values()
+                       if t.shape[0] == m.padded_vocab)
+            shard = emb.data.addressable_shards[0].data.shape
+            print(f"vocab table: global {tuple(emb.shape)}, per-device "
+                  f"shard {tuple(shard)} (row-sharded over tp)")
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step:3d}: loss {float(loss.numpy()):.4f}",
+                  flush=True)
+        if args.ckpt and step == half - 1:
+            ckpt_path = m.save_checkpoint(args.ckpt, step=half,
+                                          overwrite=True)
+            print(f"checkpointed full training state -> {ckpt_path}")
+    final = float(loss.numpy())
+
+    if ckpt_path:
+        # resume from the midpoint in-place and re-run the second half:
+        # identical final loss = params + momentum + RNG all restored
+        m.load_checkpoint(ckpt_path)
+        for step in range(half, args.steps):
+            _, loss = m(tx, ty)
+        resumed = float(loss.numpy())
+        print(f"resume check: final {final:.6f} vs resumed {resumed:.6f}")
+        assert abs(final - resumed) < 1e-5, "resume diverged"
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

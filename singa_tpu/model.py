@@ -373,10 +373,10 @@ class Model(Layer, metaclass=ModelMeta):
             # plain P() prefix is kept in the no-TP case so strategies with
             # dynamically growing optimizer state (sparse residuals) still
             # pytree-match.
-            state_specs = [sanitize(getattr(t, "spec", None)) or P()
-                           for t in state_tensors]
-            has_tp = any(sanitize(getattr(t, "spec", None)) is not None
-                         for t in state_tensors)
+            sanitized = [sanitize(getattr(t, "spec", None))
+                         for t in state_tensors]
+            state_specs = [s or P() for s in sanitized]
+            has_tp = any(s is not None for s in sanitized)
             if has_tp:
                 state_in = state_specs
                 opt_in = [sanitize(s) or P() for s in opt.state_specs()]
@@ -700,11 +700,26 @@ class Model(Layer, metaclass=ModelMeta):
         rng = dev.rng_state
         if jnp.issubdtype(getattr(rng, "dtype", None), jax.dtypes.prng_key):
             rng = jax.random.key_data(rng)
+        # RAW arrays throughout (no np.asarray): optimizer slots of
+        # sharded params are themselves sharded jax.Arrays and orbax
+        # writes them per-shard — a host gather here would defeat the
+        # point (and fail outright on non-addressable multi-host arrays)
+        opt_tree = {}
+        res_tree = {}
+        if self._optimizer is not None:
+            opt_tree = {f"s{i}": a for i, a in
+                        enumerate(self._optimizer.state_arrays())}
+            # sparse error-feedback residuals are per-DEVICE state under a
+            # replicated spec: save every device's buffer, not device 0's
+            get_stacks = getattr(self._optimizer,
+                                 "residual_device_stacks", None)
+            if get_stacks is not None:
+                res_tree = {f"r{i}": v for i, v in get_stacks().items()}
         tree = {
             "model": {k: t.data for k, t in self.get_states().items()},
-            "opt": (dict(self._optimizer.get_states())
-                    if self._optimizer is not None else {}),
-            "rng": np.asarray(rng),
+            "opt": opt_tree,
+            "res": res_tree,
+            "rng": rng,
         }
         ck = ocp.StandardCheckpointer()
         path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
@@ -715,7 +730,12 @@ class Model(Layer, metaclass=ModelMeta):
     def load_checkpoint(self, path: str):
         """Restore a `save_checkpoint` directory (a .../step_N path) into
         this model + its optimizer + the device RNG. The model must be
-        built/compiled to the same topology first (params exist)."""
+        built/compiled to the same topology first (params exist).
+        Optimizer state (including sparse error-feedback residuals saved
+        before/after their order existed) resumes exactly. NOTE: restore
+        is validated single-process (shardings reapply at the next step);
+        a multi-host restore additionally needs per-host orbax restore
+        args and is not wired yet."""
         import jax
         import orbax.checkpoint as ocp
         ck = ocp.StandardCheckpointer()
@@ -723,8 +743,19 @@ class Model(Layer, metaclass=ModelMeta):
         self.set_states({k: np.asarray(v)
                          for k, v in tree["model"].items()})
         if self._optimizer is not None and tree.get("opt"):
-            self._optimizer.set_states(
-                {k: np.asarray(v) for k, v in tree["opt"].items()})
+            # a fresh model may never have trained: the optimizer's slot
+            # order does not exist until setup(), and the positional
+            # restore below would misalign (momentum read as residuals)
+            self._optimizer.setup(self.get_params().values())
+            opt_tree = tree["opt"]
+            arrs = [jnp.asarray(opt_tree[f"s{i}"])
+                    for i in range(len(opt_tree))]
+            self._optimizer.load_state_arrays(arrs)
+            load_stacks = getattr(self._optimizer,
+                                  "load_residual_device_stacks", None)
+            if load_stacks is not None and tree.get("res"):
+                load_stacks({int(k[1:]): v
+                             for k, v in tree["res"].items()})
         from .device import get_default_device
         dev = self._device or get_default_device()
         dev.rng_state = jax.random.wrap_key_data(

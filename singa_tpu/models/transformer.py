@@ -42,8 +42,11 @@ class _DecodeCore:
     beam-1 == greedy test leans on this).
     """
 
-    def __init__(self, H, E, S0, T, scale):
+    def __init__(self, H, E, S0, T, scale, moe_ks=None):
         self.H, self.E, self.S0, self.T, self.scale = H, E, S0, T, scale
+        # static per-layer MoE routing degree (None = dense MLP); must be
+        # static (int() under jit) so it lives here, not in the param tree
+        self.moe_ks = moe_ks or []
 
     def cast(self, p, dtype):
         import jax
@@ -69,6 +72,29 @@ class _DecodeCore:
             + b.astype(jnp.float32)
         return y.astype(x.dtype)
 
+    def mlp(self, bp, x, li):
+        """Block MLP on (..., E): dense two-layer, or the MoE FFN when
+        layer `li` routes to experts (decode uses the single-device
+        dense-dispatch path; generous capacity so no token drops)."""
+        import jax
+        import jax.numpy as jnp
+        kcf = self.moe_ks[li] if li < len(self.moe_ks) else None
+        if kcf is not None:
+            # NOTE: capacity-limited routing is a BATCH-GLOBAL effect (a
+            # token's drop depends on the other tokens in the dispatch),
+            # so cached decode == full forward only in the no-drop regime
+            # (generous capacity_factor); the layer's own factor is used
+            # here for honest replication.
+            k, cf = kcf
+            from ..parallel.moe import moe_ffn
+            lead = x.shape[:-1]
+            flat = x.reshape(-1, x.shape[-1])
+            y, _, _ = moe_ffn(flat, bp["moeWg"], bp["moeW1"], bp["moeb1"],
+                              bp["moeW2"], bp["moeb2"],
+                              capacity_factor=cf, k=k)
+            return y.reshape(*lead, x.shape[-1]).astype(x.dtype)
+        return jax.nn.gelu(x @ bp["W1"] + bp["bb1"]) @ bp["W2"] + bp["bb2"]
+
     def prefill(self, p, prompt, n):
         """Causal pass over the (n, S0) prompt; returns the last-position
         logits (n, V) and per-block KV caches of time-length T."""
@@ -83,7 +109,7 @@ class _DecodeCore:
 
         caches = []
         cmask = jnp.tril(jnp.ones((S0, S0), bool))
-        for bp in p["blocks"]:
+        for li, bp in enumerate(p["blocks"]):
             x = ln(h, bp["g1"], bp["b1"])
             q, k, v = (heads(x @ bp[w] + bp[bb])
                        for w, bb in (("Wq", "bq"), ("Wk", "bk"),
@@ -94,8 +120,7 @@ class _DecodeCore:
             h = h + o.swapaxes(1, 2).reshape(n, S0, self.E) @ bp["Wo"] \
                 + bp["bo"]
             x = ln(h, bp["g2"], bp["b2"])
-            h = h + jax.nn.gelu(x @ bp["W1"] + bp["bb1"]) @ bp["W2"] \
-                + bp["bb2"]
+            h = h + self.mlp(bp, x, li)
             Kc = jnp.zeros((n, H, T, D), k.dtype).at[:, :, :S0].set(k)
             Vc = jnp.zeros((n, H, T, D), v.dtype).at[:, :, :S0].set(v)
             caches.append((Kc, Vc))
@@ -115,7 +140,7 @@ class _DecodeCore:
         h = p["emb"][tok] + p["pos"][pos_idx]
         kmask = (jnp.arange(self.T) <= pos_idx)
         new_caches = []
-        for (Kc, Vc), bp in zip(caches, p["blocks"]):
+        for li, ((Kc, Vc), bp) in enumerate(zip(caches, p["blocks"])):
             x = ln(h, bp["g1"], bp["b1"])
             q = (x @ bp["Wq"] + bp["bq"]).reshape(n, H, D)
             kn = (x @ bp["Wk"] + bp["bk"]).reshape(n, H, 1, D)
@@ -127,8 +152,7 @@ class _DecodeCore:
             o = jnp.einsum("nhk,nhkd->nhd", a, Vc).reshape(n, E)
             h = h + o @ bp["Wo"] + bp["bo"]
             x = ln(h, bp["g2"], bp["b2"])
-            h = h + jax.nn.gelu(x @ bp["W1"] + bp["bb1"]) @ bp["W2"] \
-                + bp["bb2"]
+            h = h + self.mlp(bp, x, li)
             new_caches.append((Kc, Vc))
         logits = ln(h, p["gf"], p["bf"]) @ p["head"]
         return logits, new_caches
@@ -162,7 +186,9 @@ def _decode_core(m: "GPT", S0, max_new):
     T = S0 + max_new
     assert T <= m.max_seq, \
         f"prompt {S0} + new {max_new} exceeds max_seq {m.max_seq}"
-    return _DecodeCore(H, m.dim, S0, T, (m.dim // H) ** -0.5)
+    moe_ks = [(b.moe.k, float(b.moe.capacity_factor))
+              if b.moe_experts else None for b in m.blocks]
+    return _DecodeCore(H, m.dim, S0, T, (m.dim // H) ** -0.5, moe_ks)
 
 
 class _VocabTPMixin:
@@ -352,17 +378,13 @@ class GPT(_VocabTPMixin, model.Model):
             raise RuntimeError(
                 "generate() needs initialized weights - call "
                 "Model.compile([ids], ...) (or run a forward) first")
-        if self.moe_experts:
-            raise NotImplementedError(
-                "KV-cached generate() does not support MoE blocks yet; "
-                "run forward() for MoE inference")
         import jax.numpy as jnp
         blocks = []
         zeros = jnp.zeros((self.dim,),
                           self.blocks[0].attn.Wq.data.dtype)
         for b in self.blocks:
             ab = b.attn.use_bias
-            blocks.append({
+            bp = {
                 "g1": b.ln1.gamma.data, "b1": b.ln1.beta.data,
                 "Wq": b.attn.Wq.data, "Wk": b.attn.Wk.data,
                 "Wv": b.attn.Wv.data, "Wo": b.attn.Wo.data,
@@ -371,9 +393,21 @@ class GPT(_VocabTPMixin, model.Model):
                 "bv": b.attn.bv.data if ab else zeros,
                 "bo": b.attn.bo.data if ab else zeros,
                 "g2": b.ln2.gamma.data, "b2": b.ln2.beta.data,
-                "W1": b.fc1.W.data, "bb1": b.fc1.b.data,
-                "W2": b.fc2.W.data, "bb2": b.fc2.b.data,
-            })
+            }
+            if b.moe_experts:
+                # routing degree/capacity stay STATIC on _DecodeCore
+                # (moe_ks), not in the traced param tree
+                bp.update({
+                    "moeWg": b.moe.Wg.data,
+                    "moeW1": b.moe.W1.data, "moeb1": b.moe.b1.data,
+                    "moeW2": b.moe.W2.data, "moeb2": b.moe.b2.data,
+                })
+            else:
+                bp.update({
+                    "W1": b.fc1.W.data, "bb1": b.fc1.b.data,
+                    "W2": b.fc2.W.data, "bb2": b.fc2.b.data,
+                })
+            blocks.append(bp)
         emb = self.tok_embed.W.data
         if self.vocab_tp:
             # tied head, truncated to the true vocab so padded rows (never

@@ -326,13 +326,12 @@ class DistOpt(Optimizer):
         if not self.sparse_residuals:
             return
         by_id = getattr(self.opt, "_params_by_id", {})
-        if any(getattr(p, "spec", None) is not None for p in by_id.values()):
-            for pid, p in by_id.items():
-                if getattr(p, "spec", None) is None \
-                        and pid not in self._spars_residual:
-                    self._spars_residual[pid] = jnp.zeros(p.shape,
-                                                          dtype=p.dtype)
-                    self._spars_order.append(pid)
+        for pid, p in by_id.items():
+            if getattr(p, "spec", None) is None \
+                    and pid not in self._spars_residual:
+                self._spars_residual[pid] = jnp.zeros(p.shape,
+                                                      dtype=p.dtype)
+                self._spars_order.append(pid)
 
     def state_arrays(self):
         arrs = list(self.opt.state_arrays())
@@ -351,10 +350,93 @@ class DistOpt(Optimizer):
         return specs
 
     def load_state_arrays(self, arrs):
-        n = len(arrs) - len(self._spars_order)
-        self.opt.load_state_arrays(arrs[:n])
+        n_inner = len(self.opt.state_arrays())
+        self.opt.load_state_arrays(arrs[:n_inner])
+        tail = arrs[n_inner:]
+        if tail and len(tail) < len(self._spars_order):
+            # e.g. saved and restored with different sparse_residuals
+            # settings — positional mapping would misassign
+            raise ValueError(
+                f"checkpoint has {len(tail)} sparse residuals but the "
+                f"optimizer tracks {len(self._spars_order)}; save and "
+                "restore with the same sparse_residuals setting")
+        if not tail and self._spars_order:
+            # rollback to a checkpoint that predates residual creation:
+            # exact resume means starting from zero error feedback
+            for pid in self._spars_order:
+                self._spars_residual[pid] = jnp.zeros_like(
+                    self._spars_residual[pid])
         for i, pid in enumerate(self._spars_order):
-            self._spars_residual[pid] = arrs[n + i]
+            if i < len(tail):
+                self._spars_residual[pid] = tail[i]
+        extra = list(tail[len(self._spars_order):])
+        if extra:
+            # checkpoint restored before the first backward established
+            # the residual order: consumed in creation order by
+            # backward_and_sparse_update
+            self._pending_residuals = extra
+
+    # -- per-device residual checkpointing --------------------------------
+    # Error-feedback residuals are PER-DEVICE state (each data shard keeps
+    # its own top-K leftovers) that rides the step under a replicated
+    # out-spec — the per-device buffers persist across steps because the
+    # step feeds its own outputs back in. A naive save reads device 0's
+    # copy only; these two methods save/restore the full (n_dev, ...)
+    # stack so checkpoint-resume stays bit-identical. Exact dist resume
+    # additionally needs DistOpt(sparse_residuals=True), so the slots are
+    # threaded as step INPUTS from step 0 (a lazily-created slot restored
+    # into a fresh model would be baked into the first executable as a
+    # constant, collapsing the per-device values again).
+    def residual_device_stacks(self):
+        """{state_arrays index: (n_devices, *shape) numpy} for residuals
+        whose per-device buffers differ (multi-device arrays)."""
+        import jax
+        out = {}
+        n_inner = len(self.opt.state_arrays())
+        for i, pid in enumerate(self._spars_order):
+            a = self._spars_residual[pid]
+            if isinstance(a, jax.Array) and len(a.addressable_shards) > 1:
+                shards = sorted(a.addressable_shards,
+                                key=lambda s: s.device.id)
+                out[n_inner + i] = np.stack(
+                    [np.asarray(s.data) for s in shards])
+        return out
+
+    def load_residual_device_stacks(self, stacks):
+        """Rebuild per-device residual arrays from `residual_device_stacks`
+        output (single-process meshes)."""
+        import jax
+        mesh = self.communicator.mesh
+        if not stacks:
+            return
+        if mesh is None:
+            raise ValueError(
+                "checkpoint carries per-device sparse residuals but this "
+                "DistOpt has no mesh; restore on the same topology")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P())
+        devs = sorted(mesh.devices.flatten(), key=lambda d: d.id)
+        n_inner = len(self.opt.state_arrays())
+        for idx, stacked in stacks.items():
+            stacked = np.asarray(stacked)
+            if stacked.shape[0] != len(devs):
+                raise ValueError(
+                    f"per-device residual saved on {stacked.shape[0]} "
+                    f"devices cannot restore on a {len(devs)}-device "
+                    "mesh (error-feedback state is per-device; use the "
+                    "same topology)")
+            arrs = [jax.device_put(stacked[i], d)
+                    for i, d in enumerate(devs)]
+            ga = jax.make_array_from_single_device_arrays(
+                stacked.shape[1:], sh, arrs)
+            i = int(idx) - n_inner
+            if i < len(self._spars_order):
+                self._spars_residual[self._spars_order[i]] = ga
+            else:
+                pend = getattr(self, "_pending_residuals", None)
+                if pend is not None and i - len(self._spars_order) < \
+                        len(pend):
+                    pend[i - len(self._spars_order)] = ga
 
     def get_states(self):
         out = self.opt.get_states()
@@ -368,6 +450,18 @@ class DistOpt(Optimizer):
             key = f"spars_residual.{i}"
             if key in states:
                 self._spars_residual[pid] = jnp.asarray(states[key])
+        # residuals restored BEFORE the first backward established the
+        # param order (lazy creation): queue them; the sparse strategy
+        # consumes them in creation order instead of starting from zeros,
+        # keeping checkpoint-resume bit-identical
+        n_known = len(self._spars_order)
+        pending = []
+        i = n_known
+        while f"spars_residual.{i}" in states:
+            pending.append(jnp.asarray(states[f"spars_residual.{i}"]))
+            i += 1
+        if pending:
+            self._pending_residuals = pending
 
     def step(self):
         self.opt.step()
@@ -510,6 +604,18 @@ class DistOpt(Optimizer):
         by_id = getattr(self.opt, "_params_by_id", {})
         has_sharded = any(getattr(p, "spec", None) is not None
                           for p in by_id.values())
+        # precondition BEFORE any param is touched: per-leaf state specs
+        # cannot grow mid-trace, so residuals on a sharded-param model
+        # must have been pre-created at setup (raising mid-loop would
+        # leave the model half-updated / leak tracers into opt state)
+        if corr and has_sharded and any(
+                getattr(p, "spec", None) is None
+                and id(p) not in self._spars_residual
+                for p in by_id.values()):
+            raise RuntimeError(
+                "error-feedback residuals on a model with sharded params "
+                "must be pre-created: construct "
+                "DistOpt(..., sparse_residuals=True)")
         for p, g in autograd.backward(loss):
             pid = id(p)
             if getattr(p, "spec", None) is not None:
@@ -525,15 +631,13 @@ class DistOpt(Optimizer):
                 self.opt.apply(p, g)
                 continue
             if corr and pid not in self._spars_residual:
-                if has_sharded:
-                    # per-leaf state specs cannot grow mid-trace: the
-                    # residuals must exist before the step compiles
-                    raise RuntimeError(
-                        "error-feedback residuals on a model with "
-                        "sharded params must be pre-created: construct "
-                        "DistOpt(..., sparse_residuals=True)")
-                self._spars_residual[pid] = jnp.zeros(p.shape,
-                                                      dtype=p.dtype)
+                pending = getattr(self, "_pending_residuals", None)
+                if pending:
+                    # restored from a checkpoint before the order existed
+                    self._spars_residual[pid] = pending.pop(0)
+                else:
+                    self._spars_residual[pid] = jnp.zeros(p.shape,
+                                                          dtype=p.dtype)
                 self._spars_order.append(pid)
             acc = self._spars_residual[pid] if corr else 0.0
             x = g.data + acc

@@ -151,3 +151,26 @@ def test_overlong_generation_raises(gpt):
     m, _ = gpt
     with pytest.raises(AssertionError, match="max_seq"):
         m.generate(np.zeros((1, 60), np.int32), 10)
+
+
+def test_moe_gpt_greedy_matches_full_forward():
+    """MoE blocks in the KV-cached decode (previously NotImplementedError):
+    the single-token step routes through the dense-dispatch MoE FFN and
+    greedy output matches the naive full-forward loop exactly. Generous
+    capacity: with drops, routing is batch-global (a token's fate depends
+    on the other tokens in the dispatch group), so the cached decode —
+    whose groups are single positions — can only equal the full forward
+    in the no-drop regime."""
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=61, max_seq=32, dim=32,
+                            num_heads=4, num_layers=2, moe_experts=4,
+                            moe_k=2, moe_capacity_factor=4.0)
+    ids = tensor.from_numpy(
+        np.random.RandomState(3).randint(0, 61, (2, 6)).astype(np.int32),
+        device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    prompt = np.random.RandomState(4).randint(0, 61, (2, 6))
+    want = _naive_greedy(m, dev, prompt, 5)
+    got = m.generate(prompt, 5, temperature=0.0)
+    np.testing.assert_array_equal(got, want)
