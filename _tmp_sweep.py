@@ -1,0 +1,25 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from singa_tpu.ops.attention import flash_attention
+B,H,S,D = 8,16,1024,128
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+
+def timed(f, *a, iters=20):
+    np.asarray(jax.device_get(f(*a)))  # compile + fence
+    t0 = time.perf_counter()
+    o = None
+    for _ in range(iters):
+        o = f(*a)
+    s = jnp.sum(o[0].astype(jnp.float32)) if isinstance(o, tuple) else jnp.sum(o.astype(jnp.float32))
+    np.asarray(jax.device_get(s))
+    return (time.perf_counter()-t0)/iters*1e3
+
+for bq in (None, 512, 256, 128):
+    for bk in (None, 512, 256, 128):
+        fwd = jax.jit(lambda q,k,v,bq=bq,bk=bk: flash_attention(q,k,v,True,block_q=bq,block_k=bk))
+        def loss(q,k,v,bq=bq,bk=bk):
+            return jnp.sum(flash_attention(q,k,v,True,block_q=bq,block_k=bk).astype(jnp.float32))
+        bwd = jax.jit(jax.grad(loss, argnums=(0,1,2)))
+        print(f"bq={bq} bk={bk}: fwd {timed(fwd,q,k,v):.3f} ms, grad {timed(bwd,q,k,v):.3f} ms")

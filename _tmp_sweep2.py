@@ -1,0 +1,21 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from singa_tpu.ops.attention import flash_attention
+B,H,S,D = 8,16,1024,128
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((B,H,S,D)), jnp.bfloat16)
+def timed(f, *a, iters=20):
+    np.asarray(jax.device_get(f(*a)))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(iters):
+            o = f(*a)
+        np.asarray(jax.device_get(jnp.sum(o.astype(jnp.float32))))
+        ts.append((time.perf_counter()-t0)/iters*1e3)
+    return min(ts)
+for bq, bk in ((None,None),(1024,1024),(512,1024),(256,None),(None,None)):
+    fwd = jax.jit(lambda q,k,v,bq=bq,bk=bk: flash_attention(q,k,v,True,block_q=bq,block_k=bk))
+    print(f"bq={bq} bk={bk}: fwd {timed(fwd,q,k,v):.3f} ms")

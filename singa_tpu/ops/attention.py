@@ -61,6 +61,10 @@ DEFAULT_BLOCK_K = None
 
 
 def _default_block(s):
+    import os
+    env = os.environ.get("SINGA_FLASH_BLOCK")
+    if env:
+        return int(env)
     return 1024 if s >= 1024 else 256
 
 
