@@ -847,6 +847,34 @@ def _fn_block(params, h, num_heads, tp_axis=None):
     return h + y + bb2
 
 
+def _make_chunk_fn(num_heads, axis, total_layers, pc, tp_axis=None):
+    """Chunk-aware stage application for the interleaved schedule: this
+    device's local stack rows [c*pc, (c+1)*pc) are virtual chunk `c`
+    (global pipeline stage c*n + d), so global layer (c*n+d)*pc + j
+    decides the non-uniform padding mask (rows past total_layers are
+    identity)."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    def chunk_fn(local_stacks, x, c):
+        # local stacks are (V, pc, ...): chunk-major leading dim (the
+        # full tensor is (V, n*pc, ...) with spec P(None, pp) — its
+        # row-major order IS the canonical stage-major layer order,
+        # since flat index c*(n*pc) + d*pc + j = ((c*n+d)*pc + j))
+        n = lax.axis_size(axis)
+        d = lax.axis_index(axis)
+        for j in range(pc):
+            params = [lax.dynamic_index_in_dim(st, c, 0,
+                                               keepdims=False)[j]
+                      for st in local_stacks]
+            on = ((c * n + d) * pc + j) < total_layers
+            y = _fn_block(params, x, num_heads, tp_axis)
+            x = jnp.where(on, y, x)
+        return x
+
+    return chunk_fn
+
+
 def _make_stage_fn(num_heads, axis, total_layers, tp_axis=None):
     """Per-stage block application with non-uniform stage support: local
     stacks carry padded_layers/n rows; rows whose GLOBAL index (stage*per +
@@ -871,21 +899,25 @@ def _make_stage_fn(num_heads, axis, total_layers, tp_axis=None):
 
 
 class _PipelineBlocks(autograd.Operator):
-    """All transformer blocks as one tape op: GPipe scan inside shard_map
-    (parallel/pipeline.py gpipe), serial layer loop outside a mesh."""
+    """All transformer blocks as one tape op: GPipe (or interleaved
+    virtual-chunk GPipe) scan inside shard_map (parallel/pipeline.py),
+    serial layer loop outside a mesh."""
 
     def __init__(self, num_heads, axis=None, n_micro=1, total_layers=None,
-                 tp_axis=None):
+                 tp_axis=None, interleave=1, pc=None):
         super().__init__("PipelineBlocks")
         self.num_heads = num_heads
         self.axis = axis
         self.n_micro = n_micro
         self.total_layers = total_layers
         self.tp_axis = tp_axis
+        self.interleave = interleave
+        self.pc = pc          # layers per virtual chunk (interleave > 1)
 
     def forward(self, h, *stacks):
         import jax.numpy as jnp
-        from ..parallel.pipeline import gpipe, bcast_from_last
+        from ..parallel.pipeline import (gpipe, gpipe_interleaved,
+                                         bcast_from_last)
         nh = self.num_heads
         L = self.total_layers or stacks[0].shape[0]
         if self.axis is not None and autograd.axis_bound(self.axis):
@@ -896,15 +928,23 @@ class _PipelineBlocks(autograd.Operator):
                                   and autograd.axis_bound(self.tp_axis)) \
                 else None
             x_micro = h.reshape(nm, B // nm, *h.shape[1:])
-            stage_fn = _make_stage_fn(nh, self.axis, L, tp)
-            outs = gpipe(stage_fn, list(stacks), x_micro, self.axis)
+            if self.interleave > 1:
+                chunk_fn = _make_chunk_fn(nh, self.axis, L, self.pc, tp)
+                outs = gpipe_interleaved(chunk_fn, list(stacks), x_micro,
+                                         self.axis, self.interleave)
+            else:
+                stage_fn = _make_stage_fn(nh, self.axis, L, tp)
+                outs = gpipe(stage_fn, list(stacks), x_micro, self.axis)
             outs = bcast_from_last(self.axis, outs)
             return outs.reshape(B, *h.shape[1:])
-        # serial fallback (eval / single device): loop the real rows (the
-        # stack may carry zero-init padding rows past L when built for a
-        # non-uniform pipeline)
-        for li in range(L):
-            h = _fn_block([s[li] for s in stacks], h, nh)
+        # serial fallback (eval / single device): the (V, n*pc, ...)
+        # interleaved stacks share the flat canonical memory order, so a
+        # reshape recovers layer-major rows; padding rows past L are
+        # skipped entirely
+        if self.interleave > 1:
+            stacks = [s.reshape((-1,) + s.shape[2:]) for s in stacks]
+        for g in range(L):
+            h = _fn_block([s[g] for s in stacks], h, nh)
         return h
 
 
@@ -1015,7 +1055,7 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
     def __init__(self, vocab_size, max_seq=1024, dim=256, num_heads=8,
                  num_layers=4, mlp_ratio=4, tp_axis=None, vocab_tp=False,
                  vocab_pad_multiple=128, vocab_tp_return_logits=True,
-                 name=None):
+                 interleave=1, name=None):
         super().__init__(name)
         self.vocab_size = vocab_size
         self.max_seq = max_seq
@@ -1024,6 +1064,13 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
         self.num_layers = num_layers
         self.mlp_ratio = mlp_ratio
         self.tp_axis = tp_axis
+        # interleave=V > 1: each device holds V virtual chunks assigned
+        # round-robin over the pipeline (Megatron interleaved virtual
+        # stages) — cuts the bubble below GPipe's at the same memory
+        # profile (parallel/pipeline.py gpipe_interleaved /
+        # schedule_table). gpipe schedule only.
+        assert interleave >= 1
+        self.interleave = int(interleave)
         if vocab_tp and tp_axis is None:
             raise ValueError(
                 "vocab_tp=True needs tp_axis (see GPT.__init__)")
@@ -1046,6 +1093,18 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
         self.sce = layer.SoftMaxCrossEntropy()
         self._stacks_init = False
 
+    def compile(self, inputs, **kwargs):
+        # validate BEFORE tracing: raising inside the traced step would
+        # leak tracers into the device RNG state
+        if kwargs.get("pipeline_schedule") == "1f1b" and \
+                self.interleave > 1:
+            raise ValueError(
+                "interleave>1 composes with the gpipe schedule only: "
+                "1f1b's fused scan assumes one contiguous stage per "
+                "device (see parallel/pipeline.py schedule_table for "
+                "the bubble/memory/compute trade-offs)")
+        return super().compile(inputs, **kwargs)
+
     def _mesh_axis_size(self, axis):
         """Mesh degree of `axis`, readable at param-init time (compile
         runs after set_optimizer, so the mesh is already attached)."""
@@ -1060,17 +1119,33 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
     def _n_stages(self):
         return self._mesh_axis_size(self.pipeline_axis)
 
+    def _blocks_op(self):
+        return _PipelineBlocks(
+            self.num_heads, self.pipeline_axis, self.n_micro,
+            self.num_layers, self.tp_axis, interleave=self.interleave,
+            pc=getattr(self, "_chunk_layers", None))
+
     def _init_stacks(self, dev):
         import numpy as np
         L, E, H = self.num_layers, self.dim, self.dim * self.mlp_ratio
         # non-uniform stages: pad the stack to stages*ceil(L/stages) rows
         # so shard_map can slice it evenly; rows [L, padded) are zero-init
         # padding that _make_stage_fn masks to the identity (late stages
-        # simply run fewer real layers)
+        # simply run fewer real layers). With interleave=V>1 the unit is
+        # the virtual chunk: stacks are shaped (V, n*pc, ...) with spec
+        # P(None, pp), so device d's local (V, pc, ...) slice holds its V
+        # round-robin chunks — and because global stage = c*n + d, the
+        # tensor's row-major order IS the canonical layer order (the
+        # (V, n*pc) layout is a pure reshape of the flat (Lp,) stack; no
+        # permutation, and shapes disambiguate canonical (L,...) inputs
+        # from same-config round-trips in set_params).
         n_pp = self._n_stages()
-        per = -(-L // n_pp)
-        Lp = n_pp * per
+        V = self.interleave
+        pc = -(-L // (n_pp * V))
+        Lp = n_pp * V * pc
         self.padded_layers = Lp
+        self._chunk_layers = pc
+        self._stack_lead = (V, n_pp * pc) if V > 1 else (Lp,)
         tp_n = self._mesh_axis_size(self.tp_axis)
         if tp_n > 1:
             assert self.pipeline_axis is not None, (
@@ -1092,18 +1167,20 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
                     "bb1": P(pp, tp)}
 
         def mk(attr, shape, scale=None):
-            t = Tensor((Lp,) + shape, device=dev, dtype=float32)
+            lead = self._stack_lead
+            t = Tensor(lead + shape, device=dev, dtype=float32)
+            vals = np.zeros((Lp,) + shape, np.float32)
             if scale is None:   # layernorm gain/bias
-                vals = np.zeros((Lp,) + shape, np.float32)
                 vals[:L] = 1.0 if attr.startswith("g") else 0.0
-                t.copy_from_numpy(vals)
             else:
-                vals = np.zeros((Lp,) + shape, np.float32)
                 vals[:L] = (rng.standard_normal((L,) + shape)
                             * scale).astype(np.float32)
-                t.copy_from_numpy(vals)
+            t.copy_from_numpy(vals.reshape(lead + shape))
             if pp is not None:
-                t.spec = tp_specs.get(attr, P(pp)) if tp_n > 1 else P(pp)
+                spec = tp_specs.get(attr, P(pp)) if tp_n > 1 else P(pp)
+                if len(lead) == 2:   # (V, n*pc, ...): pp shards dim 1
+                    spec = P(None, *spec)
+                t.spec = spec
             self._register_param(attr, t)
 
         mk("g1", (E,)), mk("b1", (E,))
@@ -1140,30 +1217,48 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
 
     def forward(self, ids):
         h = self._embed(ids)
-        op = _PipelineBlocks(self.num_heads, self.pipeline_axis,
-                             self.n_micro, self.num_layers, self.tp_axis)
+        op = self._blocks_op()
         h = op(h, *[getattr(self, a) for a in self._STACK_ATTRS])
         return self._caller_logits(h)
 
     def set_params(self, params: dict):
         """Accepts stacks from a model built with a different pipeline
-        degree: a (num_layers, ...) stack loads into this model's
-        (padded_layers, ...) stack by filling the real rows (padding rows
-        stay zero), and vice versa by slicing."""
+        degree: a CANONICAL-layer-order (num_layers, ...) stack loads
+        into this model's stack by zero-padding to padded_layers and
+        reshaping to the stack's lead shape ((Lp, ...) normally,
+        (V, n*pc, ...) under interleave>1 — same memory order, so this
+        is a pure reshape). Same-shape stacks pass through unchanged
+        (the shapes disambiguate, so get_params -> set_params round
+        trips between identical configs are exact)."""
         import numpy as np
         own = self.get_params()
         fixed = {}
         for n, v in params.items():
             arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
-            if (n in own and n.split(".")[-1] in self._STACK_ATTRS
-                    and arr.shape != tuple(own[n].shape)
-                    and arr.shape[1:] == tuple(own[n].shape)[1:]):
-                Lp = own[n].shape[0]
-                out = np.zeros((Lp,) + arr.shape[1:], arr.dtype)
-                out[:min(Lp, arr.shape[0])] = arr[:min(Lp, arr.shape[0])]
-                arr = out
+            own_shape = tuple(own[n].shape) if n in own else None
+            if (own_shape and arr.shape != own_shape
+                    and n.split(".")[-1] in self._STACK_ATTRS):
+                lead = self._stack_lead
+                body = own_shape[len(lead):]
+                if arr.shape[1:] == body:       # canonical (L_in, ...)
+                    Lp = self.padded_layers
+                    glob = np.zeros((Lp,) + body, arr.dtype)
+                    m = min(Lp, arr.shape[0])
+                    glob[:m] = arr[:m]
+                    arr = glob.reshape(lead + body)
             fixed[n] = arr
         super().set_params(fixed)
+
+    def canonical_stacks(self) -> dict:
+        """The block stacks as numpy arrays in CANONICAL layer order
+        (row 0 = layer 0, padded to padded_layers) regardless of
+        interleave — the (V, n*pc, ...) interleaved layout shares the
+        flat memory order, so this is a reshape, not a gather."""
+        return {a: getattr(self, a).numpy()
+                .reshape((self.padded_layers,)
+                         + tuple(getattr(self, a).shape)[
+                             len(self._stack_lead):])
+                for a in self._STACK_ATTRS}
 
     def _caller_logits(self, h_out):
         """Caller-facing logits from post-block activations, OUTSIDE the
@@ -1178,6 +1273,8 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
 
     def train_one_batch(self, ids, targets):
         sched = getattr(self, "pipeline_schedule", "gpipe")
+        # (interleave>1 + 1f1b is rejected at compile() time, before any
+        # tracing could leak)
         if sched == "1f1b" and self.pipeline_axis is not None and \
                 autograd.axis_bound(self.pipeline_axis):
             h = self._embed(ids)
@@ -1196,9 +1293,7 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
             return logits, loss
         if self.vocab_tp:
             h = self._embed(ids)
-            op = _PipelineBlocks(self.num_heads, self.pipeline_axis,
-                                 self.n_micro, self.num_layers,
-                                 self.tp_axis)
+            op = self._blocks_op()
             h = op(h, *[getattr(self, a) for a in self._STACK_ATTRS])
             local = self._tied_logits(self.ln_f(h))
             loss, logits = self._vp_loss_and_logits(local, targets)
