@@ -94,6 +94,31 @@ def moe_ffn(x, Wg, W1, b1, W2, b2, capacity_factor=1.25, act=None, k=1):
     return y, aux, (z_loss, overflow)
 
 
+def _a2a(x, axis_name: str, split_axis: int, concat_axis: int):
+    """lax.all_to_all with an explicit custom vjp: the transpose of an
+    all_to_all is the mirrored all_to_all (it permutes data across
+    devices, so its linear adjoint is the inverse permutation). JAX's
+    built-in transpose rule mis-lowers when the op is differentiated
+    through a lax.scan (the PP x EP pipeline case: expert dispatch
+    inside the gpipe slot scan) — the explicit rule sidesteps it and is
+    what the math says anyway."""
+
+    @jax.custom_vjp
+    def run(v):
+        return lax.all_to_all(v, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis)
+
+    def fwd(v):
+        return run(v), None
+
+    def bwd(_, dy):
+        return (lax.all_to_all(dy, axis_name, split_axis=concat_axis,
+                               concat_axis=split_axis),)
+
+    run.defvjp(fwd, bwd)
+    return run(x)
+
+
 def moe_ffn_ep(x, Wg, W1, b1, W2, b2, axis_name: str,
                capacity_factor=1.25, act=None, k=1):
     """Expert-parallel MoE inside shard_map.
@@ -115,13 +140,11 @@ def moe_ffn_ep(x, Wg, W1, b1, W2, b2, axis_name: str,
     # group by owning device and exchange: (n, E_local, C, D) -> each
     # device receives its expert group from everyone -> (E_local, n, C, D)
     grouped = blocks.reshape(n, e_local, capacity, -1)
-    received = lax.all_to_all(grouped, axis_name, split_axis=0,
-                              concat_axis=1)              # (e_local,n,C,D)
+    received = _a2a(grouped, axis_name, 0, 1)             # (e_local,n,C,D)
     stacked = received.reshape(e_local, n * capacity, -1)
     out = _expert_ffn(stacked, W1, b1, W2, b2, act)       # (e_local,nC,D)
     out = out.reshape(e_local, n, capacity, -1)
-    returned = lax.all_to_all(out, axis_name, split_axis=1,
-                              concat_axis=0)              # (n,e_local,C,D)
+    returned = _a2a(out, axis_name, 1, 0)                 # (n,e_local,C,D)
     out_blocks = returned.reshape(E, capacity, -1)
     y = jnp.einsum("tec,ecd->td", combine, out_blocks)
     aux = lax.pmean(aux, axis_name)

@@ -15,16 +15,23 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def gpipe(stage_fn, stage_params, x_micro, axis_name):
+def gpipe(stage_fn, stage_params, x_micro, axis_name, with_aux=False):
     """Run the pipeline.
 
     stage_fn(params, x) -> y: one stage's computation; activation shape
         must be the same for every stage (classic GPipe constraint).
+        With `with_aux`, stage_fn returns (y, aux) where aux is a
+        fixed-shape array of per-stage scalars (e.g. MoE router losses);
+        aux is accumulated ONLY over this stage's active slots (warmup/
+        drain slots run on garbage and must not pollute it).
     stage_params: this device's stage params (pytree of arrays).
     x_micro: (n_micro, mb, ...) microbatched input, same value on every
         device (only stage 0 consumes it).
     Returns (n_micro, mb, ...) outputs — valid on the LAST stage; other
         stages hold zeros (psum/select on the caller side if needed).
+    With `with_aux`: (outs, aux_sum) — aux_sum is this DEVICE's stage's
+        aux summed over the n_micro active slots (psum over the axis and
+        divide by n_micro for the per-microbatch mean).
     """
     n = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -36,13 +43,19 @@ def gpipe(stage_fn, stage_params, x_micro, axis_name):
     outs = jnp.zeros_like(x_micro)
 
     def step(carry, t):
-        buf, outs = carry
+        buf, outs, aux_acc = carry
         mb = jnp.clip(t, 0, n_micro - 1)
         inp = jnp.where(stage == 0,
                         lax.dynamic_index_in_dim(x_micro, mb, 0,
                                                  keepdims=False),
                         buf)
-        y = stage_fn(stage_params, inp)
+        if with_aux:
+            y, aux = stage_fn(stage_params, inp)
+            active = ((t >= stage) & (t - stage < n_micro)).astype(
+                aux.dtype)
+            aux_acc = aux_acc + aux * active
+        else:
+            y = stage_fn(stage_params, inp)
         out_idx = t - (n - 1)
         write = jnp.logical_and(stage == n - 1, out_idx >= 0)
         safe_idx = jnp.maximum(out_idx, 0)
@@ -50,10 +63,12 @@ def gpipe(stage_fn, stage_params, x_micro, axis_name):
         upd = jnp.where(write, y, cur)
         outs = lax.dynamic_update_index_in_dim(outs, upd, safe_idx, 0)
         buf = lax.ppermute(y, axis_name, perm)
-        return (buf, outs), None
+        return (buf, outs, aux_acc), None
 
-    (buf, outs), _ = lax.scan(step, (buf, outs), jnp.arange(steps))
-    return outs
+    aux0 = jnp.zeros((2,), jnp.float32)
+    (buf, outs, aux_acc), _ = lax.scan(step, (buf, outs, aux0),
+                                       jnp.arange(steps))
+    return (outs, aux_acc) if with_aux else outs
 
 
 def gpipe_interleaved(chunk_fn, stage_params, x_micro, axis_name,
