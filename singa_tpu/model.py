@@ -727,39 +727,91 @@ class Model(Layer, metaclass=ModelMeta):
         ck.wait_until_finished()
         return path
 
+    def _restore_template(self, path):
+        """Abstract restore targets carrying THIS process's current
+        shardings, so orbax reads only the shards each host addresses —
+        the multi-host restore path (every process calls load_checkpoint
+        with the same path; arrays come back sharded exactly as the live
+        training state is). Leaves whose live counterpart does not exist
+        yet (sparse residual stacks, the rng key-data) fall back to the
+        checkpoint's own metadata with a replicated sharding."""
+        import jax
+        import orbax.checkpoint as ocp
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                        sharding=a.sharding)
+
+        mesh = None
+        if self._optimizer is not None:
+            mesh = getattr(
+                getattr(self._optimizer, "communicator", None),
+                "mesh", None)
+
+        def meta_leaf(m):
+            # replicated target: correct on one host, and on a pod every
+            # host holds the full (small) array
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                return jax.ShapeDtypeStruct(
+                    tuple(m.shape), np.dtype(m.dtype),
+                    sharding=NamedSharding(mesh, PartitionSpec()))
+            return jax.ShapeDtypeStruct(tuple(m.shape), np.dtype(m.dtype))
+
+        meta = ocp.StandardCheckpointer().metadata(
+            os.path.abspath(path)).item_metadata
+        tpl = {
+            "model": {k: sds(t.data)
+                      for k, t in self.get_states().items()},
+            "opt": {}, "res": {},
+            "rng": meta_leaf(meta["rng"]),
+        }
+        if self._optimizer is not None and meta.get("opt"):
+            self._optimizer.setup(self.get_params().values())
+            tpl["opt"] = {f"s{i}": sds(a) for i, a in
+                          enumerate(self._optimizer.state_arrays())}
+        tpl["res"] = {k: meta_leaf(m)
+                      for k, m in (meta.get("res") or {}).items()}
+        return tpl
+
     def load_checkpoint(self, path: str):
         """Restore a `save_checkpoint` directory (a .../step_N path) into
         this model + its optimizer + the device RNG. The model must be
-        built/compiled to the same topology first (params exist).
+        built/compiled to the same topology first (params exist; under
+        `jax.distributed` every process calls this with the same path and
+        receives its own shards — restore targets carry the live training
+        state's shardings, so no host ever gathers the full arrays).
         Optimizer state (including sparse error-feedback residuals saved
-        before/after their order existed) resumes exactly. NOTE: restore
-        is validated single-process (shardings reapply at the next step);
-        a multi-host restore additionally needs per-host orbax restore
-        args and is not wired yet."""
+        before/after their order existed) resumes exactly; bit-identical
+        continuation is asserted single-process by tests/test_model.py::
+        test_checkpoint_resume_equivalence and across 2 processes by
+        examples/multihost/ckpt_2proc.py (the CI leg)."""
         import jax
         import orbax.checkpoint as ocp
         ck = ocp.StandardCheckpointer()
-        tree = ck.restore(os.path.abspath(path))
-        self.set_states({k: np.asarray(v)
-                         for k, v in tree["model"].items()})
+        tree = ck.restore(os.path.abspath(path),
+                          self._restore_template(path))
+        # direct buffer assignment: the restored arrays already carry the
+        # live shardings (template), so no host round-trip — required on
+        # multi-host, where np.asarray of a global array would throw
+        states = self.get_states()
+        for k, v in tree["model"].items():
+            states[k].data = v
         if self._optimizer is not None and tree.get("opt"):
-            # a fresh model may never have trained: the optimizer's slot
-            # order does not exist until setup(), and the positional
-            # restore below would misalign (momentum read as residuals)
-            self._optimizer.setup(self.get_params().values())
+            # (setup already ran while building the restore template, so
+            # the positional slot order below cannot misalign)
             opt_tree = tree["opt"]
-            arrs = [jnp.asarray(opt_tree[f"s{i}"])
-                    for i in range(len(opt_tree))]
+            arrs = [opt_tree[f"s{i}"] for i in range(len(opt_tree))]
             self._optimizer.load_state_arrays(arrs)
             load_stacks = getattr(self._optimizer,
                                   "load_residual_device_stacks", None)
             if load_stacks is not None and tree.get("res"):
-                load_stacks({int(k[1:]): v
+                load_stacks({int(k[1:]): np.asarray(v)
                              for k, v in tree["res"].items()})
         from .device import get_default_device
         dev = self._device or get_default_device()
         dev.rng_state = jax.random.wrap_key_data(
-            jnp.asarray(tree["rng"], jnp.uint32))
+            jnp.asarray(np.asarray(tree["rng"]), jnp.uint32))
         self._compiled_step = None  # drop stale executable state binding
         return self
 
