@@ -1,0 +1,99 @@
+"""End-to-end training through the REAL on-disk data formats (VERDICT r3
+#8): fabricate a valid CIFAR-10 pickle-batch directory and MNIST IDX
+files (the exact byte formats the reference downloads —
+reference examples/cnn/data/cifar10.py / mnist.py), then run
+examples/cnn/train_cnn.py for one epoch THROUGH ITS OWN argv entrypoint
+and assert the run used the real parse path (no SYNTHETIC-DATA tag) and
+trained to a finite loss. The loader unit tests (tests/test_loaders.py)
+prove byte-exact parsing; this proves the full epoch loop runs on files.
+
+Run: python examples/cnn/e2e_realformat.py
+"""
+
+import gzip
+import os
+import pickle
+import re
+import shutil
+import struct
+import subprocess
+import sys
+
+import numpy as np
+
+CIFAR_DIR = "/tmp/cifar-10-batches-py"
+MNIST_DIR = "/tmp/mnist"
+
+
+def fabricate_cifar(n_per_batch=200, n_test=200):
+    os.makedirs(CIFAR_DIR, exist_ok=True)
+    rng = np.random.RandomState(7)
+
+    def write(path, n):
+        with open(path, "wb") as f:
+            pickle.dump({
+                b"data": rng.randint(0, 256, (n, 3072), dtype=np.uint8),
+                b"labels": rng.randint(0, 10, n).tolist(),
+            }, f)
+
+    for i in range(1, 6):
+        write(os.path.join(CIFAR_DIR, f"data_batch_{i}"), n_per_batch)
+    write(os.path.join(CIFAR_DIR, "test_batch"), n_test)
+
+
+def fabricate_mnist(n_train=600, n_val=200):
+    os.makedirs(MNIST_DIR, exist_ok=True)
+    rng = np.random.RandomState(8)
+
+    def write_idx(path, arr, gz):
+        op = gzip.open if gz else open
+        with op(path, "wb") as f:
+            f.write(struct.pack(">HBB", 0, 8, arr.ndim))
+            for dim in arr.shape:
+                f.write(struct.pack(">I", dim))
+            f.write(arr.tobytes())
+
+    write_idx(os.path.join(MNIST_DIR, "train-images-idx3-ubyte.gz"),
+              rng.randint(0, 256, (n_train, 28, 28), dtype=np.uint8), True)
+    write_idx(os.path.join(MNIST_DIR, "train-labels-idx1-ubyte.gz"),
+              rng.randint(0, 10, (n_train,)).astype(np.uint8), True)
+    write_idx(os.path.join(MNIST_DIR, "t10k-images.idx3-ubyte"),
+              rng.randint(0, 256, (n_val, 28, 28), dtype=np.uint8), False)
+    write_idx(os.path.join(MNIST_DIR, "t10k-labels.idx1-ubyte"),
+              rng.randint(0, 10, (n_val,)).astype(np.uint8), False)
+
+
+def run_epoch(dataset):
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = subprocess.run(
+        [sys.executable, os.path.join(here, "train_cnn.py"), "cnn",
+         dataset, "--epochs", "1", "--batch", "50", "--lr", "0.01"],
+        capture_output=True, text=True, timeout=1200,
+        cwd=os.path.join(here, "..", ".."))
+    sys.stdout.write(out.stdout)
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "SYNTHETIC-DATA" not in out.stdout, (
+        f"{dataset}: training fell back to synthetic tensors — the "
+        "fabricated on-disk files were not picked up by the real parser")
+    m = re.search(r"train loss=([0-9.einf+-]+)", out.stdout)
+    assert m, out.stdout
+    loss = float(m.group(1))
+    assert np.isfinite(loss), f"{dataset}: non-finite loss {loss}"
+    print(f"{dataset}: one epoch through the real parse path, "
+          f"loss={loss} (finite), no synthetic tag")
+
+
+def main():
+    try:
+        fabricate_cifar()
+        fabricate_mnist()
+        run_epoch("cifar10")
+        run_epoch("mnist")
+        print("e2e real-format training OK")
+    finally:
+        shutil.rmtree(CIFAR_DIR, ignore_errors=True)
+        shutil.rmtree(MNIST_DIR, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -70,8 +70,13 @@ class Device:
                     and key.ndim == 1 and key.shape[0] == 2
                     and key.dtype == jnp.uint32):
                 key = jax.random.wrap_key_data(key)
-        except Exception:
-            pass  # tracers/None/host values pass through untouched
+        except TypeError:
+            # tracers/abstract values: shape/dtype probing above can raise
+            # on them; they pass through untouched. Anything else (e.g. a
+            # malformed key array) propagates — silently threading a bad
+            # key would fragment the executable cache, the exact failure
+            # this normalization exists to prevent.
+            pass
         self._rng_key = key
 
     # ---- graph control (parity with core_device.i) ----------------------

@@ -280,12 +280,20 @@ def _pool_merge(pool_tok, pool_norm, pool_raw, cand_tok, cand_norm,
     return new_tok, top_norm, new_raw
 
 
-def _decode_core(m: "GPT", S0, max_new):
+def _decode_core(m: "GPT", S0, max_new, moe_capacity_factor=None):
     H = m.blocks[0].attn.num_heads
     T = S0 + max_new
     assert T <= m.max_seq, \
         f"prompt {S0} + new {max_new} exceeds max_seq {m.max_seq}"
-    moe_ks = [(b.moe.k, float(b.moe.capacity_factor))
+    # decode-time capacity override: capacity-limited routing is a
+    # batch-global effect, so cached decode == full forward only in the
+    # no-drop regime; a tight TRAINING capacity_factor shouldn't silently
+    # drop tokens at serving time — pass moe_capacity_factor (e.g.
+    # float(num_experts) for guaranteed no drops) to generate()/
+    # generate_beam() to decouple the two.
+    moe_ks = [(b.moe.k, float(moe_capacity_factor
+                              if moe_capacity_factor is not None
+                              else b.moe.capacity_factor))
               if b.moe_experts else None for b in m.blocks]
     return _DecodeCore(H, m.dim, S0, T, (m.dim // H) ** -0.5, moe_ks)
 
@@ -567,12 +575,12 @@ class GPT(_VocabTPMixin, model.Model):
         }
 
     def _build_decode(self, B, S0, max_new, temperature, top_k,
-                      dtype=None):
+                      dtype=None, moe_capacity_factor=None):
         import jax
         import jax.numpy as jnp
         from jax import lax
 
-        core = _decode_core(self, S0, max_new)
+        core = _decode_core(self, S0, max_new, moe_capacity_factor)
 
         def sample(logits, key):
             logits = logits.astype(jnp.float32)
@@ -609,14 +617,15 @@ class GPT(_VocabTPMixin, model.Model):
         return jax.jit(decode)
 
     def _build_beam_decode(self, B, S0, max_new, num_beams, length_penalty,
-                           eos_id, dtype, pad_id=None):
+                           eos_id, dtype, pad_id=None,
+                           moe_capacity_factor=None):
         import jax
         import jax.numpy as jnp
         from jax import lax
 
         V = self.vocab_size
         K = num_beams
-        core = _decode_core(self, S0, max_new)
+        core = _decode_core(self, S0, max_new, moe_capacity_factor)
         NEG = jnp.float32(-1e9)
         pad = 0 if eos_id is None else (pad_id if pad_id is not None
                                         else eos_id)
@@ -725,7 +734,8 @@ class GPT(_VocabTPMixin, model.Model):
 
     def generate_beam(self, prompt, max_new_tokens, num_beams=4,
                       length_penalty=1.0, eos_id=None, pad_id=None,
-                      dtype=None, return_scores=False):
+                      dtype=None, return_scores=False,
+                      moe_capacity_factor=None):
         """Beam-search decoding (no reference equivalent; its GPT-2
         example is greedy). One jitted function: prefill once, tile the
         KV cache across beams, and a `lax.scan` whose carry reorders
@@ -746,7 +756,8 @@ class GPT(_VocabTPMixin, model.Model):
             f"num_beams {num_beams} exceeds vocab_size {self.vocab_size}"
         B, S0 = ids.shape
         sig = ("beam", B, S0, max_new_tokens, num_beams,
-               float(length_penalty), eos_id, pad_id, dtype)
+               float(length_penalty), eos_id, pad_id, dtype,
+               moe_capacity_factor)
         cache = getattr(self, "_decode_cache", None)
         if cache is None:
             cache = self._decode_cache = {}
@@ -754,7 +765,7 @@ class GPT(_VocabTPMixin, model.Model):
         if fn is None:
             fn = cache[sig] = self._build_beam_decode(
                 B, S0, max_new_tokens, num_beams, float(length_penalty),
-                eos_id, dtype, pad_id)
+                eos_id, dtype, pad_id, moe_capacity_factor)
         out, scores = fn(self._decode_state(dtype), ids.astype(np.int32))
         out = np.asarray(jax.device_get(out))
         if return_scores:
@@ -762,7 +773,7 @@ class GPT(_VocabTPMixin, model.Model):
         return out
 
     def generate(self, prompt, max_new_tokens, temperature=0.0, top_k=None,
-                 seed=0, dtype=None):
+                 seed=0, dtype=None, moe_capacity_factor=None):
         """Autoregressive sampling: greedy (temperature=0) or
         temperature/top-k. `prompt` is (B, S0) int32 (numpy or Tensor);
         returns (B, S0+max_new_tokens) numpy. The decode function is
@@ -783,14 +794,16 @@ class GPT(_VocabTPMixin, model.Model):
         elif top_k is not None:
             top_k = max(1, min(int(top_k), self.vocab_size))
         B, S0 = ids.shape
-        sig = (B, S0, max_new_tokens, float(temperature), top_k, dtype)
+        sig = (B, S0, max_new_tokens, float(temperature), top_k, dtype,
+               moe_capacity_factor)
         cache = getattr(self, "_decode_cache", None)
         if cache is None:
             cache = self._decode_cache = {}
         fn = cache.get(sig)
         if fn is None:
             fn = cache[sig] = self._build_decode(
-                B, S0, max_new_tokens, float(temperature), top_k, dtype)
+                B, S0, max_new_tokens, float(temperature), top_k, dtype,
+                moe_capacity_factor)
         out = fn(self._decode_state(dtype), ids.astype(np.int32),
                  jax.random.PRNGKey(seed))
         return np.asarray(jax.device_get(out))
@@ -1054,7 +1067,14 @@ class _Pipeline1F1B(autograd.Operator):
     waits for its cotangent cannot start any backward early) — so this op
     consumes (h, targets, ln_f/head params, block stacks) and produces the
     loss directly; parallel/pipeline.one_f_one_b runs the fused scan and
-    hands back every cotangent, which backward() replays to the tape."""
+    hands back every cotangent, which backward() replays to the tape.
+
+    CONTRACT (backward): the second output (activations for the
+    caller-facing logits) is an OBSERVATION edge only — backward()
+    discards its cotangent `douts`. Any future change that puts a
+    differentiable term on the returned logits (e.g. an auxiliary loss
+    in train_one_batch) would silently train with ZERO gradient through
+    the pipeline blocks. Keep every loss term inside last_fn."""
 
     def __init__(self, num_heads, axis, n_micro, total_layers,
                  tp_axis=None, tied_vocab=None):
