@@ -34,6 +34,9 @@ def main():
     p.add_argument("--dim", type=int, default=1024)
     p.add_argument("--layers", type=int, default=8)
     p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA: kv heads < heads shrinks the KV cache — "
+                        "the binding term of the decode roofline")
     p.add_argument("--vocab", type=int, default=8192)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt", type=int, default=128)
@@ -58,7 +61,8 @@ def main():
     T = args.prompt + args.new
     m = models.create_model(
         "gpt", vocab_size=args.vocab, max_seq=T, dim=args.dim,
-        num_heads=args.heads, num_layers=args.layers)
+        num_heads=args.heads, num_layers=args.layers,
+        num_kv_heads=args.kv_heads)
     rng = np.random.RandomState(0)
     ids = tensor.from_numpy(
         rng.randint(0, args.vocab, (args.batch, args.prompt))
@@ -102,16 +106,18 @@ def main():
     # plus the K and V caches of every layer (the masked attention reads
     # the full preallocated T rows regardless of position).
     E, H, L, V = args.dim, args.heads, args.layers, args.vocab
+    Hkv = args.kv_heads or H
     bpe = {"float32": 4, "bfloat16": 2, "int8": 1}[args.dtype]
-    # per block: Wqkv (3 E^2) + Wo (E^2) + W1,W2 (2 * 4E^2) = 12 E^2
-    block_params = 12 * E * E
+    D = E // H
+    # per block: Wq+Wo (2 E^2) + Wk,Wv (2 E*Hkv*D) + W1,W2 (8 E^2)
+    block_params = 10 * E * E + 2 * E * Hkv * D
     head_params = E * V
     weight_bytes = (L * block_params + head_params) * bpe
-    D = E // H
     # KV cache follows the ACTIVATION dtype: bf16 under both "bfloat16"
-    # and "int8" (weight-only quantization), fp32 under "float32"
+    # and "int8" (weight-only quantization), fp32 under "float32";
+    # GQA holds Hkv heads, not H
     kv_bpe = 4 if args.dtype == "float32" else 2
-    kv_bytes = L * 2 * args.batch * H * T * D * kv_bpe  # K + V, T rows
+    kv_bytes = L * 2 * args.batch * Hkv * T * D * kv_bpe  # K+V, T rows
     per_step_bytes = weight_bytes + kv_bytes
     kind = getattr(dev.jax_device, "device_kind", "")
     peak_bw = _chip_peak_bw(kind)
@@ -136,6 +142,7 @@ def main():
     rec = {
         "metric": f"gpt_decode_tok_s_d{args.dim}_l{args.layers}"
                   f"_b{args.batch}_p{args.prompt}_n{args.new}_{args.dtype}"
+                  + (f"_kv{Hkv}" if Hkv != H else "")
                   + ("_cpu" if on_cpu else ""),
         "value": round(tok_s, 1),
         "unit": "tokens/s",

@@ -632,12 +632,22 @@ class MultiHeadAttention(Layer):
 
     `tp_axis` shards the heads Megatron-style: Wq/Wk/Wv column-parallel
     (each device computes num_heads/tp local heads, zero comm), Wo
-    row-parallel (one psum). Composes with `seq_axis` ring attention."""
+    row-parallel (one psum). Composes with `seq_axis` ring attention.
+
+    `num_kv_heads` (grouped-query attention, GQA; = num_heads is MHA,
+    = 1 is MQA): Wk/Wv project to num_kv_heads*D and each KV head
+    serves num_heads/num_kv_heads query heads. This shrinks the KV
+    params AND — the real point — the serving KV cache, which is the
+    binding term of the decode roofline (PROFILE.md)."""
 
     def __init__(self, num_heads, causal=False, seq_axis=None, tp_axis=None,
-                 bias=False, name=None):
+                 bias=False, num_kv_heads=None, name=None):
         super().__init__(name)
         self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        assert num_heads % self.num_kv_heads == 0, \
+            f"num_heads {num_heads} not divisible by " \
+            f"num_kv_heads {self.num_kv_heads}"
         self.causal = causal
         self.seq_axis = seq_axis
         self.tp_axis = tp_axis
@@ -646,6 +656,8 @@ class MultiHeadAttention(Layer):
     def initialize(self, x):
         e = x.shape[-1]
         assert e % self.num_heads == 0
+        d = e // self.num_heads
+        kv_e = self.num_kv_heads * d
         spec_col = spec_row = spec_colb = None
         if self.tp_axis is not None:
             from jax.sharding import PartitionSpec as P
@@ -653,12 +665,13 @@ class MultiHeadAttention(Layer):
             spec_row = P(self.tp_axis, None)
             spec_colb = P(self.tp_axis)
         for attr in ("Wq", "Wk", "Wv", "Wo"):
-            W = Tensor((e, e), device=x.device, dtype=x.dtype)
+            out_e = kv_e if attr in ("Wk", "Wv") else e
+            W = Tensor((e, out_e), device=x.device, dtype=x.dtype)
             initializer.glorot_uniform(W)
             W.spec = spec_row if attr == "Wo" else spec_col
             self._register_param(attr, W)
             if self.use_bias:
-                b = Tensor((e,), device=x.device, dtype=x.dtype)
+                b = Tensor((out_e,), device=x.device, dtype=x.dtype)
                 b.set_value(0.0)
                 # q/k/v biases shard with the heads (column); the output
                 # bias is added after the row-parallel psum: replicated
@@ -692,9 +705,20 @@ class MultiHeadAttention(Layer):
         bq = bk = bv = bo = None
         if self.use_bias:
             bq, bk, bv, bo = self.bq, self.bk, self.bv, self.bo
+        kv_heads = self.num_kv_heads
+        grp = self.num_heads // self.num_kv_heads
+        if tp:
+            assert kv_heads % tp_size == 0, \
+                f"{kv_heads} kv heads not divisible by tp={tp_size}"
+            kv_heads //= tp_size
         q = self._split(proj(Wq, bq), B, S, heads)
-        k = self._split(proj(Wk, bk), B, S, heads)
-        v = self._split(proj(Wv, bv), B, S, heads)
+        k = self._split(proj(Wk, bk), B, S, kv_heads)
+        v = self._split(proj(Wv, bv), B, S, kv_heads)
+        if grp > 1:
+            # GQA: each kv head serves `grp` consecutive query heads
+            # (repeat on the head axis; XLA folds the broadcast)
+            k = autograd.UpSample([1, grp, 1, 1])(k)
+            v = autograd.UpSample([1, grp, 1, 1])(v)
         o = autograd.attention(q, k, v, causal=self.causal,
                                seq_axis=self.seq_axis)
         o = autograd.transpose(o, (0, 2, 1, 3))
@@ -716,12 +740,14 @@ class TransformerBlock(Layer):
 
     def __init__(self, num_heads, mlp_ratio=4, causal=True, seq_axis=None,
                  tp_axis=None, attn_bias=False, moe_experts=0, moe_k=1,
-                 ep_axis=None, moe_capacity_factor=1.25, name=None):
+                 ep_axis=None, moe_capacity_factor=1.25, num_kv_heads=None,
+                 name=None):
         super().__init__(name)
         self.ln1 = LayerNorm()
         self.attn = MultiHeadAttention(num_heads, causal=causal,
                                        seq_axis=seq_axis, tp_axis=tp_axis,
-                                       bias=attn_bias)
+                                       bias=attn_bias,
+                                       num_kv_heads=num_kv_heads)
         self.ln2 = LayerNorm()
         self.mlp_ratio = mlp_ratio
         self.tp_axis = tp_axis

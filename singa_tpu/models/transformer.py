@@ -117,14 +117,20 @@ class _DecodeCore:
       symmetric, _quant8) halves the dominant weight traffic again.
     """
 
-    def __init__(self, H, E, S0, T, scale, moe_ks=None):
+    def __init__(self, H, E, S0, T, scale, moe_ks=None, kv_heads=None):
         self.H, self.E, self.S0, self.T, self.scale = H, E, S0, T, scale
         # static per-layer MoE routing degree (None = dense MLP); must be
         # static (int() under jit) so it lives here, not in the param tree
         self.moe_ks = moe_ks or []
+        # GQA: Hkv kv heads each serve G = H/Hkv query heads; the caches
+        # hold Hkv heads (the serving win — KV traffic shrinks G x) and
+        # the packed block-diagonal contraction places G query rows per
+        # kv-head block instead of 1
+        self.Hkv = kv_heads or H
+        self.G = H // self.Hkv
         D = E // H
         P = max(1, 128 // D)
-        self.P = P if (P > 1 and H % P == 0) else 1
+        self.P = P if (P > 1 and self.Hkv % P == 0) else 1
 
     def cast(self, p, dtype):
         return _cast_params(p, dtype)
@@ -166,25 +172,27 @@ class _DecodeCore:
                    bp["W2"]) + bp["bb2"]
 
     def qkv(self, bp, x, n, S=None):
-        """Fused QKV projection: one (E, 3E) matmul, split into per-head
-        q/k/v — (n,[S,]H,D) each."""
+        """Fused QKV projection: one (E, E + 2*Hkv*D) matmul, split into
+        q (n,[S,]H,D) and k/v (n,[S,]Hkv,D)."""
         import jax.numpy as jnp
-        H, D, E = self.H, self.E // self.H, self.E
+        H, D, E, Hkv = self.H, self.E // self.H, self.E, self.Hkv
+        KE = Hkv * D
         fused = _mm(x, bp["Wqkv"]) + bp["bqkv"]
+        bounds = ((0, E, H), (E, E + KE, Hkv), (E + KE, E + 2 * KE, Hkv))
         if S is None:
-            q, k, v = (fused[..., j * E:(j + 1) * E].reshape(n, H, D)
-                       for j in range(3))
+            q, k, v = (fused[..., a:b].reshape(n, h, D)
+                       for a, b, h in bounds)
         else:
-            q, k, v = (fused[..., j * E:(j + 1) * E]
-                       .reshape(n, S, H, D).swapaxes(1, 2)
-                       for j in range(3))
+            q, k, v = (fused[..., a:b].reshape(n, S, h, D).swapaxes(1, 2)
+                       for a, b, h in bounds)
         return q, k, v
 
     def _pack(self, kv, n, S):
-        """(n,H,S,D) per-head K/V -> head-packed (n, H/P, S, P*D)."""
-        H, D, P = self.H, self.E // self.H, self.P
-        return kv.reshape(n, H // P, P, S, D).swapaxes(2, 3) \
-            .reshape(n, H // P, S, P * D)
+        """(n,Hkv,S,D) per-kv-head K/V -> head-packed
+        (n, Hkv/P, S, P*D)."""
+        D, P, Hkv = self.E // self.H, self.P, self.Hkv
+        return kv.reshape(n, Hkv // P, P, S, D).swapaxes(2, 3) \
+            .reshape(n, Hkv // P, S, P * D)
 
     def prefill(self, p, prompt, n):
         """Causal pass over the (n, S0) prompt; returns the last-position
@@ -198,19 +206,22 @@ class _DecodeCore:
 
         caches = []
         cmask = jnp.tril(jnp.ones((S0, S0), bool))
+        Hkv, G = self.Hkv, self.G
         for li, bp in enumerate(p["blocks"]):
             x = ln(h, bp["g1"], bp["b1"])
-            q, k, v = self.qkv(bp, x, n, S0)             # (n,H,S0,D)
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * self.scale
+            q, k, v = self.qkv(bp, x, n, S0)    # q (n,H,·); kv (n,Hkv,·)
+            kr = jnp.repeat(k, G, axis=1) if G > 1 else k
+            vr = jnp.repeat(v, G, axis=1) if G > 1 else v
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, kr) * self.scale
             a = jax.nn.softmax(jnp.where(cmask, s, -jnp.inf), axis=-1)
-            o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
+            o = jnp.einsum("bhqk,bhkd->bhqd", a, vr)
             h = h + _mm(o.swapaxes(1, 2).reshape(n, S0, self.E),
                         bp["Wo"]) + bp["bo"]
             x = ln(h, bp["g2"], bp["b2"])
             h = h + self.mlp(bp, x, li)
-            Kc = jnp.zeros((n, H // P, T, P * D), k.dtype) \
+            Kc = jnp.zeros((n, Hkv // P, T, P * D), k.dtype) \
                 .at[:, :, :S0].set(self._pack(k, n, S0))
-            Vc = jnp.zeros((n, H // P, T, P * D), v.dtype) \
+            Vc = jnp.zeros((n, Hkv // P, T, P * D), v.dtype) \
                 .at[:, :, :S0].set(self._pack(v, n, S0))
             caches.append((Kc, Vc))
         logits0 = _mm(ln(h[:, -1], p["gf"], p["bf"]), p["head"])
@@ -224,7 +235,8 @@ class _DecodeCore:
         import jax.numpy as jnp
         from jax import lax
         H, D, E, P = self.H, self.E // self.H, self.E, self.P
-        Hp = H // P
+        Hkv, G = self.Hkv, self.G
+        Hp = Hkv // P
         ln = self.ln
         pos_idx = self.S0 + i
         h = p["emb"][tok] + p["pos"][pos_idx]
@@ -233,22 +245,27 @@ class _DecodeCore:
         new_caches = []
         for li, ((Kc, Vc), bp) in enumerate(zip(caches, p["blocks"])):
             x = ln(h, bp["g1"], bp["b1"])
-            q, kn, vn = self.qkv(bp, x, n)               # (n,H,D)
+            q, kn, vn = self.qkv(bp, x, n)   # q (n,H,D); kv (n,Hkv,D)
             # packed caches: one contiguous (P*D)-lane row per token
             Kc = lax.dynamic_update_slice(
                 Kc, kn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
             Vc = lax.dynamic_update_slice(
                 Vc, vn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
-            # block-diagonal queries: Q2[:, :, c] is head c's q in block
-            # c, zeros elsewhere — the full-width contraction with the
-            # packed K then yields exactly the per-head scores
-            q4 = q.reshape(n, Hp, P, D)
-            Q2 = jnp.zeros((n, Hp, P, P, D), q.dtype) \
-                .at[:, :, ar, ar, :].set(q4).reshape(n, Hp, P, P * D)
-            s = jnp.einsum("nhpj,nhtj->nhpt", Q2, Kc) * self.scale
+            # block-diagonal queries: packed slot c holds kv head
+            # (hp*P + c)'s G query rows in block c, zeros elsewhere —
+            # the full-width contraction with the packed K then yields
+            # exactly the per-head scores (GQA: G rows per block; MHA is
+            # the G=1 case)
+            q6 = jnp.moveaxis(q.reshape(n, Hp, P, G, D), 2, 0)
+            Q2 = jnp.zeros((n, Hp, P, G, P, D), q.dtype) \
+                .at[:, :, ar, :, ar, :].set(q6) \
+                .reshape(n, Hp, P * G, P * D)
+            s = jnp.einsum("nhqj,nhtj->nhqt", Q2, Kc) * self.scale
             a = jax.nn.softmax(jnp.where(kmask, s, -jnp.inf), axis=-1)
-            O2 = jnp.einsum("nhpt,nhtj->nhpj", a, Vc)    # (n,Hp,P,P*D)
-            o = O2.reshape(n, Hp, P, P, D)[:, :, ar, ar, :].reshape(n, E)
+            O2 = jnp.einsum("nhqt,nhtj->nhqj", a, Vc)   # (n,Hp,P*G,P*D)
+            o = jnp.moveaxis(
+                O2.reshape(n, Hp, P, G, P, D)[:, :, ar, :, ar, :],
+                0, 2).reshape(n, E)
             h = h + _mm(o, bp["Wo"]) + bp["bo"]
             x = ln(h, bp["g2"], bp["b2"])
             h = h + self.mlp(bp, x, li)
@@ -282,6 +299,7 @@ def _pool_merge(pool_tok, pool_norm, pool_raw, cand_tok, cand_norm,
 
 def _decode_core(m: "GPT", S0, max_new, moe_capacity_factor=None):
     H = m.blocks[0].attn.num_heads
+    kv = m.blocks[0].attn.num_kv_heads
     T = S0 + max_new
     assert T <= m.max_seq, \
         f"prompt {S0} + new {max_new} exceeds max_seq {m.max_seq}"
@@ -295,7 +313,8 @@ def _decode_core(m: "GPT", S0, max_new, moe_capacity_factor=None):
                               if moe_capacity_factor is not None
                               else b.moe.capacity_factor))
               if b.moe_experts else None for b in m.blocks]
-    return _DecodeCore(H, m.dim, S0, T, (m.dim // H) ** -0.5, moe_ks)
+    return _DecodeCore(H, m.dim, S0, T, (m.dim // H) ** -0.5, moe_ks,
+                       kv_heads=kv)
 
 
 class _VocabTPMixin:
@@ -350,7 +369,7 @@ class GPT(_VocabTPMixin, model.Model):
                  vocab_tp_return_logits=True,
                  moe_experts=0, moe_k=2, ep_axis=None,
                  moe_capacity_factor=1.25, moe_aux_weight=0.01,
-                 moe_z_weight=1e-3, name=None):
+                 moe_z_weight=1e-3, num_kv_heads=None, name=None):
         super().__init__(name)
         self.vocab_size = vocab_size
         self.max_seq = max_seq
@@ -400,7 +419,8 @@ class GPT(_VocabTPMixin, model.Model):
             num_heads, mlp_ratio, causal=True, seq_axis=seq_axis,
             tp_axis=tp_axis, attn_bias=attn_bias, moe_experts=moe_experts,
             moe_k=moe_k, ep_axis=ep_axis,
-            moe_capacity_factor=moe_capacity_factor)
+            moe_capacity_factor=moe_capacity_factor,
+            num_kv_heads=num_kv_heads)
                   for _ in range(num_layers)]
         self.blocks = blocks
         self.register_layers(*blocks)
@@ -542,7 +562,9 @@ class GPT(_VocabTPMixin, model.Model):
                     axis=1),
                 "bqkv": jnp.concatenate(
                     [b.attn.bq.data, b.attn.bk.data, b.attn.bv.data])
-                if ab else jnp.zeros((3 * self.dim,), zeros.dtype),
+                if ab else jnp.zeros(
+                    (b.attn.Wq.shape[1] + b.attn.Wk.shape[1]
+                     + b.attn.Wv.shape[1],), zeros.dtype),
                 "Wo": b.attn.Wo.data,
                 "bo": b.attn.bo.data if ab else zeros,
                 "g2": b.ln2.gamma.data, "b2": b.ln2.beta.data,

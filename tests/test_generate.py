@@ -132,6 +132,61 @@ def test_int8_decode():
                            dtype="int8").shape == (2, 10)
 
 
+def _seeded_gqa(dim, num_heads, num_kv_heads, vocab=97, max_seq=64,
+                layers=2, seed=11):
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=vocab, max_seq=max_seq,
+                            dim=dim, num_heads=num_heads,
+                            num_layers=layers,
+                            num_kv_heads=num_kv_heads)
+    ids = tensor.from_numpy(
+        np.random.RandomState(0).randint(0, vocab, (2, 8))
+        .astype(np.int32), device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    rng = np.random.RandomState(seed)
+    m.set_params({n: (rng.standard_normal(tuple(t.shape)) * 0.05)
+                  .astype(np.float32) for n, t in m.get_params().items()})
+    return m, dev
+
+
+def test_gqa_greedy_matches_full_forward():
+    """GQA (num_kv_heads < num_heads): the decode core's grouped packed
+    attention (G query rows per kv-head block) must match the layer-path
+    full forward (which repeats kv heads before flash) exactly — two
+    independent implementations of the same math. dim=256/H=8/kv=4 ->
+    D=32, P=4, G=2: the packed GQA path, really."""
+    m, dev = _seeded_gqa(dim=256, num_heads=8, num_kv_heads=4)
+    from singa_tpu.models.transformer import _decode_core
+    core = _decode_core(m, 8, 4)
+    assert (core.P, core.G, core.Hkv) == (4, 2, 4)
+    # kv projections really are half-width (the param saving)
+    assert tuple(m.blocks[0].attn.Wk.shape) == (256, 128)
+    prompt = np.random.RandomState(6).randint(0, 97, (2, 8))
+    want = _naive_greedy(m, dev, prompt, 6)
+    got = m.generate(prompt, 6, temperature=0.0)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(
+        m.generate_beam(prompt, 4, num_beams=1),
+        m.generate(prompt, 4, temperature=0.0))
+    # int8/bf16 serving paths run on the GQA cache layout too
+    assert m.generate(prompt, 4, dtype="int8").shape == (2, 12)
+    assert m.generate(prompt, 4, dtype="bfloat16").shape == (2, 12)
+
+
+def test_gqa_unpacked_fallback_matches():
+    """Hkv=2 with P=4 -> packing falls back to P=1 (kv heads not
+    divisible); numerics must still match the full forward."""
+    m, dev = _seeded_gqa(dim=256, num_heads=8, num_kv_heads=2, seed=12)
+    from singa_tpu.models.transformer import _decode_core
+    core = _decode_core(m, 8, 4)
+    assert (core.P, core.G) == (1, 4)
+    prompt = np.random.RandomState(7).randint(0, 97, (2, 8))
+    np.testing.assert_array_equal(
+        m.generate(prompt, 6, temperature=0.0),
+        _naive_greedy(m, dev, prompt, 6))
+
+
 def test_decode_param_memo_invalidates_on_weight_load():
     """_decode_state memoizes the fused/quantized decode tree; loading
     new weights must invalidate it (the memo keys on buffer identity)."""
