@@ -846,12 +846,14 @@ def _fn_layernorm(x, g, b, eps=1e-5):
     return (x - m) * lax.rsqrt(v + eps) * g + b
 
 
-def _fn_block(params, h, num_heads, tp_axis=None):
+def _fn_block(params, h, num_heads, tp_axis=None, num_kv_heads=None):
     """Functional pre-LN transformer block; h (B, S, E) replicated over
     `tp_axis`. With tp: Wq/Wk/Wv/W1 arrive column-sharded (local heads =
     num_heads/tp), Wo/W2 row-sharded — the Megatron layout, two psums per
     block, expressed with custom_vjp f/g so the block stays correct under
-    both autodiff-through-scan (GPipe) and explicit vjp (1F1B engine)."""
+    both autodiff-through-scan (GPipe) and explicit vjp (1F1B engine).
+    `num_kv_heads` < num_heads is GQA: Wk/Wv are (E, Hkv*D) and each kv
+    head serves num_heads/Hkv query heads (repeat before flash)."""
     import jax
     import jax.numpy as jnp
     from ..ops.attention import flash_attention
@@ -859,14 +861,21 @@ def _fn_block(params, h, num_heads, tp_axis=None):
     (g1, b1, Wq, Wk, Wv, Wo, g2, b2, W1, bb1, W2, bb2) = params
     B, S, E = h.shape
     heads = num_heads
+    kv_heads = num_kv_heads or num_heads
+    grp = heads // kv_heads
     if tp_axis is not None:
-        heads = num_heads // jax.lax.axis_size(tp_axis)
+        tp_n = jax.lax.axis_size(tp_axis)
+        heads = num_heads // tp_n
+        kv_heads = kv_heads // tp_n
     x = _fn_layernorm(h, g1, b1)
     if tp_axis is not None:
         x = megatron_f(x, tp_axis)
     q = (x @ Wq).reshape(B, S, heads, -1).transpose(0, 2, 1, 3)
-    k = (x @ Wk).reshape(B, S, heads, -1).transpose(0, 2, 1, 3)
-    v = (x @ Wv).reshape(B, S, heads, -1).transpose(0, 2, 1, 3)
+    k = (x @ Wk).reshape(B, S, kv_heads, -1).transpose(0, 2, 1, 3)
+    v = (x @ Wv).reshape(B, S, kv_heads, -1).transpose(0, 2, 1, 3)
+    if grp > 1:
+        k = jnp.repeat(k, grp, axis=1)
+        v = jnp.repeat(v, grp, axis=1)
     o = flash_attention(q, k, v, True)
     o = o.transpose(0, 2, 1, 3).reshape(B, S, -1)
     o = o @ Wo
@@ -954,7 +963,8 @@ def _make_stage_fn_moe(num_heads, axis, total_layers, k, capacity_factor,
     return stage_fn
 
 
-def _make_chunk_fn(num_heads, axis, total_layers, pc, tp_axis=None):
+def _make_chunk_fn(num_heads, axis, total_layers, pc, tp_axis=None,
+                   num_kv_heads=None):
     """Chunk-aware stage application for the interleaved schedule: this
     device's local stack rows [c*pc, (c+1)*pc) are virtual chunk `c`
     (global pipeline stage c*n + d), so global layer (c*n+d)*pc + j
@@ -975,14 +985,15 @@ def _make_chunk_fn(num_heads, axis, total_layers, pc, tp_axis=None):
                                                keepdims=False)[j]
                       for st in local_stacks]
             on = ((c * n + d) * pc + j) < total_layers
-            y = _fn_block(params, x, num_heads, tp_axis)
+            y = _fn_block(params, x, num_heads, tp_axis, num_kv_heads)
             x = jnp.where(on, y, x)
         return x
 
     return chunk_fn
 
 
-def _make_stage_fn(num_heads, axis, total_layers, tp_axis=None):
+def _make_stage_fn(num_heads, axis, total_layers, tp_axis=None,
+                   num_kv_heads=None):
     """Per-stage block application with non-uniform stage support: local
     stacks carry padded_layers/n rows; rows whose GLOBAL index (stage*per +
     li) >= total_layers are padding (zero-init, never trained) and are
@@ -998,7 +1009,7 @@ def _make_stage_fn(num_heads, axis, total_layers, tp_axis=None):
         for li in range(per):
             on = (s * per + li) < total_layers
             y = _fn_block([st[li] for st in local_stacks], x, num_heads,
-                          tp_axis)
+                          tp_axis, num_kv_heads)
             x = jnp.where(on, y, x)
         return x
 
@@ -1011,9 +1022,11 @@ class _PipelineBlocks(autograd.Operator):
     serial layer loop outside a mesh."""
 
     def __init__(self, num_heads, axis=None, n_micro=1, total_layers=None,
-                 tp_axis=None, interleave=1, pc=None, moe=None):
+                 tp_axis=None, interleave=1, pc=None, moe=None,
+                 num_kv_heads=None):
         super().__init__("PipelineBlocks")
         self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads
         self.axis = axis
         self.n_micro = n_micro
         self.total_layers = total_layers
@@ -1052,11 +1065,13 @@ class _PipelineBlocks(autograd.Operator):
                 return (outs.reshape(B, *h.shape[1:]),
                         auxv[0], auxv[1])
             if self.interleave > 1:
-                chunk_fn = _make_chunk_fn(nh, self.axis, L, self.pc, tp)
+                chunk_fn = _make_chunk_fn(nh, self.axis, L, self.pc, tp,
+                                          self.num_kv_heads)
                 outs = gpipe_interleaved(chunk_fn, list(stacks), x_micro,
                                          self.axis, self.interleave)
             else:
-                stage_fn = _make_stage_fn(nh, self.axis, L, tp)
+                stage_fn = _make_stage_fn(nh, self.axis, L, tp,
+                                          self.num_kv_heads)
                 outs = gpipe(stage_fn, list(stacks), x_micro, self.axis)
             outs = bcast_from_last(self.axis, outs)
             return outs.reshape(B, *h.shape[1:])
@@ -1077,7 +1092,8 @@ class _PipelineBlocks(autograd.Operator):
                 z_t = z_t + z.astype(jnp.float32)
             return h, aux_t, z_t
         for g in range(L):
-            h = _fn_block([s[g] for s in stacks], h, nh)
+            h = _fn_block([s[g] for s in stacks], h, nh,
+                          num_kv_heads=self.num_kv_heads)
         return h
 
 
@@ -1099,9 +1115,10 @@ class _Pipeline1F1B(autograd.Operator):
     the pipeline blocks. Keep every loss term inside last_fn."""
 
     def __init__(self, num_heads, axis, n_micro, total_layers,
-                 tp_axis=None, tied_vocab=None):
+                 tp_axis=None, tied_vocab=None, num_kv_heads=None):
         super().__init__("Pipeline1F1B")
         self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads
         self.axis = axis
         self.n_micro = n_micro
         self.total_layers = total_layers
@@ -1126,7 +1143,8 @@ class _Pipeline1F1B(autograd.Operator):
         x_micro = h.reshape(nm, B // nm, S, E)
         tgt_micro = tgt.reshape(nm, B // nm, S)
         stage_fn = _make_stage_fn(self.num_heads, self.axis,
-                                  self.total_layers, tp)
+                                  self.total_layers, tp,
+                                  self.num_kv_heads)
         tied = self.tied_vocab is not None
 
         def last_fn(lp, y, t):
@@ -1204,12 +1222,16 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
                  vocab_pad_multiple=128, vocab_tp_return_logits=True,
                  interleave=1, moe_experts=0, moe_k=2, ep_axis=None,
                  moe_capacity_factor=1.25, moe_aux_weight=0.01,
-                 moe_z_weight=1e-3, name=None):
+                 moe_z_weight=1e-3, num_kv_heads=None, name=None):
         super().__init__(name)
         self.vocab_size = vocab_size
         self.max_seq = max_seq
         self.dim = dim
         self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        assert num_heads % self.num_kv_heads == 0, \
+            f"num_heads {num_heads} not divisible by " \
+            f"num_kv_heads {self.num_kv_heads}"
         self.num_layers = num_layers
         self.mlp_ratio = mlp_ratio
         self.tp_axis = tp_axis
@@ -1240,6 +1262,11 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
                 raise ValueError(
                     "PipelinedGPT moe_experts composes with the plain "
                     "gpipe schedule only (no interleave)")
+            if num_kv_heads is not None and num_kv_heads != num_heads:
+                raise ValueError(
+                    "PipelinedGPT moe_experts does not compose with "
+                    "num_kv_heads yet (the MoE stage fn's attention is "
+                    "MHA); use GQA with the dense-MLP pipelined model")
         if vocab_tp and tp_axis is None:
             raise ValueError(
                 "vocab_tp=True needs tp_axis (see GPT.__init__)")
@@ -1299,7 +1326,8 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
         return _PipelineBlocks(
             self.num_heads, self.pipeline_axis, self.n_micro,
             self.num_layers, self.tp_axis, interleave=self.interleave,
-            pc=getattr(self, "_chunk_layers", None), moe=moe)
+            pc=getattr(self, "_chunk_layers", None), moe=moe,
+            num_kv_heads=self.num_kv_heads)
 
     def _init_stacks(self, dev):
         import numpy as np
@@ -1359,9 +1387,13 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
                 t.spec = spec
             self._register_param(attr, t)
 
+        kv_e = E // self.num_heads * self.num_kv_heads
+        if tp_n > 1:
+            assert self.num_kv_heads % tp_n == 0, \
+                f"kv heads {self.num_kv_heads} must divide tp={tp_n}"
         mk("g1", (E,)), mk("b1", (E,))
         for a in ("Wq", "Wk", "Wv", "Wo"):
-            mk(a, (E, E), scale=E ** -0.5)
+            mk(a, (E, kv_e if a in ("Wk", "Wv") else E), scale=E ** -0.5)
         mk("g2", (E,)), mk("b2", (E,))
         if self.moe_experts:
             # expert stacks stay REPLICATED over ep (layer._MoEOp
@@ -1470,7 +1502,8 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
             op = _Pipeline1F1B(
                 self.num_heads, self.pipeline_axis, self.n_micro,
                 self.num_layers, self.tp_axis,
-                tied_vocab=self.vocab_size if self.vocab_tp else None)
+                tied_vocab=self.vocab_size if self.vocab_tp else None,
+                num_kv_heads=self.num_kv_heads)
             loss, outs = op(h, targets, self.ln_f.gamma, self.ln_f.beta,
                             headW,
                             *[getattr(self, a) for a in self._stack_attrs])
