@@ -229,3 +229,46 @@ def test_ring_dispatch_falls_back_when_bwd_blocks_dont_fit():
     ref = att.attention_reference(q, q, q, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_gqa_ring_attention_matches_serial(dev):
+    """GQA composes with ring attention: kv heads repeat per group BEFORE
+    the ring, so the rotating K/V shards carry full head counts and the
+    sequence-sharded forward matches the serial one."""
+    from jax.sharding import PartitionSpec as P, NamedSharding
+    from singa_tpu import models, tensor
+    from singa_tpu.parallel import make_mesh
+    from singa_tpu import autograd
+    import jax as _jax
+
+    mesh = make_mesh({"sp": 4})
+    rng = np.random.RandomState(3)
+    B, S, V = 2, 32, 50
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+
+    m = models.create_model("gpt", vocab_size=V, max_seq=S, dim=32,
+                            num_heads=4, num_kv_heads=2, num_layers=1,
+                            seq_axis="sp")
+    tx = tensor.from_numpy(ids, device=dev)
+    m.compile([tx], is_train=False, use_graph=False)
+    m.eval()
+    want = m.forward(tx).numpy()      # serial (sp axis unbound)
+    params = list(m.get_params().values())
+    p_arrs = [p.data for p in params]
+
+    def fwd(p_arrs, ids_a):
+        for p, a in zip(params, p_arrs):
+            p.data = a
+        t = tensor.Tensor(data=ids_a, device=dev, requires_grad=False)
+        return m.forward(t).data
+
+    run = _jax.shard_map(fwd, mesh=mesh,
+                         in_specs=(P(), P(None, "sp")),
+                         out_specs=P(None, "sp"), check_vma=False)
+    rep = NamedSharding(mesh, P())
+    got = _jax.jit(run)(
+        [_jax.device_put(a, rep) for a in p_arrs],
+        _jax.device_put(jnp.asarray(ids), NamedSharding(mesh,
+                                                        P(None, "sp"))))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3,
+                               atol=2e-3)

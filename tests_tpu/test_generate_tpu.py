@@ -34,3 +34,26 @@ def test_generate_on_chip():
         m.generate_beam(prompt, 12, num_beams=1),
         m.generate(prompt, 12, temperature=0.0))
     assert m.generate_beam(prompt, 12, num_beams=4).shape == (2, 28)
+
+
+def test_gqa_generate_on_chip():
+    """GQA decode (grouped packed caches, int8 and bf16) on the real
+    chip: deterministic greedy, beam-1 == greedy."""
+    from singa_tpu import device, models, tensor
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=512, max_seq=128, dim=256,
+                            num_heads=8, num_kv_heads=4, num_layers=2)
+    ids = tensor.from_numpy(
+        np.random.RandomState(0).randint(0, 512, (2, 16)).astype(np.int32),
+        device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    prompt = np.random.RandomState(1).randint(0, 512, (2, 16))
+    for dtype in ("bfloat16", "int8"):
+        out = m.generate(prompt, 24, temperature=0.0, dtype=dtype)
+        assert out.shape == (2, 40)
+        np.testing.assert_array_equal(
+            out, m.generate(prompt, 24, temperature=0.0, dtype=dtype))
+    np.testing.assert_array_equal(
+        m.generate_beam(prompt, 12, num_beams=1),
+        m.generate(prompt, 12, temperature=0.0))
