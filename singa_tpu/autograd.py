@@ -1519,6 +1519,52 @@ def attention(q, k, v, causal=False, seq_axis=None):
     return _FlashAttention(causal)(q, k, v)
 
 
+def rope_tables(positions, dim, theta=10000.0):
+    """(cos, sin) tables for NeoX-style rotary embeddings: positions (S,)
+    -> (S, dim) with the two half-blocks duplicated (cos = [c | c])."""
+    inv = theta ** (-jnp.arange(0, dim // 2, dtype=jnp.float32)
+                    / (dim // 2))
+    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]  # (S,D/2)
+    cos = jnp.concatenate([jnp.cos(ang), jnp.cos(ang)], axis=-1)
+    sin = jnp.concatenate([jnp.sin(ang), jnp.sin(ang)], axis=-1)
+    return cos, sin
+
+
+def apply_rope(x, cos, sin):
+    """Rotate (.., S, D) by per-position tables (S, D) — NeoX halves:
+    out = x*cos + rotate_half(x)*sin, rotate_half = [-x2 | x1]."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return (x.astype(jnp.float32) * cos + rot.astype(jnp.float32) * sin) \
+        .astype(x.dtype)
+
+
+class Rope(Operator):
+    """Rotary position embedding on (B, H, S, D) q/k (RoFormer/NeoX
+    convention; no reference counterpart — SINGA has no transformer).
+    `seq_axis` offsets positions by axis_index * S_local under sequence
+    parallelism, the same pattern as _PosSlice for the learned table."""
+
+    def __init__(self, theta=10000.0, seq_axis=None):
+        super().__init__("Rope")
+        self.theta = float(theta)
+        self.seq_axis = seq_axis
+
+    def forward(self, x):
+        from jax import lax
+        S = x.shape[-2]
+        off = 0
+        if self.seq_axis is not None:
+            try:
+                off = lax.axis_index(self.seq_axis) * S
+            except NameError:
+                off = 0
+        pos = jnp.arange(S) + off
+        cos, sin = rope_tables(pos, x.shape[-1], self.theta)
+        return apply_rope(x, cos, sin)
+
+
 # ======================= extended ONNX op set ==============================
 # Ops beyond the reference's _rename_operators table (sonnx.py:1046-1133),
 # needed to import real-world exported models (torch/tf2onnx graphs use

@@ -641,9 +641,12 @@ class MultiHeadAttention(Layer):
     binding term of the decode roofline (PROFILE.md)."""
 
     def __init__(self, num_heads, causal=False, seq_axis=None, tp_axis=None,
-                 bias=False, num_kv_heads=None, name=None):
+                 bias=False, num_kv_heads=None, rope=False,
+                 rope_theta=10000.0, name=None):
         super().__init__(name)
         self.num_heads = num_heads
+        self.rope = bool(rope)          # rotary q/k (RoFormer/NeoX)
+        self.rope_theta = rope_theta
         self.num_kv_heads = num_kv_heads or num_heads
         assert num_heads % self.num_kv_heads == 0, \
             f"num_heads {num_heads} not divisible by " \
@@ -714,6 +717,12 @@ class MultiHeadAttention(Layer):
         q = self._split(proj(Wq, bq), B, S, heads)
         k = self._split(proj(Wk, bk), B, S, kv_heads)
         v = self._split(proj(Wv, bv), B, S, kv_heads)
+        if self.rope:
+            # rotate q/k before the kv-head repeat (rotation is per-head
+            # identical, so rotating the Hkv heads is cheaper)
+            rop = autograd.Rope(self.rope_theta, self.seq_axis)
+            q, k = rop(q), autograd.Rope(self.rope_theta,
+                                         self.seq_axis)(k)
         if grp > 1:
             # GQA: each kv head serves `grp` consecutive query heads
             # (repeat on the head axis; XLA folds the broadcast)
@@ -741,13 +750,14 @@ class TransformerBlock(Layer):
     def __init__(self, num_heads, mlp_ratio=4, causal=True, seq_axis=None,
                  tp_axis=None, attn_bias=False, moe_experts=0, moe_k=1,
                  ep_axis=None, moe_capacity_factor=1.25, num_kv_heads=None,
-                 name=None):
+                 rope=False, rope_theta=10000.0, name=None):
         super().__init__(name)
         self.ln1 = LayerNorm()
         self.attn = MultiHeadAttention(num_heads, causal=causal,
                                        seq_axis=seq_axis, tp_axis=tp_axis,
                                        bias=attn_bias,
-                                       num_kv_heads=num_kv_heads)
+                                       num_kv_heads=num_kv_heads,
+                                       rope=rope, rope_theta=rope_theta)
         self.ln2 = LayerNorm()
         self.mlp_ratio = mlp_ratio
         self.tp_axis = tp_axis

@@ -117,8 +117,11 @@ class _DecodeCore:
       symmetric, _quant8) halves the dominant weight traffic again.
     """
 
-    def __init__(self, H, E, S0, T, scale, moe_ks=None, kv_heads=None):
+    def __init__(self, H, E, S0, T, scale, moe_ks=None, kv_heads=None,
+                 rope=False, rope_theta=10000.0):
         self.H, self.E, self.S0, self.T, self.scale = H, E, S0, T, scale
+        self.rope = bool(rope)
+        self.rope_theta = float(rope_theta)
         # static per-layer MoE routing degree (None = dense MLP); must be
         # static (int() under jit) so it lives here, not in the param tree
         self.moe_ks = moe_ks or []
@@ -202,14 +205,22 @@ class _DecodeCore:
         import jax.numpy as jnp
         H, D, S0, T, P = self.H, self.E // self.H, self.S0, self.T, self.P
         ln = self.ln
-        h = p["emb"][prompt] + p["pos"][:S0]
+        h = p["emb"][prompt] + (0 if self.rope else p["pos"][:S0])
 
         caches = []
         cmask = jnp.tril(jnp.ones((S0, S0), bool))
         Hkv, G = self.Hkv, self.G
+        if self.rope:
+            from ..autograd import rope_tables, apply_rope
+            rcos, rsin = rope_tables(jnp.arange(S0), D, self.rope_theta)
         for li, bp in enumerate(p["blocks"]):
             x = ln(h, bp["g1"], bp["b1"])
             q, k, v = self.qkv(bp, x, n, S0)    # q (n,H,·); kv (n,Hkv,·)
+            if self.rope:
+                # rotate q/k; the cache stores ROTATED keys (standard),
+                # so decode steps only rotate their own position
+                q = apply_rope(q, rcos, rsin)
+                k = apply_rope(k, rcos, rsin)
             kr = jnp.repeat(k, G, axis=1) if G > 1 else k
             vr = jnp.repeat(v, G, axis=1) if G > 1 else v
             s = jnp.einsum("bhqd,bhkd->bhqk", q, kr) * self.scale
@@ -239,13 +250,20 @@ class _DecodeCore:
         Hp = Hkv // P
         ln = self.ln
         pos_idx = self.S0 + i
-        h = p["emb"][tok] + p["pos"][pos_idx]
+        h = p["emb"][tok] + (0 if self.rope else p["pos"][pos_idx])
         kmask = (jnp.arange(self.T) <= pos_idx)
         ar = jnp.arange(P)
+        if self.rope:
+            from ..autograd import rope_tables, apply_rope
+            rcos, rsin = rope_tables(pos_idx[None], D, self.rope_theta)
+            rcos, rsin = rcos[0], rsin[0]          # (D,) broadcast
         new_caches = []
         for li, ((Kc, Vc), bp) in enumerate(zip(caches, p["blocks"])):
             x = ln(h, bp["g1"], bp["b1"])
             q, kn, vn = self.qkv(bp, x, n)   # q (n,H,D); kv (n,Hkv,D)
+            if self.rope:
+                q = apply_rope(q, rcos, rsin)
+                kn = apply_rope(kn, rcos, rsin)
             # packed caches: one contiguous (P*D)-lane row per token
             Kc = lax.dynamic_update_slice(
                 Kc, kn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
@@ -314,7 +332,10 @@ def _decode_core(m: "GPT", S0, max_new, moe_capacity_factor=None):
                               else b.moe.capacity_factor))
               if b.moe_experts else None for b in m.blocks]
     return _DecodeCore(H, m.dim, S0, T, (m.dim // H) ** -0.5, moe_ks,
-                       kv_heads=kv)
+                       kv_heads=kv,
+                       rope=(getattr(m, "pos_encoding", "learned")
+                             == "rope"),
+                       rope_theta=getattr(m, "rope_theta", 10000.0))
 
 
 class _VocabTPMixin:
@@ -369,8 +390,15 @@ class GPT(_VocabTPMixin, model.Model):
                  vocab_tp_return_logits=True,
                  moe_experts=0, moe_k=2, ep_axis=None,
                  moe_capacity_factor=1.25, moe_aux_weight=0.01,
-                 moe_z_weight=1e-3, num_kv_heads=None, name=None):
+                 moe_z_weight=1e-3, num_kv_heads=None,
+                 pos_encoding="learned", rope_theta=10000.0, name=None):
         super().__init__(name)
+        assert pos_encoding in ("learned", "rope"), pos_encoding
+        # "rope": rotary q/k per block (no learned position table; the
+        # model length-generalizes and the decode rotates at the cache
+        # position); "learned": the GPT-2-style trained table.
+        self.pos_encoding = pos_encoding
+        self.rope_theta = float(rope_theta)
         self.vocab_size = vocab_size
         self.max_seq = max_seq
         self.dim = dim
@@ -420,7 +448,8 @@ class GPT(_VocabTPMixin, model.Model):
             tp_axis=tp_axis, attn_bias=attn_bias, moe_experts=moe_experts,
             moe_k=moe_k, ep_axis=ep_axis,
             moe_capacity_factor=moe_capacity_factor,
-            num_kv_heads=num_kv_heads)
+            num_kv_heads=num_kv_heads,
+            rope=(pos_encoding == "rope"), rope_theta=rope_theta)
                   for _ in range(num_layers)]
         self.blocks = blocks
         self.register_layers(*blocks)
@@ -443,8 +472,13 @@ class GPT(_VocabTPMixin, model.Model):
     def _backbone(self, ids):
         # ids: (B, S) int32 -> (B, S, E) post-final-LN hidden states
         h = self.tok_embed(ids)
-        pos = self._pos_embedding(h)
-        h = autograd.add(h, autograd.expand(pos, h.shape))
+        if self.pos_encoding == "rope":
+            # positions live in the per-block q/k rotation; no table.
+            # (_pos_init still gates the decode-params contract)
+            self._pos_init = True
+        else:
+            pos = self._pos_embedding(h)
+            h = autograd.add(h, autograd.expand(pos, h.shape))
         for b in self.blocks:
             h = b(h)
         return self.ln_f(h)
@@ -508,8 +542,10 @@ class GPT(_VocabTPMixin, model.Model):
             raise RuntimeError(
                 "generate() needs initialized weights - call "
                 "Model.compile([ids], ...) (or run a forward) first")
-        arrs = [self.tok_embed.W.data, self.pos_embed.data,
+        arrs = [self.tok_embed.W.data,
                 self.ln_f.gamma.data, self.ln_f.beta.data]
+        if self.pos_encoding != "rope":
+            arrs.append(self.pos_embed.data)
         if self.head is not None:
             arrs.append(self.head.W.data)
         for b in self.blocks:
@@ -591,7 +627,10 @@ class GPT(_VocabTPMixin, model.Model):
         else:
             head = self.head.W.data
         return {
-            "emb": emb, "pos": self.pos_embed.data,
+            "emb": emb,
+            "pos": (jnp.zeros((self.max_seq, 0), emb.dtype)
+                    if self.pos_encoding == "rope"
+                    else self.pos_embed.data),
             "gf": self.ln_f.gamma.data, "bf": self.ln_f.beta.data,
             "head": head, "blocks": blocks,
         }
@@ -1222,8 +1261,15 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
                  vocab_pad_multiple=128, vocab_tp_return_logits=True,
                  interleave=1, moe_experts=0, moe_k=2, ep_axis=None,
                  moe_capacity_factor=1.25, moe_aux_weight=0.01,
-                 moe_z_weight=1e-3, num_kv_heads=None, name=None):
+                 moe_z_weight=1e-3, num_kv_heads=None,
+                 pos_encoding="learned", rope_theta=10000.0, name=None):
         super().__init__(name)
+        assert pos_encoding in ("learned", "rope"), pos_encoding
+        # "rope": rotary q/k per block (no learned position table; the
+        # model length-generalizes and the decode rotates at the cache
+        # position); "learned": the GPT-2-style trained table.
+        self.pos_encoding = pos_encoding
+        self.rope_theta = float(rope_theta)
         self.vocab_size = vocab_size
         self.max_seq = max_seq
         self.dim = dim

@@ -355,6 +355,33 @@ def _emit(ctx, op, ins, outs):
         if to is None:
             return [mk("Identity", ins, outs)]
         return [mk("Cast", ins, outs, to=to)]
+    if t == "Rope":
+        # rotary embedding decomposed to baked cos/sin + rotate-half
+        # (Slice/Neg/Concat): export traces are single-device (offset 0)
+        # with static S, so the tables are constants
+        shape, _ = op._out_shapes[0]
+        S, D = shape[-2], shape[-1]
+        inv = (op.theta ** (-np.arange(0, D // 2, dtype=np.float32)
+                            / (D // 2)))
+        ang = np.arange(S, dtype=np.float32)[:, None] * inv[None, :]
+        cos = np.concatenate([np.cos(ang), np.cos(ang)], -1)
+        sin = np.concatenate([np.sin(ang), np.sin(ang)], -1)
+        x = ins[0]
+        n = lambda: ctx.fresh("rope")
+        x1, x2, nx2, rot, xc, rs = (n() for _ in range(6))
+        ax = _const_input(ctx, "axes", np.asarray([-1], np.int64))
+        half = _const_input(ctx, "half", np.asarray([D // 2], np.int64))
+        zero = _const_input(ctx, "zero", np.asarray([0], np.int64))
+        end = _const_input(ctx, "end", np.asarray([D], np.int64))
+        return [
+            mk("Slice", [x, zero, half, ax], [x1]),
+            mk("Slice", [x, half, end, ax], [x2]),
+            mk("Neg", [x2], [nx2]),
+            mk("Concat", [nx2, x1], [rot], axis=-1),
+            mk("Mul", [x, _const_input(ctx, "cos", cos)], [xc]),
+            mk("Mul", [rot, _const_input(ctx, "sin", sin)], [rs]),
+            mk("Add", [xc, rs], outs),
+        ]
     if t == "CosSim":
         # no ONNX CosineSimilarity node: decompose (like Gelu)
         a, b = ins
@@ -532,7 +559,7 @@ EXPORTABLE = frozenset([
     "ScatterElements", "OneHot", "IsInf", "IsNaN", "LRN",
     "LpNormalization", "MeanVarianceNormalization", "InstanceNorm2d",
     "Where", "ComputeCast", "CosSim", "GreaterOrEqual", "LessOrEqual",
-    "HardSwish", "Size",
+    "HardSwish", "Size", "Rope",
 ])
 
 # Operator class names DELIBERATELY not exported, with the reason — the

@@ -272,3 +272,42 @@ def test_gqa_ring_attention_matches_serial(dev):
                                                         P(None, "sp"))))
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3,
                                atol=2e-3)
+
+
+def test_rope_seq_parallel_offset(dev):
+    """Under sequence parallelism the Rope op offsets positions by
+    axis_index * S_local — the sharded forward must match serial."""
+    from jax.sharding import PartitionSpec as P, NamedSharding
+    from singa_tpu import models, tensor
+    from singa_tpu.parallel import make_mesh
+    import jax as _jax
+
+    mesh = make_mesh({"sp": 4})
+    rng = np.random.RandomState(9)
+    B, S, V = 2, 32, 50
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+    m = models.create_model("gpt", vocab_size=V, max_seq=S, dim=32,
+                            num_heads=4, num_layers=1, seq_axis="sp",
+                            pos_encoding="rope")
+    tx = tensor.from_numpy(ids, device=dev)
+    m.compile([tx], is_train=False, use_graph=False)
+    m.eval()
+    want = m.forward(tx).numpy()
+    params = list(m.get_params().values())
+
+    def fwd(p_arrs, ids_a):
+        for p, a in zip(params, p_arrs):
+            p.data = a
+        t = tensor.Tensor(data=ids_a, device=dev, requires_grad=False)
+        return m.forward(t).data
+
+    run = _jax.shard_map(fwd, mesh=mesh,
+                         in_specs=(P(), P(None, "sp")),
+                         out_specs=P(None, "sp"), check_vma=False)
+    rep = NamedSharding(mesh, P())
+    got = _jax.jit(run)(
+        [_jax.device_put(p.data, rep) for p in params],
+        _jax.device_put(jnp.asarray(ids), NamedSharding(mesh,
+                                                        P(None, "sp"))))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3,
+                               atol=2e-3)

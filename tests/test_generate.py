@@ -302,3 +302,31 @@ def test_moe_gpt_greedy_matches_full_forward():
     want = _naive_greedy(m, dev, prompt, 5)
     got = m.generate(prompt, 5, temperature=0.0)
     np.testing.assert_array_equal(got, want)
+
+
+def test_rope_greedy_matches_full_forward():
+    """RoPE (pos_encoding="rope"): decode rotates q/k at the cache
+    position while the layer path rotates whole sequences — two
+    independent implementations that must agree exactly. Combined with
+    GQA to cover the grouped packed layout."""
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=97, max_seq=64, dim=128,
+                            num_heads=4, num_kv_heads=2, num_layers=2,
+                            pos_encoding="rope")
+    ids = tensor.from_numpy(
+        np.random.RandomState(0).randint(0, 97, (2, 8)).astype(np.int32),
+        device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    rng = np.random.RandomState(13)
+    m.set_params({n: (rng.standard_normal(tuple(t.shape)) * 0.05)
+                  .astype(np.float32) for n, t in m.get_params().items()})
+    assert "pos_embed" not in m.get_params()  # no learned table
+    prompt = np.random.RandomState(8).randint(0, 97, (2, 8))
+    want = _naive_greedy(m, dev, prompt, 6)
+    got = m.generate(prompt, 6, temperature=0.0)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(
+        m.generate_beam(prompt, 4, num_beams=1),
+        m.generate(prompt, 4, temperature=0.0))
+    assert m.generate(prompt, 4, dtype="int8").shape == (2, 12)

@@ -94,3 +94,25 @@ def test_gqa_gpt_trains(dev):
         losses.append(float(loss.numpy()))
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
     assert tuple(m.blocks[0].attn.Wk.shape) == (64, 32)
+
+
+def test_rope_gpt_trains(dev):
+    """RoPE GPT trains (gradient flows through the rotation; no learned
+    position table in the param set)."""
+    rng = np.random.RandomState(0)
+    V, B, S = 50, 8, 16
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    m = models.create_model("gpt", vocab_size=V, max_seq=S, dim=64,
+                            num_heads=4, num_layers=2,
+                            pos_encoding="rope")
+    m.set_optimizer(opt.SGD(lr=0.1))
+    tx = tensor.from_numpy(ids, device=dev)
+    ty = tensor.from_numpy(tgt, device=dev)
+    m.compile([tx], is_train=True, use_graph=True)
+    losses = []
+    for _ in range(6):
+        _, loss = m(tx, ty)
+        losses.append(float(loss.numpy()))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    assert "pos_embed" not in m.get_params()

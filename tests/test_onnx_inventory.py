@@ -182,3 +182,26 @@ def test_unexportable_raises_with_reason(dev):
         autograd.training = prev
     with pytest.raises(NotImplementedError, match="deliberately"):
         frontend.to_onnx_model([x], [y])
+
+
+def test_rope_gpt_export_roundtrip(dev, tmp_path):
+    """A RoPE GPT exports (rotation decomposed to baked cos/sin +
+    rotate-half Slice/Neg/Concat) and re-imports with numeric parity."""
+    from singa_tpu import models
+    m = models.create_model("gpt", vocab_size=31, max_seq=16, dim=32,
+                            num_heads=2, num_layers=1,
+                            pos_encoding="rope")
+    x = np.random.RandomState(5).randint(0, 31, (2, 8)).astype(np.int32)
+    txs = [tensor.Tensor(data=x, device=dev)]
+    m.compile(txs, is_train=False, use_graph=False)
+    m.eval()
+    ref = m.forward(*txs).numpy()
+    sonnx.export(m, txs, str(tmp_path / "rope.onnx"))
+    rep = sonnx.prepare(sonnx.load_model(str(tmp_path / "rope.onnx")), dev)
+    prev = autograd.training
+    autograd.training = False
+    try:
+        out = rep.run([tensor.Tensor(data=x, device=dev)])[0]
+    finally:
+        autograd.training = prev
+    np.testing.assert_allclose(ref, out.numpy(), rtol=1e-4, atol=1e-4)
