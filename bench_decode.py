@@ -37,6 +37,9 @@ def main():
     p.add_argument("--kv-heads", type=int, default=None,
                    help="GQA: kv heads < heads shrinks the KV cache — "
                         "the binding term of the decode roofline")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings instead of the "
+                        "learned table")
     p.add_argument("--vocab", type=int, default=8192)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--prompt", type=int, default=128)
@@ -62,7 +65,8 @@ def main():
     m = models.create_model(
         "gpt", vocab_size=args.vocab, max_seq=T, dim=args.dim,
         num_heads=args.heads, num_layers=args.layers,
-        num_kv_heads=args.kv_heads)
+        num_kv_heads=args.kv_heads,
+        pos_encoding="rope" if args.rope else "learned")
     rng = np.random.RandomState(0)
     ids = tensor.from_numpy(
         rng.randint(0, args.vocab, (args.batch, args.prompt))
@@ -143,6 +147,7 @@ def main():
         "metric": f"gpt_decode_tok_s_d{args.dim}_l{args.layers}"
                   f"_b{args.batch}_p{args.prompt}_n{args.new}_{args.dtype}"
                   + (f"_kv{Hkv}" if Hkv != H else "")
+                  + ("_rope" if args.rope else "")
                   + ("_cpu" if on_cpu else ""),
         "value": round(tok_s, 1),
         "unit": "tokens/s",
