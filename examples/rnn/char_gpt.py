@@ -91,6 +91,10 @@ def main():
     p.add_argument("--sample", type=int, default=256,
                    help="chars to sample after training")
     p.add_argument("--prompt", default="def forward(self, x):")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings")
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA kv heads (< heads; decode cache shrinks)")
     args = p.parse_args()
 
     text = load_corpus(args.corpus)
@@ -108,7 +112,10 @@ def main():
     dev = device.best_device()
     m = models.create_model("gpt", vocab_size=data.vocab, max_seq=args.seq,
                             dim=args.dim, num_heads=max(1, args.dim // 64),
-                            num_layers=args.layers)
+                            num_layers=args.layers,
+                            num_kv_heads=args.kv_heads,
+                            pos_encoding="rope" if args.rope
+                            else "learned")
     m.set_optimizer(opt.Adam(lr=args.lr))
     tx = tensor.Tensor((args.batch, args.seq), device=dev,
                        dtype=tensor.int32)
