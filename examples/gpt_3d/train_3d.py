@@ -41,7 +41,10 @@ def main():
     p.add_argument("--vocab", type=int, default=512)
     p.add_argument("--n-micro", type=int, default=4)
     p.add_argument("--schedule", default="1f1b",
-                   choices=["gpipe", "1f1b"])
+                   choices=["gpipe", "1f1b", "interleaved"],
+                   help="interleaved = gpipe schedule with 2 virtual "
+                        "chunks per device (lowest bubble; see "
+                        "parallel/pipeline.py schedule_table)")
     p.add_argument("--lr", type=float, default=1e-2)
     p.add_argument("--ckpt", default=None,
                    help="directory for an orbax checkpoint; saved at the "
@@ -68,14 +71,16 @@ def main():
     mesh = make_mesh({"data": n // 4, "pp": 2, "tp": 2})
     print(f"mesh: data={n // 4} x pp=2 x tp=2 ({n} devices), "
           f"schedule={args.schedule}, bubble="
-          f"{pipeline_bubble_fraction(2, args.n_micro, args.schedule):.1%}")
+          f"{pipeline_bubble_fraction(2, args.n_micro, 'interleaved' if args.schedule == 'interleaved' else args.schedule):.1%}")
 
     dev = device.best_device()
     dev.SetRandSeed(0)
+    interleave = 2 if args.schedule == "interleaved" else 1
+    sched = "gpipe" if args.schedule == "interleaved" else args.schedule
     m = models.create_model(
         "gpt_pipe", vocab_size=args.vocab, max_seq=args.seq, dim=args.dim,
         num_heads=args.heads, num_layers=args.layers,
-        tp_axis="tp", vocab_tp=True)
+        tp_axis="tp", vocab_tp=True, interleave=interleave)
     m.set_optimizer(opt.DistOpt(opt.SGD(lr=args.lr, momentum=0.9),
                                 axis="data", mesh=mesh))
 
@@ -89,7 +94,7 @@ def main():
     ty = tensor.from_numpy(tgt, dev)
     m.compile([tx], is_train=True, use_graph=True,
               pipeline_axis="pp", n_micro=args.n_micro,
-              pipeline_schedule=args.schedule)
+              pipeline_schedule=sched)
 
     half = args.steps // 2
     ckpt_path = None
