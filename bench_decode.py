@@ -46,6 +46,8 @@ def main():
     p.add_argument("--new", type=int, default=512)
     p.add_argument("--dtype", default="bfloat16",
                    choices=["float32", "bfloat16", "int8"])
+    p.add_argument("--kv-dtype", default=None, choices=[None, "int8"],
+                   help="int8 KV cache (per-head-per-position scales)")
     p.add_argument("--reps", type=int, default=3,
                    help="timed full-decode calls (median reported)")
     p.add_argument("--trace", default=None, metavar="DIR")
@@ -77,7 +79,8 @@ def main():
 
     dt = None if args.dtype == "float32" else args.dtype
     # warmup = compile
-    m.generate(prompt, args.new, temperature=0.0, dtype=dt)
+    m.generate(prompt, args.new, temperature=0.0, dtype=dt,
+               kv_dtype=args.kv_dtype)
 
     # per-call overhead (jit dispatch + host<->device roundtrip; on a
     # tunneled chip this is ~100 ms and dominates the wall-vs-device gap)
@@ -96,7 +99,8 @@ def main():
     times = []
     for _ in range(args.reps):
         t0 = time.perf_counter()
-        out = m.generate(prompt, args.new, temperature=0.0, dtype=dt)
+        out = m.generate(prompt, args.new, temperature=0.0, dtype=dt,
+                         kv_dtype=args.kv_dtype)
         times.append(time.perf_counter() - t0)
     if args.trace:
         dev.StopTrace()
@@ -120,8 +124,11 @@ def main():
     # KV cache follows the ACTIVATION dtype: bf16 under both "bfloat16"
     # and "int8" (weight-only quantization), fp32 under "float32";
     # GQA holds Hkv heads, not H
-    kv_bpe = 4 if args.dtype == "float32" else 2
+    kv_bpe = 1 if args.kv_dtype == "int8"         else (4 if args.dtype == "float32" else 2)
     kv_bytes = L * 2 * args.batch * Hkv * T * D * kv_bpe  # K+V, T rows
+    if args.kv_dtype == "int8":
+        # per-(head, position) fp32 scales travel with the cache
+        kv_bytes += L * 2 * args.batch * Hkv * T * 4
     per_step_bytes = weight_bytes + kv_bytes
     kind = getattr(dev.jax_device, "device_kind", "")
     peak_bw = _chip_peak_bw(kind)
@@ -148,6 +155,7 @@ def main():
                   f"_b{args.batch}_p{args.prompt}_n{args.new}_{args.dtype}"
                   + (f"_kv{Hkv}" if Hkv != H else "")
                   + ("_rope" if args.rope else "")
+                  + ("_kv8" if args.kv_dtype == "int8" else "")
                   + ("_cpu" if on_cpu else ""),
         "value": round(tok_s, 1),
         "unit": "tokens/s",

@@ -118,10 +118,17 @@ class _DecodeCore:
     """
 
     def __init__(self, H, E, S0, T, scale, moe_ks=None, kv_heads=None,
-                 rope=False, rope_theta=10000.0):
+                 rope=False, rope_theta=10000.0, kv8=False):
         self.H, self.E, self.S0, self.T, self.scale = H, E, S0, T, scale
         self.rope = bool(rope)
         self.rope_theta = float(rope_theta)
+        # kv8: int8 KV cache with per-(head, position) symmetric scales.
+        # The algebra stays exact-in-structure: K-scales multiply scores
+        # per source position after the packed matmul, and V-scales fold
+        # into the attention weights for the DIAGONAL (own-head) block —
+        # the only block the packed extraction keeps, so the off-block
+        # garbage scaling is discarded with the cross-terms.
+        self.kv8 = bool(kv8)
         # static per-layer MoE routing degree (None = dense MLP); must be
         # static (int() under jit) so it lives here, not in the param tree
         self.moe_ks = moe_ks or []
@@ -197,6 +204,24 @@ class _DecodeCore:
         return kv.reshape(n, Hkv // P, P, S, D).swapaxes(2, 3) \
             .reshape(n, Hkv // P, S, P * D)
 
+    def _quant_kv(self, kv, n, S):
+        """(n,Hkv,S,D) -> (packed int8 (n,Hp,S,P*D),
+        scales (n,Hp,S,P) fp32): per-(head, position) symmetric."""
+        import jax.numpy as jnp
+        P, Hkv = self.P, self.Hkv
+        s = jnp.maximum(jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1),
+                        1e-8) / 127.0                       # (n,Hkv,S)
+        q = jnp.clip(jnp.round(kv.astype(jnp.float32) / s[..., None]),
+                     -127, 127).astype(jnp.int8)
+        sp = s.reshape(n, Hkv // P, P, S).swapaxes(2, 3)    # (n,Hp,S,P)
+        return self._pack(q, n, S), sp
+
+    def _scale_rows(self, sp, G):
+        """(n,Hp,T,P) per-position scales -> (n,Hp,P*G,T) row factors
+        (packed query row q = c*G + g reads lane block c)."""
+        import jax.numpy as jnp
+        return jnp.repeat(sp.swapaxes(2, 3), G, axis=2)
+
     def prefill(self, p, prompt, n):
         """Causal pass over the (n, S0) prompt; returns the last-position
         logits (n, V) and per-block head-packed KV caches of time-length
@@ -230,10 +255,22 @@ class _DecodeCore:
                         bp["Wo"]) + bp["bo"]
             x = ln(h, bp["g2"], bp["b2"])
             h = h + self.mlp(bp, x, li)
-            Kc = jnp.zeros((n, Hkv // P, T, P * D), k.dtype) \
-                .at[:, :, :S0].set(self._pack(k, n, S0))
-            Vc = jnp.zeros((n, Hkv // P, T, P * D), v.dtype) \
-                .at[:, :, :S0].set(self._pack(v, n, S0))
+            if self.kv8:
+                k8, ks = self._quant_kv(k, n, S0)
+                v8, vs = self._quant_kv(v, n, S0)
+                Kc = (jnp.zeros((n, Hkv // P, T, P * D), jnp.int8)
+                      .at[:, :, :S0].set(k8),
+                      jnp.zeros((n, Hkv // P, T, P), jnp.float32)
+                      .at[:, :, :S0].set(ks))
+                Vc = (jnp.zeros((n, Hkv // P, T, P * D), jnp.int8)
+                      .at[:, :, :S0].set(v8),
+                      jnp.zeros((n, Hkv // P, T, P), jnp.float32)
+                      .at[:, :, :S0].set(vs))
+            else:
+                Kc = jnp.zeros((n, Hkv // P, T, P * D), k.dtype) \
+                    .at[:, :, :S0].set(self._pack(k, n, S0))
+                Vc = jnp.zeros((n, Hkv // P, T, P * D), v.dtype) \
+                    .at[:, :, :S0].set(self._pack(v, n, S0))
             caches.append((Kc, Vc))
         logits0 = _mm(ln(h[:, -1], p["gf"], p["bf"]), p["head"])
         return logits0, caches
@@ -265,10 +302,22 @@ class _DecodeCore:
                 q = apply_rope(q, rcos, rsin)
                 kn = apply_rope(kn, rcos, rsin)
             # packed caches: one contiguous (P*D)-lane row per token
-            Kc = lax.dynamic_update_slice(
-                Kc, kn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
-            Vc = lax.dynamic_update_slice(
-                Vc, vn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
+            if self.kv8:
+                (K8, Ks), (V8, Vs) = Kc, Vc
+                k8, ks = self._quant_kv(kn[:, :, None], n, 1)
+                v8, vs = self._quant_kv(vn[:, :, None], n, 1)
+                K8 = lax.dynamic_update_slice(K8, k8, (0, 0, pos_idx, 0))
+                Ks = lax.dynamic_update_slice(Ks, ks, (0, 0, pos_idx, 0))
+                V8 = lax.dynamic_update_slice(V8, v8, (0, 0, pos_idx, 0))
+                Vs = lax.dynamic_update_slice(Vs, vs, (0, 0, pos_idx, 0))
+                Kc, Vc = (K8, Ks), (V8, Vs)
+                Kmat, Vmat = K8.astype(x.dtype), V8.astype(x.dtype)
+            else:
+                Kc = lax.dynamic_update_slice(
+                    Kc, kn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
+                Vc = lax.dynamic_update_slice(
+                    Vc, vn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
+                Kmat, Vmat = Kc, Vc
             # block-diagonal queries: packed slot c holds kv head
             # (hp*P + c)'s G query rows in block c, zeros elsewhere —
             # the full-width contraction with the packed K then yields
@@ -278,9 +327,16 @@ class _DecodeCore:
             Q2 = jnp.zeros((n, Hp, P, G, P, D), q.dtype) \
                 .at[:, :, ar, :, ar, :].set(q6) \
                 .reshape(n, Hp, P * G, P * D)
-            s = jnp.einsum("nhqj,nhtj->nhqt", Q2, Kc) * self.scale
+            s = jnp.einsum("nhqj,nhtj->nhqt", Q2, Kmat) * self.scale
+            if self.kv8:
+                # K-scales: one factor per (source position, own block)
+                s = s * self._scale_rows(Ks, G)
             a = jax.nn.softmax(jnp.where(kmask, s, -jnp.inf), axis=-1)
-            O2 = jnp.einsum("nhqt,nhtj->nhqj", a, Vc)   # (n,Hp,P*G,P*D)
+            if self.kv8:
+                # V-scales fold into the weights for the own-head block
+                # (the only one extracted below)
+                a = (a * self._scale_rows(Vs, G)).astype(x.dtype)
+            O2 = jnp.einsum("nhqt,nhtj->nhqj", a, Vmat)  # (n,Hp,P*G,P*D)
             o = jnp.moveaxis(
                 O2.reshape(n, Hp, P, G, P, D)[:, :, ar, :, ar, :],
                 0, 2).reshape(n, E)
@@ -315,7 +371,8 @@ def _pool_merge(pool_tok, pool_norm, pool_raw, cand_tok, cand_norm,
     return new_tok, top_norm, new_raw
 
 
-def _decode_core(m: "GPT", S0, max_new, moe_capacity_factor=None):
+def _decode_core(m: "GPT", S0, max_new, moe_capacity_factor=None,
+                 kv8=False):
     H = m.blocks[0].attn.num_heads
     kv = m.blocks[0].attn.num_kv_heads
     T = S0 + max_new
@@ -335,7 +392,8 @@ def _decode_core(m: "GPT", S0, max_new, moe_capacity_factor=None):
                        kv_heads=kv,
                        rope=(getattr(m, "pos_encoding", "learned")
                              == "rope"),
-                       rope_theta=getattr(m, "rope_theta", 10000.0))
+                       rope_theta=getattr(m, "rope_theta", 10000.0),
+                       kv8=kv8)
 
 
 class _VocabTPMixin:
@@ -636,12 +694,14 @@ class GPT(_VocabTPMixin, model.Model):
         }
 
     def _build_decode(self, B, S0, max_new, temperature, top_k,
-                      dtype=None, moe_capacity_factor=None):
+                      dtype=None, moe_capacity_factor=None,
+                      kv_dtype=None):
         import jax
         import jax.numpy as jnp
         from jax import lax
 
-        core = _decode_core(self, S0, max_new, moe_capacity_factor)
+        core = _decode_core(self, S0, max_new, moe_capacity_factor,
+                            kv8=(kv_dtype == "int8"))
 
         def sample(logits, key):
             logits = logits.astype(jnp.float32)
@@ -679,14 +739,15 @@ class GPT(_VocabTPMixin, model.Model):
 
     def _build_beam_decode(self, B, S0, max_new, num_beams, length_penalty,
                            eos_id, dtype, pad_id=None,
-                           moe_capacity_factor=None):
+                           moe_capacity_factor=None, kv_dtype=None):
         import jax
         import jax.numpy as jnp
         from jax import lax
 
         V = self.vocab_size
         K = num_beams
-        core = _decode_core(self, S0, max_new, moe_capacity_factor)
+        core = _decode_core(self, S0, max_new, moe_capacity_factor,
+                            kv8=(kv_dtype == "int8"))
         NEG = jnp.float32(-1e9)
         pad = 0 if eos_id is None else (pad_id if pad_id is not None
                                         else eos_id)
@@ -698,8 +759,10 @@ class GPT(_VocabTPMixin, model.Model):
             # p arrives pre-cast/quantized (_decode_state memo)
             # ---- prefill on the B prompts, then tile caches to B*K ----
             logits0, caches = core.prefill(p, prompt, B)
-            caches = [(jnp.repeat(Kc, K, axis=0), jnp.repeat(Vc, K, axis=0))
-                      for (Kc, Vc) in caches]  # beam b*K+k from prompt b
+            # beam b*K+k from prompt b (tree-map: kv8 caches are
+            # (int8, scales) tuples)
+            caches = jax.tree.map(lambda a: jnp.repeat(a, K, axis=0),
+                                  caches)
             logp0 = jax.nn.log_softmax(
                 logits0.astype(jnp.float32), axis=-1)     # (B,V)
             tokens = jnp.full((B, K, max_new), pad, jnp.int32)
@@ -769,7 +832,7 @@ class GPT(_VocabTPMixin, model.Model):
                 tokens = gather(cand_hist, pick[..., None], axis=1)
                 src = (jnp.arange(B)[:, None] * K
                        + keep_beam).reshape(B * K)        # flat rows
-                caches = [(Kc[src], Vc[src]) for (Kc, Vc) in caches]
+                caches = jax.tree.map(lambda a: a[src], caches)
                 return (tokens, new_scores, caches,
                         pool_tok, pool_norm, pool_raw), None
 
@@ -796,7 +859,7 @@ class GPT(_VocabTPMixin, model.Model):
     def generate_beam(self, prompt, max_new_tokens, num_beams=4,
                       length_penalty=1.0, eos_id=None, pad_id=None,
                       dtype=None, return_scores=False,
-                      moe_capacity_factor=None):
+                      moe_capacity_factor=None, kv_dtype=None):
         """Beam-search decoding (no reference equivalent; its GPT-2
         example is greedy). One jitted function: prefill once, tile the
         KV cache across beams, and a `lax.scan` whose carry reorders
@@ -816,9 +879,10 @@ class GPT(_VocabTPMixin, model.Model):
         assert num_beams <= self.vocab_size, \
             f"num_beams {num_beams} exceeds vocab_size {self.vocab_size}"
         B, S0 = ids.shape
+        assert kv_dtype in (None, "int8"), kv_dtype
         sig = ("beam", B, S0, max_new_tokens, num_beams,
                float(length_penalty), eos_id, pad_id, dtype,
-               moe_capacity_factor)
+               moe_capacity_factor, kv_dtype)
         cache = getattr(self, "_decode_cache", None)
         if cache is None:
             cache = self._decode_cache = {}
@@ -826,7 +890,7 @@ class GPT(_VocabTPMixin, model.Model):
         if fn is None:
             fn = cache[sig] = self._build_beam_decode(
                 B, S0, max_new_tokens, num_beams, float(length_penalty),
-                eos_id, dtype, pad_id, moe_capacity_factor)
+                eos_id, dtype, pad_id, moe_capacity_factor, kv_dtype)
         out, scores = fn(self._decode_state(dtype), ids.astype(np.int32))
         out = np.asarray(jax.device_get(out))
         if return_scores:
@@ -834,7 +898,8 @@ class GPT(_VocabTPMixin, model.Model):
         return out
 
     def generate(self, prompt, max_new_tokens, temperature=0.0, top_k=None,
-                 seed=0, dtype=None, moe_capacity_factor=None):
+                 seed=0, dtype=None, moe_capacity_factor=None,
+                 kv_dtype=None):
         """Autoregressive sampling: greedy (temperature=0) or
         temperature/top-k. `prompt` is (B, S0) int32 (numpy or Tensor);
         returns (B, S0+max_new_tokens) numpy. The decode function is
@@ -855,8 +920,9 @@ class GPT(_VocabTPMixin, model.Model):
         elif top_k is not None:
             top_k = max(1, min(int(top_k), self.vocab_size))
         B, S0 = ids.shape
+        assert kv_dtype in (None, "int8"), kv_dtype
         sig = (B, S0, max_new_tokens, float(temperature), top_k, dtype,
-               moe_capacity_factor)
+               moe_capacity_factor, kv_dtype)
         cache = getattr(self, "_decode_cache", None)
         if cache is None:
             cache = self._decode_cache = {}
@@ -864,7 +930,7 @@ class GPT(_VocabTPMixin, model.Model):
         if fn is None:
             fn = cache[sig] = self._build_decode(
                 B, S0, max_new_tokens, float(temperature), top_k, dtype,
-                moe_capacity_factor)
+                moe_capacity_factor, kv_dtype)
         out = fn(self._decode_state(dtype), ids.astype(np.int32),
                  jax.random.PRNGKey(seed))
         return np.asarray(jax.device_get(out))

@@ -330,3 +330,29 @@ def test_rope_greedy_matches_full_forward():
         m.generate_beam(prompt, 4, num_beams=1),
         m.generate(prompt, 4, temperature=0.0))
     assert m.generate(prompt, 4, dtype="int8").shape == (2, 12)
+
+
+def test_kv8_decode_tracks_bf16():
+    """int8 KV cache (kv_dtype="int8"): per-(head, position) scales keep
+    greedy decode close to the bf16-cache path; deterministic; beam
+    shares the quantized cache (tree-mapped tiling/reordering)."""
+    m, _ = _seeded_gqa(dim=256, num_heads=8, num_kv_heads=4, seed=21)
+    prompt = np.random.RandomState(9).randint(0, 97, (2, 6))
+    a = m.generate(prompt, 8, dtype="bfloat16", kv_dtype="int8")
+    assert a.shape == (2, 14)
+    np.testing.assert_array_equal(
+        a, m.generate(prompt, 8, dtype="bfloat16", kv_dtype="int8"))
+    b = m.generate(prompt, 8, dtype="bfloat16")
+    agree = float(np.mean(a[:, 6:] == b[:, 6:]))
+    assert agree >= 0.5, \
+        f"kv8 greedy diverged from bf16 cache on {1-agree:.0%} of tokens"
+    # full quantized serving: int8 weights + int8 KV, plus beam
+    c = m.generate(prompt, 6, dtype="int8", kv_dtype="int8")
+    assert c.shape == (2, 12)
+    assert m.generate_beam(prompt, 4, num_beams=2, dtype="int8",
+                           kv_dtype="int8").shape == (2, 10)
+    # MHA (P>1, G=1) layout too
+    m2, _ = _seeded_gpt(dim=128, num_heads=4, seed=22)
+    d = m2.generate(prompt, 8, dtype="bfloat16", kv_dtype="int8")
+    e = m2.generate(prompt, 8, dtype="bfloat16")
+    assert float(np.mean(d[:, 6:] == e[:, 6:])) >= 0.5
