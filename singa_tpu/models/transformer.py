@@ -11,6 +11,12 @@ from __future__ import annotations
 
 from .. import autograd, layer, model
 from ..tensor import Tensor, float32
+# serving engine lives in singa_tpu/serving.py; re-exports kept so
+# existing imports (tests, examples) stay valid
+from ..serving import (_DecodeCore, _cast_params, _decode_core, _mm,  # noqa: F401
+                       _pool_merge, _quant8, _set_col, build_beam_decode,
+                       build_decode, decode_params, decode_raw,
+                       decode_state)
 
 
 class _PosSlice(autograd.Operator):
@@ -31,369 +37,6 @@ class _PosSlice(autograd.Operator):
             except NameError:
                 off = 0
         return lax.dynamic_slice_in_dim(table, off, self.length, axis=0)
-
-
-def _quant8(W):
-    """Per-output-channel symmetric int8 quantization of a (in, out)
-    weight: q8 int8 + fp32 scale row. The scale commutes with the
-    contraction (y_j = (sum_i x_i q_ij) * s_j), so the matmul runs on the
-    int8 bytes and only the tiny (out,) output is rescaled — halving
-    weight HBM traffic vs bf16 on the bandwidth-bound decode path."""
-    import jax.numpy as jnp
-    s = jnp.max(jnp.abs(W), axis=0, keepdims=True) / 127.0
-    s = jnp.maximum(s, 1e-8)
-    q = jnp.clip(jnp.round(W / s), -127, 127).astype(jnp.int8)
-    return {"q8": q, "sc": s.astype(jnp.float32)}
-
-
-def _mm(x, W):
-    """x @ W where W is a plain array or a _quant8 dict."""
-    if isinstance(W, dict):
-        y = x @ W["q8"].astype(x.dtype)
-        return y * W["sc"].astype(x.dtype)
-    return x @ W
-
-
-_Q8_KEYS = ("Wqkv", "Wo", "W1", "W2", "head")
-
-
-def _cast_params(p, dtype):
-    """Decode-param tree in the serving dtype: None = as-stored (fp32),
-    "bfloat16" = bf16 weights/activations, "int8" = weight-only int8
-    (the big streamed matrices quantize; biases, LN params, embedding —
-    its gather reads only B rows — and MoE weights stay bf16; W8A16)."""
-    import jax
-    import jax.numpy as jnp
-    if dtype is None:
-        return p
-    if dtype != "int8":
-        cd = jnp.dtype(dtype)
-        return jax.tree.map(
-            lambda a: a.astype(cd)
-            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
-    bf = jnp.bfloat16
-
-    def cast_leaf(a):
-        return a.astype(bf) \
-            if jnp.issubdtype(a.dtype, jnp.floating) else a
-
-    out = {k: cast_leaf(v) for k, v in p.items() if k != "blocks"}
-    out["head"] = _quant8(p["head"])
-    blocks = []
-    for bp in p["blocks"]:
-        nb = {k: cast_leaf(v) for k, v in bp.items()}
-        for k in _Q8_KEYS:
-            if k in bp:
-                nb[k] = _quant8(bp[k])
-        blocks.append(nb)
-    out["blocks"] = blocks
-    return out
-
-
-class _DecodeCore:
-    """Shared functional decode math for greedy/sampled and beam decoding.
-
-    One implementation of the fp32-island LayerNorm, the causal prefill
-    (which also fills the KV caches), and the single-token cached block
-    step — so every decode flavor shares numerics by construction (the
-    beam-1 == greedy test leans on this).
-
-    Serving-roofline design notes (PROFILE.md "KV-cached decode"):
-    - HEAD-PACKED KV caches, (B, H/P, T, P*D) with P = 128//D: TPU bf16
-      tiles are (16 sublanes, 128 lanes), so a (B,H,T,D) cache with
-      D=64 pads every row to 128 lanes — the cache physically occupies
-      and STREAMS 2x its logical bytes (measured: the decode's cache
-      fusions moved at 323 GB/s "logical" = ~85% of peak on the padded
-      bytes). Packing P heads into the minor dim fills the lanes while
-      keeping the per-token cache update a contiguous row write. Scores
-      stay exactly per-head: the packed contraction uses BLOCK-DIAGONAL
-      queries (off-block entries are 0, so cross-head terms vanish), and
-      the attention-output matmul computes a (P*D)-wide row per packed
-      head from which the diagonal (own-head) blocks are extracted —
-      2x redundant MXU FLOPs on a bandwidth-bound op, zero extra bytes.
-    - Wq/Wk/Wv are fused into one (E, 3E) matmul at decode-param prep:
-      one weight stream + one MXU op per block instead of three.
-    - `dtype="int8"` weight-only quantization (per-output-channel
-      symmetric, _quant8) halves the dominant weight traffic again.
-    """
-
-    def __init__(self, H, E, S0, T, scale, moe_ks=None, kv_heads=None,
-                 rope=False, rope_theta=10000.0, kv8=False):
-        self.H, self.E, self.S0, self.T, self.scale = H, E, S0, T, scale
-        self.rope = bool(rope)
-        self.rope_theta = float(rope_theta)
-        # kv8: int8 KV cache with per-(head, position) symmetric scales.
-        # The algebra stays exact-in-structure: K-scales multiply scores
-        # per source position after the packed matmul, and V-scales fold
-        # into the attention weights for the DIAGONAL (own-head) block —
-        # the only block the packed extraction keeps, so the off-block
-        # garbage scaling is discarded with the cross-terms.
-        self.kv8 = bool(kv8)
-        # static per-layer MoE routing degree (None = dense MLP); must be
-        # static (int() under jit) so it lives here, not in the param tree
-        self.moe_ks = moe_ks or []
-        # GQA: Hkv kv heads each serve G = H/Hkv query heads; the caches
-        # hold Hkv heads (the serving win — KV traffic shrinks G x) and
-        # the packed block-diagonal contraction places G query rows per
-        # kv-head block instead of 1
-        self.Hkv = kv_heads or H
-        self.G = H // self.Hkv
-        D = E // H
-        P = max(1, 128 // D)
-        self.P = P if (P > 1 and self.Hkv % P == 0) else 1
-
-    def cast(self, p, dtype):
-        return _cast_params(p, dtype)
-
-    def ln(self, x, g, b, eps=1e-5):
-        # fp32 island like autograd.LayerNorm: variance in bf16 is
-        # catastrophically lossy
-        import jax.numpy as jnp
-        from jax import lax
-        x32 = x.astype(jnp.float32)
-        m = jnp.mean(x32, axis=-1, keepdims=True)
-        v = jnp.var(x32, axis=-1, keepdims=True)
-        y = (x32 - m) * lax.rsqrt(v + eps) * g.astype(jnp.float32) \
-            + b.astype(jnp.float32)
-        return y.astype(x.dtype)
-
-    def mlp(self, bp, x, li):
-        """Block MLP on (..., E): dense two-layer, or the MoE FFN when
-        layer `li` routes to experts (decode uses the single-device
-        dense-dispatch path; generous capacity so no token drops)."""
-        import jax
-        import jax.numpy as jnp
-        kcf = self.moe_ks[li] if li < len(self.moe_ks) else None
-        if kcf is not None:
-            # NOTE: capacity-limited routing is a BATCH-GLOBAL effect (a
-            # token's drop depends on the other tokens in the dispatch),
-            # so cached decode == full forward only in the no-drop regime
-            # (generous capacity_factor); the layer's own factor is used
-            # here for honest replication.
-            k, cf = kcf
-            from ..parallel.moe import moe_ffn
-            lead = x.shape[:-1]
-            flat = x.reshape(-1, x.shape[-1])
-            y, _, _ = moe_ffn(flat, bp["moeWg"], bp["moeW1"], bp["moeb1"],
-                              bp["moeW2"], bp["moeb2"],
-                              capacity_factor=cf, k=k)
-            return y.reshape(*lead, x.shape[-1]).astype(x.dtype)
-        return _mm(jax.nn.gelu(_mm(x, bp["W1"]) + bp["bb1"]),
-                   bp["W2"]) + bp["bb2"]
-
-    def qkv(self, bp, x, n, S=None):
-        """Fused QKV projection: one (E, E + 2*Hkv*D) matmul, split into
-        q (n,[S,]H,D) and k/v (n,[S,]Hkv,D)."""
-        import jax.numpy as jnp
-        H, D, E, Hkv = self.H, self.E // self.H, self.E, self.Hkv
-        KE = Hkv * D
-        fused = _mm(x, bp["Wqkv"]) + bp["bqkv"]
-        bounds = ((0, E, H), (E, E + KE, Hkv), (E + KE, E + 2 * KE, Hkv))
-        if S is None:
-            q, k, v = (fused[..., a:b].reshape(n, h, D)
-                       for a, b, h in bounds)
-        else:
-            q, k, v = (fused[..., a:b].reshape(n, S, h, D).swapaxes(1, 2)
-                       for a, b, h in bounds)
-        return q, k, v
-
-    def _pack(self, kv, n, S):
-        """(n,Hkv,S,D) per-kv-head K/V -> head-packed
-        (n, Hkv/P, S, P*D)."""
-        D, P, Hkv = self.E // self.H, self.P, self.Hkv
-        return kv.reshape(n, Hkv // P, P, S, D).swapaxes(2, 3) \
-            .reshape(n, Hkv // P, S, P * D)
-
-    def _quant_kv(self, kv, n, S):
-        """(n,Hkv,S,D) -> (packed int8 (n,Hp,S,P*D),
-        scales (n,Hp,S,P) fp32): per-(head, position) symmetric."""
-        import jax.numpy as jnp
-        P, Hkv = self.P, self.Hkv
-        s = jnp.maximum(jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1),
-                        1e-8) / 127.0                       # (n,Hkv,S)
-        q = jnp.clip(jnp.round(kv.astype(jnp.float32) / s[..., None]),
-                     -127, 127).astype(jnp.int8)
-        sp = s.reshape(n, Hkv // P, P, S).swapaxes(2, 3)    # (n,Hp,S,P)
-        return self._pack(q, n, S), sp
-
-    def _scale_rows(self, sp, G):
-        """(n,Hp,T,P) per-position scales -> (n,Hp,P*G,T) row factors
-        (packed query row q = c*G + g reads lane block c)."""
-        import jax.numpy as jnp
-        return jnp.repeat(sp.swapaxes(2, 3), G, axis=2)
-
-    def prefill(self, p, prompt, n):
-        """Causal pass over the (n, S0) prompt; returns the last-position
-        logits (n, V) and per-block head-packed KV caches of time-length
-        T, shape (n, H/P, T, P*D) (see class docstring)."""
-        import jax
-        import jax.numpy as jnp
-        H, D, S0, T, P = self.H, self.E // self.H, self.S0, self.T, self.P
-        ln = self.ln
-        h = p["emb"][prompt] + (0 if self.rope else p["pos"][:S0])
-
-        caches = []
-        cmask = jnp.tril(jnp.ones((S0, S0), bool))
-        Hkv, G = self.Hkv, self.G
-        if self.rope:
-            from ..autograd import rope_tables, apply_rope
-            rcos, rsin = rope_tables(jnp.arange(S0), D, self.rope_theta)
-        for li, bp in enumerate(p["blocks"]):
-            x = ln(h, bp["g1"], bp["b1"])
-            q, k, v = self.qkv(bp, x, n, S0)    # q (n,H,·); kv (n,Hkv,·)
-            if self.rope:
-                # rotate q/k; the cache stores ROTATED keys (standard),
-                # so decode steps only rotate their own position
-                q = apply_rope(q, rcos, rsin)
-                k = apply_rope(k, rcos, rsin)
-            kr = jnp.repeat(k, G, axis=1) if G > 1 else k
-            vr = jnp.repeat(v, G, axis=1) if G > 1 else v
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, kr) * self.scale
-            a = jax.nn.softmax(jnp.where(cmask, s, -jnp.inf), axis=-1)
-            o = jnp.einsum("bhqk,bhkd->bhqd", a, vr)
-            h = h + _mm(o.swapaxes(1, 2).reshape(n, S0, self.E),
-                        bp["Wo"]) + bp["bo"]
-            x = ln(h, bp["g2"], bp["b2"])
-            h = h + self.mlp(bp, x, li)
-            if self.kv8:
-                k8, ks = self._quant_kv(k, n, S0)
-                v8, vs = self._quant_kv(v, n, S0)
-                Kc = (jnp.zeros((n, Hkv // P, T, P * D), jnp.int8)
-                      .at[:, :, :S0].set(k8),
-                      jnp.zeros((n, Hkv // P, T, P), jnp.float32)
-                      .at[:, :, :S0].set(ks))
-                Vc = (jnp.zeros((n, Hkv // P, T, P * D), jnp.int8)
-                      .at[:, :, :S0].set(v8),
-                      jnp.zeros((n, Hkv // P, T, P), jnp.float32)
-                      .at[:, :, :S0].set(vs))
-            else:
-                Kc = jnp.zeros((n, Hkv // P, T, P * D), k.dtype) \
-                    .at[:, :, :S0].set(self._pack(k, n, S0))
-                Vc = jnp.zeros((n, Hkv // P, T, P * D), v.dtype) \
-                    .at[:, :, :S0].set(self._pack(v, n, S0))
-            caches.append((Kc, Vc))
-        logits0 = _mm(ln(h[:, -1], p["gf"], p["bf"]), p["head"])
-        return logits0, caches
-
-    def token_step(self, p, tok, caches, i, n):
-        """Feed token `tok` (n,) at generated-index `i` (position S0+i)
-        through all blocks against the caches; returns (logits (n, V),
-        new caches)."""
-        import jax
-        import jax.numpy as jnp
-        from jax import lax
-        H, D, E, P = self.H, self.E // self.H, self.E, self.P
-        Hkv, G = self.Hkv, self.G
-        Hp = Hkv // P
-        ln = self.ln
-        pos_idx = self.S0 + i
-        h = p["emb"][tok] + (0 if self.rope else p["pos"][pos_idx])
-        kmask = (jnp.arange(self.T) <= pos_idx)
-        ar = jnp.arange(P)
-        if self.rope:
-            from ..autograd import rope_tables, apply_rope
-            rcos, rsin = rope_tables(pos_idx[None], D, self.rope_theta)
-            rcos, rsin = rcos[0], rsin[0]          # (D,) broadcast
-        new_caches = []
-        for li, ((Kc, Vc), bp) in enumerate(zip(caches, p["blocks"])):
-            x = ln(h, bp["g1"], bp["b1"])
-            q, kn, vn = self.qkv(bp, x, n)   # q (n,H,D); kv (n,Hkv,D)
-            if self.rope:
-                q = apply_rope(q, rcos, rsin)
-                kn = apply_rope(kn, rcos, rsin)
-            # packed caches: one contiguous (P*D)-lane row per token
-            if self.kv8:
-                (K8, Ks), (V8, Vs) = Kc, Vc
-                k8, ks = self._quant_kv(kn[:, :, None], n, 1)
-                v8, vs = self._quant_kv(vn[:, :, None], n, 1)
-                K8 = lax.dynamic_update_slice(K8, k8, (0, 0, pos_idx, 0))
-                Ks = lax.dynamic_update_slice(Ks, ks, (0, 0, pos_idx, 0))
-                V8 = lax.dynamic_update_slice(V8, v8, (0, 0, pos_idx, 0))
-                Vs = lax.dynamic_update_slice(Vs, vs, (0, 0, pos_idx, 0))
-                Kc, Vc = (K8, Ks), (V8, Vs)
-                Kmat, Vmat = K8.astype(x.dtype), V8.astype(x.dtype)
-            else:
-                Kc = lax.dynamic_update_slice(
-                    Kc, kn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
-                Vc = lax.dynamic_update_slice(
-                    Vc, vn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
-                Kmat, Vmat = Kc, Vc
-            # block-diagonal queries: packed slot c holds kv head
-            # (hp*P + c)'s G query rows in block c, zeros elsewhere —
-            # the full-width contraction with the packed K then yields
-            # exactly the per-head scores (GQA: G rows per block; MHA is
-            # the G=1 case)
-            q6 = jnp.moveaxis(q.reshape(n, Hp, P, G, D), 2, 0)
-            Q2 = jnp.zeros((n, Hp, P, G, P, D), q.dtype) \
-                .at[:, :, ar, :, ar, :].set(q6) \
-                .reshape(n, Hp, P * G, P * D)
-            s = jnp.einsum("nhqj,nhtj->nhqt", Q2, Kmat) * self.scale
-            if self.kv8:
-                # K-scales: one factor per (source position, own block)
-                s = s * self._scale_rows(Ks, G)
-            a = jax.nn.softmax(jnp.where(kmask, s, -jnp.inf), axis=-1)
-            if self.kv8:
-                # V-scales fold into the weights for the own-head block
-                # (the only one extracted below)
-                a = (a * self._scale_rows(Vs, G)).astype(x.dtype)
-            O2 = jnp.einsum("nhqt,nhtj->nhqj", a, Vmat)  # (n,Hp,P*G,P*D)
-            o = jnp.moveaxis(
-                O2.reshape(n, Hp, P, G, P, D)[:, :, ar, :, ar, :],
-                0, 2).reshape(n, E)
-            h = h + _mm(o, bp["Wo"]) + bp["bo"]
-            x = ln(h, bp["g2"], bp["b2"])
-            h = h + self.mlp(bp, x, li)
-            new_caches.append((Kc, Vc))
-        logits = _mm(ln(h, p["gf"], p["bf"]), p["head"])
-        return logits, new_caches
-
-
-def _set_col(buf, i, vals):
-    """buf (B,K,L) with column `i` (traced index) set to vals (B,K)."""
-    from jax import lax
-    return lax.dynamic_update_slice_in_dim(
-        buf, vals[..., None], i, axis=2)
-
-
-def _pool_merge(pool_tok, pool_norm, pool_raw, cand_tok, cand_norm,
-                cand_raw, K):
-    """Merge candidate finished hypotheses into the K-slot pool, keeping
-    the K best by normalized score. Shapes: pool (B,K,L)/(B,K); cand
-    (B,kk,L)/(B,kk). Candidates not actually finished carry NEG norm."""
-    import jax.numpy as jnp
-    all_norm = jnp.concatenate([pool_norm, cand_norm], axis=1)
-    all_raw = jnp.concatenate([pool_raw, cand_raw], axis=1)
-    all_tok = jnp.concatenate([pool_tok, cand_tok], axis=1)
-    from jax import lax
-    top_norm, pick = lax.top_k(all_norm, K)
-    new_raw = jnp.take_along_axis(all_raw, pick, axis=1)
-    new_tok = jnp.take_along_axis(all_tok, pick[..., None], axis=1)
-    return new_tok, top_norm, new_raw
-
-
-def _decode_core(m: "GPT", S0, max_new, moe_capacity_factor=None,
-                 kv8=False):
-    H = m.blocks[0].attn.num_heads
-    kv = m.blocks[0].attn.num_kv_heads
-    T = S0 + max_new
-    assert T <= m.max_seq, \
-        f"prompt {S0} + new {max_new} exceeds max_seq {m.max_seq}"
-    # decode-time capacity override: capacity-limited routing is a
-    # batch-global effect, so cached decode == full forward only in the
-    # no-drop regime; a tight TRAINING capacity_factor shouldn't silently
-    # drop tokens at serving time — pass moe_capacity_factor (e.g.
-    # float(num_experts) for guaranteed no drops) to generate()/
-    # generate_beam() to decouple the two.
-    moe_ks = [(b.moe.k, float(moe_capacity_factor
-                              if moe_capacity_factor is not None
-                              else b.moe.capacity_factor))
-              if b.moe_experts else None for b in m.blocks]
-    return _DecodeCore(H, m.dim, S0, T, (m.dim // H) ** -0.5, moe_ks,
-                       kv_heads=kv,
-                       rope=(getattr(m, "pos_encoding", "learned")
-                             == "rope"),
-                       rope_theta=getattr(m, "rope_theta", 10000.0),
-                       kv8=kv8)
 
 
 class _VocabTPMixin:
@@ -593,268 +236,22 @@ class GPT(_VocabTPMixin, model.Model):
     # of O(T^2), no retrace per step, static shapes throughout.
 
     def _decode_raw(self):
-        """Every parameter array the decode consumes — the memo key for
-        the fused/cast decode tree (ids change whenever a load path
-        replaces a param's buffer)."""
-        if not self._pos_init:
-            raise RuntimeError(
-                "generate() needs initialized weights - call "
-                "Model.compile([ids], ...) (or run a forward) first")
-        arrs = [self.tok_embed.W.data,
-                self.ln_f.gamma.data, self.ln_f.beta.data]
-        if self.pos_encoding != "rope":
-            arrs.append(self.pos_embed.data)
-        if self.head is not None:
-            arrs.append(self.head.W.data)
-        for b in self.blocks:
-            arrs += [b.ln1.gamma.data, b.ln1.beta.data,
-                     b.ln2.gamma.data, b.ln2.beta.data,
-                     b.attn.Wq.data, b.attn.Wk.data, b.attn.Wv.data,
-                     b.attn.Wo.data]
-            if b.attn.use_bias:
-                arrs += [b.attn.bq.data, b.attn.bk.data, b.attn.bv.data,
-                         b.attn.bo.data]
-            if b.moe_experts:
-                arrs += [b.moe.Wg.data, b.moe.W1.data, b.moe.b1.data,
-                         b.moe.W2.data, b.moe.b2.data]
-            else:
-                arrs += [b.fc1.W.data, b.fc1.b.data,
-                         b.fc2.W.data, b.fc2.b.data]
-        return arrs
+        return decode_raw(self)
 
     def _decode_state(self, dtype):
-        """Memoized decode-param tree per serving dtype: the QKV fusion,
-        bf16 cast, and int8 quantization run once per weight set instead
-        of on every generate() call (eval weights are static; the memo
-        invalidates when any underlying param buffer is replaced)."""
-        key = tuple(map(id, self._decode_raw()))
-        cached = getattr(self, "_param_cache", None)
-        if cached is None or cached[0] != key:
-            cached = self._param_cache = (key, {})
-        trees = cached[1]
-        if dtype not in trees:
-            trees[dtype] = _cast_params(self._decode_params(), dtype)
-        return trees[dtype]
+        """Memoized decode-param tree (serving.decode_state): QKV fusion
+        + cast/quantize run once per weight set; deterministic
+        invalidation on any param-buffer replacement."""
+        return decode_state(self, dtype)
 
     def _decode_params(self):
-        if not self._pos_init:
-            raise RuntimeError(
-                "generate() needs initialized weights - call "
-                "Model.compile([ids], ...) (or run a forward) first")
-        import jax.numpy as jnp
-        blocks = []
-        zeros = jnp.zeros((self.dim,),
-                          self.blocks[0].attn.Wq.data.dtype)
-        for b in self.blocks:
-            ab = b.attn.use_bias
-            bp = {
-                "g1": b.ln1.gamma.data, "b1": b.ln1.beta.data,
-                # fused QKV: one (E,3E) weight stream per block instead of
-                # three — fewer ops on the bandwidth-bound decode path
-                "Wqkv": jnp.concatenate(
-                    [b.attn.Wq.data, b.attn.Wk.data, b.attn.Wv.data],
-                    axis=1),
-                "bqkv": jnp.concatenate(
-                    [b.attn.bq.data, b.attn.bk.data, b.attn.bv.data])
-                if ab else jnp.zeros(
-                    (b.attn.Wq.shape[1] + b.attn.Wk.shape[1]
-                     + b.attn.Wv.shape[1],), zeros.dtype),
-                "Wo": b.attn.Wo.data,
-                "bo": b.attn.bo.data if ab else zeros,
-                "g2": b.ln2.gamma.data, "b2": b.ln2.beta.data,
-            }
-            if b.moe_experts:
-                # routing degree/capacity stay STATIC on _DecodeCore
-                # (moe_ks), not in the traced param tree
-                bp.update({
-                    "moeWg": b.moe.Wg.data,
-                    "moeW1": b.moe.W1.data, "moeb1": b.moe.b1.data,
-                    "moeW2": b.moe.W2.data, "moeb2": b.moe.b2.data,
-                })
-            else:
-                bp.update({
-                    "W1": b.fc1.W.data, "bb1": b.fc1.b.data,
-                    "W2": b.fc2.W.data, "bb2": b.fc2.b.data,
-                })
-            blocks.append(bp)
-        emb = self.tok_embed.W.data
-        if self.vocab_tp:
-            # tied head, truncated to the true vocab so padded rows (never
-            # trained toward anything) cannot win an argmax during decode
-            head = emb[:self.vocab_size].T
-        else:
-            head = self.head.W.data
-        return {
-            "emb": emb,
-            "pos": (jnp.zeros((self.max_seq, 0), emb.dtype)
-                    if self.pos_encoding == "rope"
-                    else self.pos_embed.data),
-            "gf": self.ln_f.gamma.data, "bf": self.ln_f.beta.data,
-            "head": head, "blocks": blocks,
-        }
+        return decode_params(self)
 
-    def _build_decode(self, B, S0, max_new, temperature, top_k,
-                      dtype=None, moe_capacity_factor=None,
-                      kv_dtype=None):
-        import jax
-        import jax.numpy as jnp
-        from jax import lax
+    def _build_decode(self, *args, **kwargs):
+        return build_decode(self, *args, **kwargs)
 
-        core = _decode_core(self, S0, max_new, moe_capacity_factor,
-                            kv8=(kv_dtype == "int8"))
-
-        def sample(logits, key):
-            logits = logits.astype(jnp.float32)
-            if temperature == 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = logits / temperature
-            if top_k is not None:
-                kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            return jax.random.categorical(key, logits).astype(jnp.int32)
-
-        def decode(p, prompt, key):
-            # p arrives pre-cast/quantized (_decode_state memo)
-            logits0, caches = core.prefill(p, prompt, B)
-            key, sub = jax.random.split(key)
-            tok0 = sample(logits0, sub)                   # (B,)
-
-            # ---- decode: one token per scan step, O(T) attention ----
-            def step(carry, i):
-                tok, caches, key = carry
-                logits, caches = core.token_step(p, tok, caches, i, B)
-                key, sub = jax.random.split(key)
-                nxt = sample(logits, sub)
-                return (nxt, caches, key), nxt
-
-            if max_new > 1:
-                (_, _, _), toks = lax.scan(
-                    step, (tok0, caches, key), jnp.arange(max_new - 1))
-                toks = jnp.concatenate([tok0[:, None], toks.T], axis=1)
-            else:
-                toks = tok0[:, None]
-            return jnp.concatenate([prompt, toks], axis=1)
-
-        return jax.jit(decode)
-
-    def _build_beam_decode(self, B, S0, max_new, num_beams, length_penalty,
-                           eos_id, dtype, pad_id=None,
-                           moe_capacity_factor=None, kv_dtype=None):
-        import jax
-        import jax.numpy as jnp
-        from jax import lax
-
-        V = self.vocab_size
-        K = num_beams
-        core = _decode_core(self, S0, max_new, moe_capacity_factor,
-                            kv8=(kv_dtype == "int8"))
-        NEG = jnp.float32(-1e9)
-        pad = 0 if eos_id is None else (pad_id if pad_id is not None
-                                        else eos_id)
-
-        def norm_len(score, length):
-            return score / (length.astype(jnp.float32) ** length_penalty)
-
-        def decode(p, prompt):
-            # p arrives pre-cast/quantized (_decode_state memo)
-            # ---- prefill on the B prompts, then tile caches to B*K ----
-            logits0, caches = core.prefill(p, prompt, B)
-            # beam b*K+k from prompt b (tree-map: kv8 caches are
-            # (int8, scales) tuples)
-            caches = jax.tree.map(lambda a: jnp.repeat(a, K, axis=0),
-                                  caches)
-            logp0 = jax.nn.log_softmax(
-                logits0.astype(jnp.float32), axis=-1)     # (B,V)
-            tokens = jnp.full((B, K, max_new), pad, jnp.int32)
-            # finished-hypothesis pool (HF-style): finished beams move
-            # here with a length-normalized score and stop competing by
-            # raw score against still-growing beams
-            pool_tok = jnp.full((B, K, max_new), pad, jnp.int32)
-            pool_norm = jnp.full((B, K), NEG)
-            pool_raw = jnp.full((B, K), NEG)
-
-            if eos_id is None:
-                s0, t0 = lax.top_k(logp0, K)              # (B,K)
-                alive_scores = s0
-                tokens = tokens.at[:, :, 0].set(t0)
-            else:
-                # consider 2K candidates so K alive beams survive even if
-                # eos ranks high
-                kk = min(2 * K, V)
-                cs, ct = lax.top_k(logp0, kk)             # (B,kk)
-                is_eos = ct == eos_id
-                # finished at length 1 -> pool
-                cand_pool_tok = jnp.broadcast_to(
-                    jnp.full((max_new,), pad, jnp.int32)
-                    .at[0].set(eos_id)[None, None],
-                    (B, kk, max_new))
-                pool_tok, pool_norm, pool_raw = _pool_merge(
-                    pool_tok, pool_norm, pool_raw,
-                    cand_pool_tok,
-                    jnp.where(is_eos, norm_len(cs, jnp.asarray(1)), NEG),
-                    cs, K)
-                # alive beams: best K non-eos
-                alive_cs = jnp.where(is_eos, NEG, cs)
-                s0, pick = lax.top_k(alive_cs, K)         # (B,K) of [0,kk)
-                t0 = jnp.take_along_axis(ct, pick, axis=1)
-                alive_scores = s0
-                tokens = tokens.at[:, :, 0].set(t0)
-
-            def step(carry, i):
-                tokens, scores, caches, pool_tok, pool_norm, pool_raw = \
-                    carry
-                tok = lax.dynamic_index_in_dim(
-                    tokens, i, axis=2, keepdims=False)    # (B,K)
-                logits, caches = core.token_step(
-                    p, tok.reshape(B * K), caches, i, B * K)
-                logp = jax.nn.log_softmax(
-                    logits.astype(jnp.float32), axis=-1).reshape(B, K, V)
-                total = scores[..., None] + logp          # (B,K,V)
-                flat = total.reshape(B, K * V)
-                kk = min(2 * K, K * V)
-                cs, idx = lax.top_k(flat, kk)             # (B,kk)
-                beam_idx = idx // V
-                cand_tok = (idx % V).astype(jnp.int32)
-                gather = jnp.take_along_axis
-                cand_hist = gather(tokens, beam_idx[..., None], axis=1)
-                cand_hist = _set_col(cand_hist, i + 1, cand_tok)
-
-                if eos_id is not None:
-                    is_eos = cand_tok == eos_id
-                    pool_tok, pool_norm, pool_raw = _pool_merge(
-                        pool_tok, pool_norm, pool_raw, cand_hist,
-                        jnp.where(is_eos,
-                                  norm_len(cs, jnp.asarray(i + 2)), NEG),
-                        cs, K)
-                    cs = jnp.where(is_eos, NEG, cs)
-                new_scores, pick = lax.top_k(cs, K)       # (B,K)
-                keep_beam = gather(beam_idx, pick, axis=1)
-                tokens = gather(cand_hist, pick[..., None], axis=1)
-                src = (jnp.arange(B)[:, None] * K
-                       + keep_beam).reshape(B * K)        # flat rows
-                caches = jax.tree.map(lambda a: a[src], caches)
-                return (tokens, new_scores, caches,
-                        pool_tok, pool_norm, pool_raw), None
-
-            carry = (tokens, alive_scores, caches,
-                     pool_tok, pool_norm, pool_raw)
-            if max_new > 1:
-                carry, _ = lax.scan(step, carry, jnp.arange(max_new - 1))
-            tokens, scores, _, pool_tok, pool_norm, pool_raw = carry
-
-            # final selection: best of {pool, alive} by normalized score
-            alive_norm = norm_len(scores, jnp.asarray(max_new))
-            all_norm = jnp.concatenate([pool_norm, alive_norm], axis=1)
-            all_raw = jnp.concatenate([pool_raw, scores], axis=1)
-            all_tok = jnp.concatenate([pool_tok, tokens], axis=1)
-            best = jnp.argmax(all_norm, axis=1)           # (B,)
-            out = jnp.take_along_axis(
-                all_tok, best[:, None, None], axis=1)[:, 0]
-            best_score = jnp.take_along_axis(
-                all_raw, best[:, None], axis=1)[:, 0]
-            return jnp.concatenate([prompt, out], axis=1), best_score
-
-        return jax.jit(decode)
+    def _build_beam_decode(self, *args, **kwargs):
+        return build_beam_decode(self, *args, **kwargs)
 
     def generate_beam(self, prompt, max_new_tokens, num_beams=4,
                       length_penalty=1.0, eos_id=None, pad_id=None,
@@ -951,14 +348,18 @@ def _fn_layernorm(x, g, b, eps=1e-5):
     return (x - m) * lax.rsqrt(v + eps) * g + b
 
 
-def _fn_block(params, h, num_heads, tp_axis=None, num_kv_heads=None):
+def _fn_block(params, h, num_heads, tp_axis=None, num_kv_heads=None,
+              rope=None):
     """Functional pre-LN transformer block; h (B, S, E) replicated over
     `tp_axis`. With tp: Wq/Wk/Wv/W1 arrive column-sharded (local heads =
     num_heads/tp), Wo/W2 row-sharded — the Megatron layout, two psums per
     block, expressed with custom_vjp f/g so the block stays correct under
     both autodiff-through-scan (GPipe) and explicit vjp (1F1B engine).
     `num_kv_heads` < num_heads is GQA: Wk/Wv are (E, Hkv*D) and each kv
-    head serves num_heads/Hkv query heads (repeat before flash)."""
+    head serves num_heads/Hkv query heads (repeat before flash).
+    `rope`: (cos, sin) (S, D) tables — rotate q/k per position (matches
+    the GPT layer path, so rope PipelinedGPT weights transfer to a rope
+    GPT for serving)."""
     import jax
     import jax.numpy as jnp
     from ..ops.attention import flash_attention
@@ -978,6 +379,11 @@ def _fn_block(params, h, num_heads, tp_axis=None, num_kv_heads=None):
     q = (x @ Wq).reshape(B, S, heads, -1).transpose(0, 2, 1, 3)
     k = (x @ Wk).reshape(B, S, kv_heads, -1).transpose(0, 2, 1, 3)
     v = (x @ Wv).reshape(B, S, kv_heads, -1).transpose(0, 2, 1, 3)
+    if rope is not None:
+        from ..autograd import apply_rope
+        rcos, rsin = rope
+        q = apply_rope(q, rcos, rsin)
+        k = apply_rope(k, rcos, rsin)
     if grp > 1:
         k = jnp.repeat(k, grp, axis=1)
         v = jnp.repeat(v, grp, axis=1)
@@ -996,7 +402,8 @@ def _fn_block(params, h, num_heads, tp_axis=None, num_kv_heads=None):
     return h + y + bb2
 
 
-def _fn_block_moe(params, h, num_heads, k, capacity_factor, ep_axis=None):
+def _fn_block_moe(params, h, num_heads, k, capacity_factor, ep_axis=None,
+                  rope=None):
     """Pre-LN transformer block whose MLP is a top-k MoE FFN (PP x EP
     composition, VERDICT r3 #6). Expert weights arrive REPLICATED over
     the ep axis (the layer-MoE convention, layer.py _MoEOp): when
@@ -1018,6 +425,11 @@ def _fn_block_moe(params, h, num_heads, k, capacity_factor, ep_axis=None):
     q = (x @ Wq).reshape(B, S, num_heads, -1).transpose(0, 2, 1, 3)
     kk = (x @ Wk).reshape(B, S, num_heads, -1).transpose(0, 2, 1, 3)
     v = (x @ Wv).reshape(B, S, num_heads, -1).transpose(0, 2, 1, 3)
+    if rope is not None:
+        from ..autograd import apply_rope
+        rcos, rsin = rope
+        q = apply_rope(q, rcos, rsin)
+        kk = apply_rope(kk, rcos, rsin)
     o = flash_attention(q, kk, v, True)
     h = h + o.transpose(0, 2, 1, 3).reshape(B, S, -1) @ Wo
     x = _fn_layernorm(h, g2, b2)
@@ -1043,7 +455,7 @@ def _fn_block_moe(params, h, num_heads, k, capacity_factor, ep_axis=None):
 
 
 def _make_stage_fn_moe(num_heads, axis, total_layers, k, capacity_factor,
-                       ep_axis=None):
+                       ep_axis=None, rope_cfg=None):
     """MoE variant of _make_stage_fn: stage_fn returns (x, aux) with
     aux = [load-balance, z-loss] summed over this stage's REAL layers
     (padding layers contribute zero)."""
@@ -1054,11 +466,12 @@ def _make_stage_fn_moe(num_heads, axis, total_layers, k, capacity_factor,
         per = local_stacks[0].shape[0]
         s = lax.axis_index(axis)
         aux_acc = jnp.zeros((2,), jnp.float32)
+        rope = _rope_tables_for(rope_cfg, x.shape[1])
         for li in range(per):
             on = (s * per + li) < total_layers
             y, aux, z = _fn_block_moe([st[li] for st in local_stacks], x,
                                       num_heads, k, capacity_factor,
-                                      ep_axis)
+                                      ep_axis, rope)
             x = jnp.where(on, y, x)
             gate = on.astype(jnp.float32)
             aux_acc = aux_acc + gate * jnp.stack(
@@ -1068,8 +481,20 @@ def _make_stage_fn_moe(num_heads, axis, total_layers, k, capacity_factor,
     return stage_fn
 
 
+def _rope_tables_for(rope_cfg, S):
+    """(cos, sin) (S, D) tables for positions [0, S) when rope_cfg =
+    (theta, head_dim) is set (pipeline microbatches always carry the full
+    sequence, so positions are simply arange(S)); None passthrough."""
+    if rope_cfg is None:
+        return None
+    import jax.numpy as jnp
+    from ..autograd import rope_tables
+    theta, hd = rope_cfg
+    return rope_tables(jnp.arange(S), hd, theta)
+
+
 def _make_chunk_fn(num_heads, axis, total_layers, pc, tp_axis=None,
-                   num_kv_heads=None):
+                   num_kv_heads=None, rope_cfg=None):
     """Chunk-aware stage application for the interleaved schedule: this
     device's local stack rows [c*pc, (c+1)*pc) are virtual chunk `c`
     (global pipeline stage c*n + d), so global layer (c*n+d)*pc + j
@@ -1085,12 +510,14 @@ def _make_chunk_fn(num_heads, axis, total_layers, pc, tp_axis=None,
         # since flat index c*(n*pc) + d*pc + j = ((c*n+d)*pc + j))
         n = lax.axis_size(axis)
         d = lax.axis_index(axis)
+        rope = _rope_tables_for(rope_cfg, x.shape[1])
         for j in range(pc):
             params = [lax.dynamic_index_in_dim(st, c, 0,
                                                keepdims=False)[j]
                       for st in local_stacks]
             on = ((c * n + d) * pc + j) < total_layers
-            y = _fn_block(params, x, num_heads, tp_axis, num_kv_heads)
+            y = _fn_block(params, x, num_heads, tp_axis, num_kv_heads,
+                          rope)
             x = jnp.where(on, y, x)
         return x
 
@@ -1098,7 +525,7 @@ def _make_chunk_fn(num_heads, axis, total_layers, pc, tp_axis=None,
 
 
 def _make_stage_fn(num_heads, axis, total_layers, tp_axis=None,
-                   num_kv_heads=None):
+                   num_kv_heads=None, rope_cfg=None):
     """Per-stage block application with non-uniform stage support: local
     stacks carry padded_layers/n rows; rows whose GLOBAL index (stage*per +
     li) >= total_layers are padding (zero-init, never trained) and are
@@ -1111,10 +538,11 @@ def _make_stage_fn(num_heads, axis, total_layers, tp_axis=None,
     def stage_fn(local_stacks, x):
         per = local_stacks[0].shape[0]
         s = lax.axis_index(axis)
+        rope = _rope_tables_for(rope_cfg, x.shape[1])
         for li in range(per):
             on = (s * per + li) < total_layers
             y = _fn_block([st[li] for st in local_stacks], x, num_heads,
-                          tp_axis, num_kv_heads)
+                          tp_axis, num_kv_heads, rope)
             x = jnp.where(on, y, x)
         return x
 
@@ -1128,7 +556,7 @@ class _PipelineBlocks(autograd.Operator):
 
     def __init__(self, num_heads, axis=None, n_micro=1, total_layers=None,
                  tp_axis=None, interleave=1, pc=None, moe=None,
-                 num_kv_heads=None):
+                 num_kv_heads=None, rope_cfg=None):
         super().__init__("PipelineBlocks")
         self.num_heads = num_heads
         self.num_kv_heads = num_kv_heads
@@ -1139,6 +567,7 @@ class _PipelineBlocks(autograd.Operator):
         self.interleave = interleave
         self.pc = pc          # layers per virtual chunk (interleave > 1)
         self.moe = moe        # (k, capacity_factor, ep_axis) or None
+        self.rope_cfg = rope_cfg  # (theta, head_dim) or None
 
     def forward(self, h, *stacks):
         import jax.numpy as jnp
@@ -1159,7 +588,8 @@ class _PipelineBlocks(autograd.Operator):
                 k, cf, ep = self.moe
                 ep = ep if (ep is not None and autograd.axis_bound(ep)) \
                     else None
-                stage_fn = _make_stage_fn_moe(nh, self.axis, L, k, cf, ep)
+                stage_fn = _make_stage_fn_moe(nh, self.axis, L, k, cf, ep,
+                                              self.rope_cfg)
                 outs, auxv = gpipe(stage_fn, list(stacks), x_micro,
                                    self.axis, with_aux=True)
                 outs = bcast_from_last(self.axis, outs)
@@ -1171,12 +601,12 @@ class _PipelineBlocks(autograd.Operator):
                         auxv[0], auxv[1])
             if self.interleave > 1:
                 chunk_fn = _make_chunk_fn(nh, self.axis, L, self.pc, tp,
-                                          self.num_kv_heads)
+                                          self.num_kv_heads, self.rope_cfg)
                 outs = gpipe_interleaved(chunk_fn, list(stacks), x_micro,
                                          self.axis, self.interleave)
             else:
                 stage_fn = _make_stage_fn(nh, self.axis, L, tp,
-                                          self.num_kv_heads)
+                                          self.num_kv_heads, self.rope_cfg)
                 outs = gpipe(stage_fn, list(stacks), x_micro, self.axis)
             outs = bcast_from_last(self.axis, outs)
             return outs.reshape(B, *h.shape[1:])
@@ -1186,19 +616,20 @@ class _PipelineBlocks(autograd.Operator):
         # skipped entirely
         if self.interleave > 1:
             stacks = [s.reshape((-1,) + s.shape[2:]) for s in stacks]
+        rope = _rope_tables_for(self.rope_cfg, h.shape[1])
         if self.moe is not None:
             k, cf, _ = self.moe
             aux_t = jnp.zeros((), jnp.float32)
             z_t = jnp.zeros((), jnp.float32)
             for g in range(L):
                 h, aux, z = _fn_block_moe([s[g] for s in stacks], h, nh,
-                                          k, cf, None)
+                                          k, cf, None, rope)
                 aux_t = aux_t + aux.astype(jnp.float32)
                 z_t = z_t + z.astype(jnp.float32)
             return h, aux_t, z_t
         for g in range(L):
             h = _fn_block([s[g] for s in stacks], h, nh,
-                          num_kv_heads=self.num_kv_heads)
+                          num_kv_heads=self.num_kv_heads, rope=rope)
         return h
 
 
@@ -1220,8 +651,10 @@ class _Pipeline1F1B(autograd.Operator):
     the pipeline blocks. Keep every loss term inside last_fn."""
 
     def __init__(self, num_heads, axis, n_micro, total_layers,
-                 tp_axis=None, tied_vocab=None, num_kv_heads=None):
+                 tp_axis=None, tied_vocab=None, num_kv_heads=None,
+                 rope_cfg=None):
         super().__init__("Pipeline1F1B")
+        self.rope_cfg = rope_cfg
         self.num_heads = num_heads
         self.num_kv_heads = num_kv_heads
         self.axis = axis
@@ -1249,7 +682,7 @@ class _Pipeline1F1B(autograd.Operator):
         tgt_micro = tgt.reshape(nm, B // nm, S)
         stage_fn = _make_stage_fn(self.num_heads, self.axis,
                                   self.total_layers, tp,
-                                  self.num_kv_heads)
+                                  self.num_kv_heads, self.rope_cfg)
         tied = self.tied_vocab is not None
 
         def last_fn(lp, y, t):
@@ -1432,6 +865,10 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
     def _n_stages(self):
         return self._mesh_axis_size(self.pipeline_axis)
 
+    def _rope_cfg(self):
+        return (self.rope_theta, self.dim // self.num_heads) \
+            if self.pos_encoding == "rope" else None
+
     def _blocks_op(self):
         moe = (self.moe_k, float(self.moe_capacity_factor), self.ep_axis) \
             if self.moe_experts else None
@@ -1439,7 +876,7 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
             self.num_heads, self.pipeline_axis, self.n_micro,
             self.num_layers, self.tp_axis, interleave=self.interleave,
             pc=getattr(self, "_chunk_layers", None), moe=moe,
-            num_kv_heads=self.num_kv_heads)
+            num_kv_heads=self.num_kv_heads, rope_cfg=self._rope_cfg())
 
     def _init_stacks(self, dev):
         import numpy as np
@@ -1530,13 +967,18 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
             if not hasattr(self, "pipeline_axis"):
                 self.pipeline_axis, self.n_micro = None, 1
             self._init_stacks(h.device)
-            p = Tensor((self.max_seq, self.dim), device=h.device,
-                       dtype=float32)
-            p.gaussian(0.0, 0.02)
-            self._register_param("pos_embed", p)
-        S = ids.shape[1]
-        pos = _PosSlice(S)(self.pos_embed)
-        h = autograd.add(h, autograd.expand(pos, h.shape))
+            if self.pos_encoding != "rope":
+                p = Tensor((self.max_seq, self.dim), device=h.device,
+                           dtype=float32)
+                p.gaussian(0.0, 0.02)
+                self._register_param("pos_embed", p)
+        if self.pos_encoding != "rope":
+            # rope: positions live in the per-block q/k rotation (stage
+            # fns apply _rope_tables_for); no learned table exists, so
+            # rope-trained stacks transfer to a rope GPT for serving
+            S = ids.shape[1]
+            pos = _PosSlice(S)(self.pos_embed)
+            h = autograd.add(h, autograd.expand(pos, h.shape))
         if self.pipeline_axis is not None and \
                 autograd.axis_bound(self.pipeline_axis):
             # Megatron-f on the pipeline input: dL/dh is nonzero only on
@@ -1615,7 +1057,7 @@ class PipelinedGPT(_VocabTPMixin, model.Model):
                 self.num_heads, self.pipeline_axis, self.n_micro,
                 self.num_layers, self.tp_axis,
                 tied_vocab=self.vocab_size if self.vocab_tp else None,
-                num_kv_heads=self.num_kv_heads)
+                num_kv_heads=self.num_kv_heads, rope_cfg=self._rope_cfg())
             loss, outs = op(h, targets, self.ln_f.gamma, self.ln_f.beta,
                             headW,
                             *[getattr(self, a) for a in self._stack_attrs])

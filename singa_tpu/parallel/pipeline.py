@@ -65,7 +65,14 @@ def gpipe(stage_fn, stage_params, x_micro, axis_name, with_aux=False):
         buf = lax.ppermute(y, axis_name, perm)
         return (buf, outs, aux_acc), None
 
-    aux0 = jnp.zeros((2,), jnp.float32)
+    if with_aux:
+        # derive the aux accumulator's shape/dtype from stage_fn itself
+        # (not a hardcoded (2,) float32): any fixed-shape aux works
+        import jax
+        _, aux_sd = jax.eval_shape(stage_fn, stage_params, x_micro[0])
+        aux0 = jnp.zeros(aux_sd.shape, aux_sd.dtype)
+    else:
+        aux0 = jnp.zeros((), jnp.float32)
     (buf, outs, aux_acc), _ = lax.scan(step, (buf, outs, aux0),
                                        jnp.arange(steps))
     return (outs, aux_acc) if with_aux else outs

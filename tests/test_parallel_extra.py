@@ -801,3 +801,95 @@ def test_pp_gqa_gpt_matches_serial():
         assert abs(float(l_ser.numpy()) - float(l_pp.numpy())) < 2e-3, \
             (schedule, float(l_ser.numpy()), float(l_pp.numpy()))
         m_ser.set_params(w0)
+
+
+def test_pp_rope_gpt_matches_serial_and_transfers():
+    """pos_encoding="rope" on PipelinedGPT (ADVICE r4): the stage fns
+    rotate q/k per block with the global position tables, NO learned
+    position table exists, and the trained stacks transfer to a serial
+    rope GPT (same loss trajectory) — the exact property the silently-
+    ignored flag used to break."""
+    from singa_tpu import models, opt, tensor
+    from singa_tpu.device import get_default_device
+
+    dev = get_default_device()
+    rng = np.random.RandomState(41)
+    V, B, S, L = 40, 8, 8, 4
+    ids = rng.randint(0, V, (B, S)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    tx = tensor.from_numpy(ids, dev)
+    ty = tensor.from_numpy(tgt, dev)
+
+    def build(schedule=None):
+        m = models.create_model("gpt_pipe", vocab_size=V, max_seq=S,
+                                dim=16, num_heads=2, num_layers=L,
+                                pos_encoding="rope")
+        if schedule:
+            mesh = make_mesh({"data": 1, "pp": 4})
+            m.set_optimizer(opt.DistOpt(opt.SGD(lr=0.05), axis="data",
+                                        mesh=mesh))
+            m.compile([tx], is_train=True, use_graph=True,
+                      pipeline_axis="pp", n_micro=4,
+                      pipeline_schedule=schedule)
+        else:
+            m.set_optimizer(opt.SGD(lr=0.05))
+            m.compile([tx], is_train=True, use_graph=True)
+        return m
+
+    m_ser = build()
+    # rope: no learned position table at all
+    assert "pos_embed" not in m_ser.get_params()
+    w0 = {k: v.numpy().copy() for k, v in m_ser.get_params().items()}
+    for schedule in ("gpipe", "1f1b"):
+        m_pp = build(schedule)
+        assert "pos_embed" not in m_pp.get_params()
+        m_pp.set_params(w0)
+        for _ in range(3):
+            _, l_ser = m_ser(tx, ty)
+            _, l_pp = m_pp(tx, ty)
+        assert abs(float(l_ser.numpy()) - float(l_pp.numpy())) < 2e-3, \
+            (schedule, float(l_ser.numpy()), float(l_pp.numpy()))
+        m_ser.set_params(w0)
+
+    # rope result differs from a learned-position model (the old bug made
+    # them identical): same seed/weights, different positional mechanism
+    m_learned = models.create_model("gpt_pipe", vocab_size=V, max_seq=S,
+                                    dim=16, num_heads=2, num_layers=L)
+    m_learned.set_optimizer(opt.SGD(lr=0.05))
+    m_learned.compile([tx], is_train=True, use_graph=True)
+    m_learned.set_params({k: v for k, v in w0.items()})
+    _, l_rope = m_ser(tx, ty)
+    _, l_learn = m_learned(tx, ty)
+    assert abs(float(l_rope.numpy()) - float(l_learn.numpy())) > 1e-5
+
+    # weight TRANSFER: the pipelined rope stacks load into a serial rope
+    # GPT (per-block params) and reproduce the same loss trajectory
+    gpt = models.create_model("gpt", vocab_size=V, max_seq=S, dim=16,
+                              num_heads=2, num_layers=L,
+                              pos_encoding="rope")
+    gpt.set_optimizer(opt.SGD(lr=0.05))
+    gpt.compile([tx], is_train=True, use_graph=True)
+    m_ser.set_params(w0)
+    stacks = {k: np.asarray(v) for k, v in w0.items()}
+    for i, blk in enumerate(gpt.blocks):
+        blk.ln1.gamma.copy_from_numpy(stacks["g1"][i])
+        blk.ln1.beta.copy_from_numpy(stacks["b1"][i])
+        blk.ln2.gamma.copy_from_numpy(stacks["g2"][i])
+        blk.ln2.beta.copy_from_numpy(stacks["b2"][i])
+        blk.attn.Wq.copy_from_numpy(stacks["Wq"][i])
+        blk.attn.Wk.copy_from_numpy(stacks["Wk"][i])
+        blk.attn.Wv.copy_from_numpy(stacks["Wv"][i])
+        blk.attn.Wo.copy_from_numpy(stacks["Wo"][i])
+        blk.fc1.W.copy_from_numpy(stacks["W1"][i])
+        blk.fc1.b.copy_from_numpy(stacks["bb1"][i])
+        blk.fc2.W.copy_from_numpy(stacks["W2"][i])
+        blk.fc2.b.copy_from_numpy(stacks["bb2"][i])
+    gpt.tok_embed.W.copy_from_numpy(stacks["tok_embed.W"])
+    gpt.ln_f.gamma.copy_from_numpy(stacks["ln_f.gamma"])
+    gpt.ln_f.beta.copy_from_numpy(stacks["ln_f.beta"])
+    gpt.head.W.copy_from_numpy(stacks["head.W"])
+    for _ in range(2):
+        _, l_pipe = m_ser(tx, ty)
+        _, l_gpt = gpt(tx, ty)
+    assert abs(float(l_pipe.numpy()) - float(l_gpt.numpy())) < 2e-3, \
+        (float(l_pipe.numpy()), float(l_gpt.numpy()))
