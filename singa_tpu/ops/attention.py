@@ -108,7 +108,7 @@ def _maybe_when(cond, fn):
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       acc_ref, m_ref, l_ref, *,
-                      nk, block_q, block_k, causal, scale):
+                      nk, block_q, block_k, causal):
     """Grid: (batch*heads, q_blocks, k_blocks) — K/V blocks STREAM through
     VMEM one (block_k, D) tile at a time (no whole-row residency, so
     sequence length is bounded by HBM, not VMEM). The online-softmax state
@@ -123,13 +123,24 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # causal: skip K blocks strictly above the diagonal of this Q block
+    # causal: skip K blocks strictly above the diagonal of this Q block.
+    # COMPUTE is gated here; the DMA for those blocks is skipped too —
+    # _causal_clamp maps their BlockSpec index to the diagonal block, and
+    # Pallas TPU elides the copy when the block index doesn't change
+    # between grid steps.
     needed = (kb * block_k <= qi * block_q + block_q - 1) if causal else True
 
     def _update():
-        q = q_ref[0].astype(jnp.float32) * scale       # (Bq, D)
-        k_blk = k_ref[0].astype(jnp.float32)           # (Bk, D)
-        v_blk = v_ref[0].astype(jnp.float32)
+        # dots run in the INPUT dtype (bf16 inputs → native MXU rate;
+        # upcasting to f32 first would run the matmul at the ~4x-slower
+        # fp32 rate) and accumulate f32 via preferred_element_type; the
+        # softmax/stats stay in f32. q arrives PRE-SCALED (the wrapper
+        # folds the softmax scale into q, where XLA fuses it for free —
+        # an in-kernel multiply would cost a VPU pass over the full
+        # score tile every grid step).
+        q = q_ref[0]                                   # (Bq, D), scaled
+        k_blk = k_ref[0]                               # (Bk, D)
+        v_blk = v_ref[0]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
             s = s + _causal_mask(block_q, block_k, q_off=qi * block_q,
@@ -140,7 +151,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         corr = jnp.exp(m_prev - m_new)
         l_new = l_ref[...][:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * corr + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32)
+            p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new, (block_q, _STAT_LANES))
         l_ref[...] = jnp.broadcast_to(l_new, (block_q, _STAT_LANES))
 
@@ -154,25 +166,58 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                                       (block_q, _STAT_LANES))
 
 
+def _causal_kv_map(causal, block_q, block_k, nk):
+    """K/V BlockSpec index map for grids with kb innermost after the q
+    block index. Causal: kb is CLAMPED to this q block's diagonal block,
+    so every fully-masked step re-addresses the last needed block and
+    Pallas skips the DMA (the copy only fires when the block index
+    changes) — masked K/V tiles are neither computed nor streamed."""
+    if not causal:
+        return lambda i, j, kb: (i, kb, 0)
+
+    def kmap(i, j, kb):
+        last = jnp.minimum(((j + 1) * block_q - 1) // block_k, nk - 1)
+        return (i, jnp.minimum(kb, last), 0)
+
+    return kmap
+
+
+def _causal_q_map(causal, block_q, block_k):
+    """Q-side BlockSpec index map for the dK/dV grid (bh, kb, j): causal
+    clamps j UP to the first unmasked q block for kb, so the leading
+    masked steps address the same tile and their DMA is elided."""
+    if not causal:
+        return lambda i, kb, j: (i, j, 0)
+
+    def qmap(i, kb, j):
+        first = (kb * block_k) // block_q
+        return (i, jnp.maximum(j, first), 0)
+
+    return qmap
+
+
 def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bh = b * h
-    qf = q.reshape(bh, sq, d)
+    # fold the softmax scale into q here: XLA fuses the multiply into
+    # whatever produced q, so the kernel never spends a VPU pass on it
+    qf = (q * scale).astype(q.dtype).reshape(bh, sq, d)
     kf = k.reshape(bh, sk, d)
     vf = v.reshape(bh, sk, d)
     nk = sk // block_k
     grid = (bh, sq // block_q, nk)
     kernel = functools.partial(
         _flash_fwd_kernel, nk=nk, block_q=block_q, block_k=block_k,
-        causal=causal, scale=scale)
+        causal=causal)
+    kvmap = _causal_kv_map(causal, block_q, block_k, nk)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), kvmap),
+            pl.BlockSpec((1, block_k, d), kvmap),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
@@ -207,18 +252,22 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     needed = (kb * block_k <= qi * block_q + block_q - 1) if causal else True
 
     def _update():
-        qs = q_ref[0].astype(jnp.float32) * scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        s = jnp.dot(qs, k_blk.T, preferred_element_type=jnp.float32)
+        # native-dtype MXU dots (see fwd kernel); ds is rounded to the
+        # input dtype for its matmul, standard flash-2 practice. q
+        # arrives PRE-SCALED, so s matches the forward's lse directly;
+        # the true dL/dq = scale * ds @ k is applied at _finish.
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        do = do_ref[0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
             s = s + _causal_mask(block_q, block_k, q_off=qi * block_q,
                                  k_off=kb * block_k)
         p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1])
-        dq_acc[...] += jnp.dot(ds, k_blk,
+        dq_acc[...] += jnp.dot(ds.astype(k_blk.dtype), k_blk,
                                preferred_element_type=jnp.float32)
 
     _maybe_when(needed, _update)
@@ -230,7 +279,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, nq, block_q,
-                          block_k, causal, scale):
+                          block_k, causal):
     """Grid (bh, k_blocks, q_blocks): accumulate dK/dV over streamed Q."""
     kb = pl.program_id(1)
     qi = pl.program_id(2)
@@ -243,20 +292,24 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     needed = (qi * block_q + block_q - 1 >= kb * block_k) if causal else True
 
     def _update():
-        qs = q_ref[0].astype(jnp.float32) * scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        s = jnp.dot(qs, k_blk.T, preferred_element_type=jnp.float32)
+        # native-dtype MXU dots; p/ds rounded to the input dtype for
+        # their matmuls (flash-2 practice). q arrives PRE-SCALED, so
+        # dk = ds.T @ q_scaled IS the true scale * ds.T @ q — no extra
+        # multiply anywhere.
+        q = q_ref[0]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        do = do_ref[0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
             s = s + _causal_mask(block_q, block_k, q_off=qi * block_q,
                                  k_off=kb * block_k)
         p = jnp.exp(s - lse_ref[0][:, :1])                 # (Bq, Bk)
-        dv_acc[...] += jnp.dot(p.T, do,
+        dv_acc[...] += jnp.dot(p.astype(do.dtype).T, do,
                                preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1])
-        dk_acc[...] += jnp.dot(ds.T, qs,
+        dk_acc[...] += jnp.dot(ds.astype(q.dtype).T, q,
                                preferred_element_type=jnp.float32)
 
     _maybe_when(needed, _update)
@@ -265,6 +318,117 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _finish():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                            delta_ref, dq_ref, dk_ref, dv_ref,
+                            dq_acc, dk_acc, dv_acc, *, nq, nk, block_q,
+                            block_k, causal, scale):
+    """Single-pass backward: grid (bh, k_blocks, q_blocks) computes
+    s/p/ds ONCE per tile pair and emits all three gradients — the split
+    dq/dkv pair recomputes the two largest matmuls (s and dp) and the
+    exp, and streams every q/k/v/do tile twice. dQ accumulates in a
+    persistent (Sq, D) VMEM scratch (TPU grid iteration is sequential,
+    so the scratch survives the whole (nk, nq) sweep of one bh row);
+    callers gate this kernel on that scratch fitting VMEM."""
+    kb = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when((kb == 0) & (j == 0))
+    def _init_dq():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    @pl.when(j == 0)
+    def _init_dkv():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    needed = (j * block_q + block_q - 1 >= kb * block_k) if causal \
+        else True
+    # the q-side window this step addresses (mirrors _causal_q_map's
+    # clamp) — masked steps re-address the first needed block so their
+    # unconditional dq store writes that block's current partial
+    if causal:
+        eff_j = jnp.maximum(j, (kb * block_k) // block_q)
+    else:
+        eff_j = j
+    rows = pl.dslice(eff_j * block_q, block_q)
+
+    def _update():
+        q = q_ref[0]                  # pre-scaled (see fwd kernel)
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        do = do_ref[0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = s + _causal_mask(block_q, block_k, q_off=j * block_q,
+                                 k_off=kb * block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])                 # (Bq, Bk)
+        dv_acc[...] += jnp.dot(p.astype(do.dtype).T, do,
+                               preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dk_acc[...] += jnp.dot(ds.astype(q.dtype).T, q,
+                               preferred_element_type=jnp.float32)
+        dq_acc[rows, :] += jnp.dot(ds.astype(k_blk.dtype), k_blk,
+                                   preferred_element_type=jnp.float32)
+
+    _maybe_when(needed, _update)
+
+    # dq: store the addressed window's partial every step — its LAST
+    # flush for window j happens at this row's diagonal block (causal;
+    # kb = nk-1 otherwise), where the accumulation is complete
+    dq_ref[0] = (dq_acc[rows, :] * scale).astype(dq_ref.dtype)
+
+    @pl.when(j == nq - 1)
+    def _finish_dkv():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+# dq scratch cap for the fused backward: (Sq, D) f32 must fit scoped
+# VMEM alongside the streamed tiles (~16 MB total) — 4 MB covers
+# S=8192 at D=128; longer sequences fall back to the split kernels.
+_FUSED_DQ_BYTES_CAP = 4 * 1024 * 1024
+
+
+def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, causal, scale,
+                     block_q, block_k, interpret, shapes):
+    b, h, sq, sk, d = shapes
+    bh = b * h
+    nq, nk = sq // block_q, sk // block_k
+    kvmap_kq = lambda i, kb, j: (i, kb, 0)
+    qmap = _causal_q_map(causal, block_q, block_k)
+    stat_spec = pl.BlockSpec((1, block_q, _STAT_LANES), qmap)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_fused_kernel, nq=nq, nk=nk,
+                          block_q=block_q, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), qmap),
+            pl.BlockSpec((1, block_k, d), kvmap_kq),
+            pl.BlockSpec((1, block_k, d), kvmap_kq),
+            pl.BlockSpec((1, block_q, d), qmap),
+            stat_spec,
+            stat_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), qmap),
+            pl.BlockSpec((1, block_k, d), kvmap_kq),
+            pl.BlockSpec((1, block_k, d), kvmap_kq),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), qf.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), vf.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((sq, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+    return dq, dk, dv
 
 
 def _flash_bwd_stats(o, lse, do):
@@ -287,15 +451,25 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bh = b * h
-    qf, kf, vf = (a.reshape(bh, -1, d) for a in (q, k, v))
+    # q pre-scaled, as in the forward (kernels consume scaled q; dq gets
+    # its own scale factor at _finish, dk inherits it from q itself)
+    qf = (q * scale).astype(q.dtype).reshape(bh, sq, d)
+    kf, vf = (a.reshape(bh, -1, d) for a in (k, v))
     dof = do.reshape(bh, sq, d)
     lsef, delta = stats if stats is not None else _flash_bwd_stats(o, lse,
                                                                    do)
+    if sq * d * 4 <= _FUSED_DQ_BYTES_CAP:
+        dq, dk, dv = _flash_bwd_fused(
+            qf, kf, vf, dof, lsef, delta, causal, scale, block_q,
+            block_k, interpret, (b, h, sq, sk, d))
+        return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+                dv.reshape(b, h, sk, d))
     nq, nk = sq // block_q, sk // block_k
+    kvmap = _causal_kv_map(causal, block_q, block_k, nk)
+    qmap = _causal_q_map(causal, block_q, block_k)
     stat_spec_q = pl.BlockSpec((1, block_q, _STAT_LANES),
                                lambda i, j, kb: (i, j, 0))
-    stat_spec_kq = pl.BlockSpec((1, block_q, _STAT_LANES),
-                                lambda i, kb, j: (i, j, 0))
+    stat_spec_kq = pl.BlockSpec((1, block_q, _STAT_LANES), qmap)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, nk=nk, block_q=block_q,
@@ -303,8 +477,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), kvmap),
+            pl.BlockSpec((1, block_k, d), kvmap),
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
             stat_spec_q,
             stat_spec_q,
@@ -317,13 +491,13 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, nq=nq, block_q=block_q,
-                          block_k=block_k, causal=causal, scale=scale),
+                          block_k=block_k, causal=causal),
         grid=(bh, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), qmap),
             pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, kb, j: (i, kb, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), qmap),
             stat_spec_kq,
             stat_spec_kq,
         ],
