@@ -81,6 +81,11 @@ def main():
     # warmup = compile
     m.generate(prompt, args.new, temperature=0.0, dtype=dt,
                kv_dtype=args.kv_dtype)
+    # prefill-only executable (prompt -> 1 token): timed separately so
+    # long-prompt serving reports prefill latency, not just decode tok/s
+    # (VERDICT r4 #2 — prefill runs the flash kernel, O(S0) memory)
+    m.generate(prompt, 1, temperature=0.0, dtype=dt,
+               kv_dtype=args.kv_dtype)
 
     # per-call overhead (jit dispatch + host<->device roundtrip; on a
     # tunneled chip this is ~100 ms and dominates the wall-vs-device gap)
@@ -107,6 +112,17 @@ def main():
     med = float(np.median(times))
     tok_s = args.batch * args.new / med
     steps_s = args.new / med
+
+    # prefill latency: the (prompt -> 1 token) executable IS prefill +
+    # one sample (max_new=1 runs no cached decode step), so only the
+    # per-call overhead is stripped
+    pf_times = []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        m.generate(prompt, 1, temperature=0.0, dtype=dt,
+                   kv_dtype=args.kv_dtype)
+        pf_times.append(time.perf_counter() - t0)
+    prefill_s = max(float(np.median(pf_times)) - call_overhead, 0.0)
 
     # ---- weight-streaming roofline --------------------------------------
     # bytes every decode step must move: all params once (embedding gather
@@ -177,6 +193,21 @@ def main():
         "device_kind": kind or "unknown",
         "peak_hbm_gbs": peak_bw,
         "decode_total_s": round(med, 3),
+        # flash-kernel prefill over the S0-token prompt, ex call overhead
+        # (the decode phase's tok/s above includes prefill amortized in;
+        # at long prompts read both numbers)
+        "prefill_ms": round(prefill_s * 1e3, 2),
+        "prefill_tok_s": round(args.batch * args.prompt
+                               / max(prefill_s, 1e-9), 1),
+        # decode rate with BOTH the call overhead and the prefill phase
+        # removed: the steady-state cached-step rate at long prompts.
+        # None when the residual is below measurement noise (a few
+        # tunnel-jitter ms) — an absurd clamped rate must never enter a
+        # committed artifact.
+        "tok_s_ex_prefill": (
+            round(args.batch * args.new
+                  / (med - call_overhead - prefill_s), 1)
+            if med - call_overhead - prefill_s > 5e-3 else None),
         "out_shape": list(out.shape),
     }
     print(json.dumps(rec))

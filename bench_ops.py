@@ -82,6 +82,7 @@ def main():
         return jnp.asarray(rng.rand(*shape), dt)
 
     from singa_tpu.ops.attention import flash_attention
+    from singa_tpu.ops.rnn import _GRUScan, _LSTMScan
 
     x_conv = arr(32, 64, 56, 56)
     w_conv = arr(64, 64, 3, 3)
@@ -119,6 +120,20 @@ def main():
                                             rng.randint(0, 1000, 512)))),
         "flash_attn_b8_h8_s1024_d64": (
             lambda q: flash_attention(q, q, q, causal=True), (q,)),
+        # RNN family (VERDICT r4 #5): the scan LSTM/GRU's fused
+        # (x@Wx + h@Wh) step vs the reference's cuDNN fused RNN
+        # (src/model/operation/rnn.cc, test_operation_benchmark.cc).
+        # tokens/step = B*T = 4096; tokens/s = 4096 / (ms/1e3).
+        "lstm_scan_b32_t128_h512": (
+            lambda x, hx, cx, Wx, Wh, b:
+                _LSTMScan(512).forward(x, hx, cx, Wx, Wh, b)[0],
+            (arr(128, 32, 512), arr(32, 512), arr(32, 512),
+             arr(512, 2048), arr(512, 2048), arr(2048))),
+        "gru_scan_b32_t128_h512": (
+            lambda x, hx, Wx, Wh, b:
+                _GRUScan(512).forward(x, hx, Wx, Wh, b)[0],
+            (arr(128, 32, 512), arr(32, 512),
+             arr(512, 1536), arr(512, 1536), arr(1536))),
     }
 
     results = {}

@@ -95,6 +95,11 @@ def main():
                    help="rotary position embeddings")
     p.add_argument("--kv-heads", type=int, default=None,
                    help="GQA kv heads (< heads; decode cache shrinks)")
+    p.add_argument("--kv-dtype", default=None, choices=[None, "int8"],
+                   help="int8 KV cache for the sample decode; also "
+                        "prints greedy-agreement vs the bf16 cache on "
+                        "held-out prompts (the trained-model accuracy "
+                        "evidence for kv8)")
     args = p.parse_args()
 
     text = load_corpus(args.corpus)
@@ -161,9 +166,28 @@ def main():
     prompt = prompt[:, -(args.seq // 2):]
     n_new = min(args.sample, args.seq - prompt.shape[1])
     out = m.generate(prompt, n_new, temperature=0.8, top_k=40,
-                     dtype="bfloat16")
+                     dtype="bfloat16", kv_dtype=args.kv_dtype)
     print("--- sample ---")
     print(data.decode(out[0]))
+    if args.kv_dtype == "int8":
+        # trained-model kv8 evidence: greedy agreement vs the bf16 cache
+        # over held-out prompts (argmax flips = quantization cost), plus
+        # a greedy sample from each cache for eyeballing
+        half = min(64, args.seq // 2)
+        prompts = (data.vx[:4, :half] if len(data.vx) >= 1
+                   else np.repeat(prompt[:, :half], 4, axis=0))
+        g8 = m.generate(prompts, half, temperature=0.0,
+                        dtype="bfloat16", kv_dtype="int8")
+        gb = m.generate(prompts, half, temperature=0.0,
+                        dtype="bfloat16")
+        n0 = prompts.shape[1]
+        agree = float(np.mean(g8[:, n0:] == gb[:, n0:]))
+        print(f"kv8 vs bf16 cache: greedy agreement "
+              f"{agree:.1%} over {g8[:, n0:].size} tokens")
+        print("--- greedy sample (int8 KV) ---")
+        print(data.decode(g8[0]))
+        print("--- greedy sample (bf16 KV) ---")
+        print(data.decode(gb[0]))
 
 
 if __name__ == "__main__":

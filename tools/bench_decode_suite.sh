@@ -1,0 +1,24 @@
+#!/bin/bash
+# Serving-benchmark suite -> BENCHDEC_rNN.json (one JSON line per config).
+# Usage: tools/bench_decode_suite.sh BENCHDEC_r05.json
+# Rows:
+#   1-2  the pinned trendable config (110M-class, 8k toy vocab), bf16+int8
+#   3    same architecture at the REAL GPT-2 vocab (50257) — isolates the
+#        head-stream cost the toy vocab hides
+#   4-5  exact GPT-2-small architecture (d768 L12 H12 V50257), bf16+int8
+#   6    long-prompt prefill receipt (4096-token prompt, flash prefill)
+#   7    16k-prompt single-stream prefill receipt
+set -e
+OUT="${1:-BENCHDEC_r05.json}"
+: > "$OUT"
+run() { python bench_decode.py "$@" | tail -1 >> "$OUT"; }
+
+run --dim 1024 --layers 8 --heads 16 --vocab 8192  --batch 8 --prompt 128 --new 512 --dtype bfloat16
+run --dim 1024 --layers 8 --heads 16 --vocab 8192  --batch 8 --prompt 128 --new 512 --dtype int8
+run --dim 1024 --layers 8 --heads 16 --vocab 50257 --batch 8 --prompt 128 --new 512 --dtype bfloat16
+run --dim 768 --layers 12 --heads 12 --vocab 50257 --batch 8 --prompt 128 --new 512 --dtype bfloat16
+run --dim 768 --layers 12 --heads 12 --vocab 50257 --batch 8 --prompt 128 --new 512 --dtype int8
+run --dim 1024 --layers 8 --heads 16 --vocab 8192  --batch 8 --prompt 4096 --new 256 --dtype bfloat16
+run --dim 1024 --layers 8 --heads 16 --vocab 8192  --batch 1 --prompt 16384 --new 64 --dtype bfloat16
+echo "wrote $OUT:"
+cat "$OUT"
