@@ -168,6 +168,7 @@ def main():
     nparams = (L * block_params + head_params + V * E + T * E)
     rec = {
         "metric": f"gpt_decode_tok_s_d{args.dim}_l{args.layers}"
+                  f"_v{args.vocab}"
                   f"_b{args.batch}_p{args.prompt}_n{args.new}_{args.dtype}"
                   + (f"_kv{Hkv}" if Hkv != H else "")
                   + ("_rope" if args.rope else "")
@@ -195,10 +196,13 @@ def main():
         "decode_total_s": round(med, 3),
         # flash-kernel prefill over the S0-token prompt, ex call overhead
         # (the decode phase's tok/s above includes prefill amortized in;
-        # at long prompts read both numbers)
-        "prefill_ms": round(prefill_s * 1e3, 2),
-        "prefill_tok_s": round(args.batch * args.prompt
-                               / max(prefill_s, 1e-9), 1),
+        # at long prompts read both numbers). None when the overhead
+        # subtraction clamped to ~0 (tunnel jitter exceeded the prefill
+        # itself) — an absurd rate must never enter a committed artifact.
+        "prefill_ms": round(prefill_s * 1e3, 2)
+        if prefill_s > 1e-3 else None,
+        "prefill_tok_s": round(args.batch * args.prompt / prefill_s, 1)
+        if prefill_s > 1e-3 else None,
         # decode rate with BOTH the call overhead and the prefill phase
         # removed: the steady-state cached-step rate at long prompts.
         # None when the residual is below measurement noise (a few

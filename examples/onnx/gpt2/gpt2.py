@@ -21,14 +21,22 @@ VOCAB = 5000
 D, H, L = 128, 4, 4  # width / heads / layers (shared with serve_native.py)
 
 
-def build_torch():
+def build_torch(vocab=None, d=None, h=None, l=None, n_ctx=None):
     """GPT-2 architecture in plain torch (pre-LN blocks, learned positions,
     tied LM head) — transformers' vmap-based mask creation can't trace
-    under the TorchScript exporter, so the blocks are spelled out."""
+    under the TorchScript exporter, so the blocks are spelled out.
+    Dims default to this module's toy CI config; pass overrides (e.g.
+    serve_native.py --scale gpt2 builds the exact GPT-2-small shape)."""
     import math
 
     import torch
     import torch.nn as nn
+
+    VOCAB = vocab or globals()["VOCAB"]
+    D = d or globals()["D"]
+    H = h or globals()["H"]
+    L = l or globals()["L"]
+    N_CTX = n_ctx or globals()["N_CTX"]
 
     torch.manual_seed(0)
 

@@ -22,12 +22,30 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__),
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from singa_tpu import device, models, tensor  # noqa: E402
 from singa_tpu.models.transformer import load_gpt2_weights  # noqa: E402
-from gpt2 import build_torch, N_CTX, VOCAB, D, H, L  # noqa: E402
+import gpt2 as gpt2_mod  # noqa: E402
+from gpt2 import build_torch  # noqa: E402
 
 
 def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", default="toy", choices=["toy", "gpt2"],
+                    help="toy: the fast CI config (V5000 d128 L4). "
+                         "gpt2: the EXACT GPT-2-small architecture "
+                         "(V50257, d768, L12, H12, ctx1024) — random "
+                         "weights (no egress for the real checkpoint; "
+                         "the weight-name mapping and the serving math "
+                         "are identical either way)")
+    args = ap.parse_args()
+    if args.scale == "gpt2":
+        VOCAB, D, H, L, N_CTX = 50257, 768, 12, 12, 1024
+    else:
+        VOCAB, D, H, L = (gpt2_mod.VOCAB, gpt2_mod.D, gpt2_mod.H,
+                          gpt2_mod.L)
+        N_CTX = gpt2_mod.N_CTX
+
     import torch
-    tm = build_torch().eval()
+    tm = build_torch(vocab=VOCAB, d=D, h=H, l=L, n_ctx=N_CTX).eval()
     state = {k: v.numpy() for k, v in tm.state_dict().items()}
 
     dev = device.best_device()
@@ -52,9 +70,12 @@ def main():
 
     prompt = np.array([[40, 2883, 4673, 351, 257]], np.int32)
     n_new = N_CTX - prompt.shape[1]
-    out = m.generate(prompt, n_new, temperature=0.0)  # compile
+    # serving dtype: bf16 at real scale (the decode is weight-bandwidth
+    # bound); the toy config stays fp32 for bit-exact CI behavior
+    sdt = "bfloat16" if args.scale == "gpt2" else None
+    out = m.generate(prompt, n_new, temperature=0.0, dtype=sdt)  # compile
     t0 = time.perf_counter()
-    out = m.generate(prompt, n_new, temperature=0.0)
+    out = m.generate(prompt, n_new, temperature=0.0, dtype=sdt)
     dt = time.perf_counter() - t0
     print("generated token ids:", out[0].tolist())
     print(f"KV-cached decode: {n_new} tokens in {dt * 1e3:.1f} ms "

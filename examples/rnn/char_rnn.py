@@ -122,9 +122,12 @@ def train(args):
             ty.copy_from_numpy(y)
             _, loss = m(tx, ty)
             loss_sum += float(loss.numpy())
+        dt = time.time() - t0
+        toks = data.num_train_batch * args.batch * args.seq
         print(f"epoch {epoch}: train loss/char="
               f"{loss_sum / max(data.num_train_batch, 1):.4f} "
-              f"time={time.time() - t0:.1f}s", flush=True)
+              f"time={dt:.1f}s "
+              f"({toks / max(dt, 1e-9):,.0f} tok/s)", flush=True)
         if data.num_test_batch:
             m.eval()
             vl = 0.0

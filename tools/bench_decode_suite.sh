@@ -8,7 +8,7 @@
 #   4-5  exact GPT-2-small architecture (d768 L12 H12 V50257), bf16+int8
 #   6    long-prompt prefill receipt (4096-token prompt, flash prefill)
 #   7    16k-prompt single-stream prefill receipt
-set -e
+set -eo pipefail
 OUT="${1:-BENCHDEC_r05.json}"
 : > "$OUT"
 run() { python bench_decode.py "$@" | tail -1 >> "$OUT"; }
