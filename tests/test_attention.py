@@ -311,3 +311,41 @@ def test_rope_seq_parallel_offset(dev):
                                                         P(None, "sp"))))
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-3,
                                atol=2e-3)
+
+
+def test_flash_bwd_fused_matches_split():
+    """The fused single-pass backward (dq VMEM scratch) and the split
+    dq/dkv kernel pair are alternate lowerings of the same math — the
+    fused path serves S*D*4 <= 4MB, the split path long context. Force
+    each and require matching gradients (and both match the reference
+    vjp)."""
+    import singa_tpu.ops.attention as att
+
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.standard_normal((2, 3, 256, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 3, 256, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 3, 256, 64)), jnp.float32)
+
+    def grads(*a):
+        return jax.grad(
+            lambda q_, k_, v_: jnp.sum(
+                att.flash_attention(q_, k_, v_, True)), (0, 1, 2))(*a)
+
+    cap = att._FUSED_DQ_BYTES_CAP
+    try:
+        att._FUSED_DQ_BYTES_CAP = 1 << 60   # force fused
+        g_fused = grads(q, k, v)
+        att._FUSED_DQ_BYTES_CAP = 0         # force split
+        g_split = grads(q, k, v)
+    finally:
+        att._FUSED_DQ_BYTES_CAP = cap
+    g_ref = jax.grad(
+        lambda q_, k_, v_: jnp.sum(
+            att.attention_reference(q_, k_, v_, True)), (0, 1, 2))(
+        q, k, v)
+    for gf, gs, gr, name in zip(g_fused, g_split, g_ref,
+                                ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gs),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=2e-3, atol=2e-3, err_msg=name)
