@@ -107,15 +107,31 @@ def _maybe_when(cond, fn):
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                      acc_ref, m_ref, l_ref, *,
-                      nk, block_q, block_k, causal):
+                      acc_ref, m_ref, l_ref, *scratch,
+                      nk, block_q, block_k, causal, hoist_mask=False):
     """Grid: (batch*heads, q_blocks, k_blocks) — K/V blocks STREAM through
     VMEM one (block_k, D) tile at a time (no whole-row residency, so
     sequence length is bounded by HBM, not VMEM). The online-softmax state
     (acc, m, l) lives in VMEM scratch, which persists across the k grid
-    dimension (TPU grid iteration is sequential, minor dim innermost)."""
+    dimension. CONTRACT: the grid must stay FULLY sequential (no
+    dimension_semantics 'parallel' on any dim) — hoist_mask initializes
+    its scratch at program_id(0) == 0 and every later bh step reads it,
+    so a parallelized bh dimension would read uninitialized VMEM."""
     qi = pl.program_id(1)
     kb = pl.program_id(2)
+
+    # hoist_mask (static; only when nq == nk == 1, e.g. S <= 1024 at the
+    # default block): the causal mask is identical for every grid step,
+    # so it is built ONCE into a persistent VMEM scratch instead of
+    # paying iota+compare+select on the full score tile per step
+    if hoist_mask:
+        mask_ref = scratch[0]          # bf16: -1e30 is representable
+        # (8-bit exponent), and halves the persistent VMEM cost
+
+        @pl.when(pl.program_id(0) == 0)
+        def _mask_init():
+            mask_ref[...] = _causal_mask(block_q, block_k,
+                                         dtype=mask_ref.dtype)
 
     @pl.when(kb == 0)
     def _init():
@@ -142,7 +158,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         k_blk = k_ref[0]                               # (Bk, D)
         v_blk = v_ref[0]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        if causal:
+        if hoist_mask:
+            s = s + mask_ref[...]
+        elif causal:
             s = s + _causal_mask(block_q, block_k, q_off=qi * block_q,
                                  k_off=kb * block_k)
         m_prev = m_ref[...][:, :1]
@@ -206,10 +224,16 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
     kf = k.reshape(bh, sk, d)
     vf = v.reshape(bh, sk, d)
     nk = sk // block_k
-    grid = (bh, sq // block_q, nk)
+    nq = sq // block_q
+    grid = (bh, nq, nk)
+    # single-tile causal grids reuse one mask every step; cap the
+    # persistent scratch at 2MB so an env-forced giant block can't eat
+    # the VMEM budget the streamed tiles need
+    hoist = (causal and nq == 1 and nk == 1
+             and block_q * block_k * 2 <= 2 * 1024 * 1024)
     kernel = functools.partial(
         _flash_fwd_kernel, nk=nk, block_q=block_q, block_k=block_k,
-        causal=causal)
+        causal=causal, hoist_mask=hoist)
     kvmap = _causal_kv_map(causal, block_q, block_k, nk)
     out, lse = pl.pallas_call(
         kernel,
@@ -232,7 +256,8 @@ def _flash_fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
-        ],
+        ] + ([pltpu.VMEM((block_q, block_k), jnp.bfloat16)]
+             if hoist else []),
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d), lse[:, :, 0].reshape(b, h, sq)
