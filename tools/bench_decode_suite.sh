@@ -20,5 +20,8 @@ run --dim 768 --layers 12 --heads 12 --vocab 50257 --batch 8 --prompt 128 --new 
 run --dim 768 --layers 12 --heads 12 --vocab 50257 --batch 8 --prompt 128 --new 512 --dtype int8
 run --dim 1024 --layers 8 --heads 16 --vocab 8192  --batch 8 --prompt 4096 --new 256 --dtype bfloat16
 run --dim 1024 --layers 8 --heads 16 --vocab 8192  --batch 1 --prompt 16384 --new 64 --dtype bfloat16
+#   8-9  the GQA serving flagship (kv_heads=4: KV stream shrinks 4x)
+run --dim 1024 --layers 8 --heads 16 --kv-heads 4 --vocab 8192 --batch 8 --prompt 128 --new 512 --dtype bfloat16
+run --dim 1024 --layers 8 --heads 16 --kv-heads 4 --vocab 8192 --batch 8 --prompt 128 --new 512 --dtype int8
 echo "wrote $OUT:"
 cat "$OUT"
