@@ -45,6 +45,10 @@ def main():
                    help="interleaved = gpipe schedule with 2 virtual "
                         "chunks per device (lowest bubble; see "
                         "parallel/pipeline.py schedule_table)")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings in the pipelined "
+                        "stage fns (no learned table; composes with all "
+                        "three schedules and tp)")
     p.add_argument("--lr", type=float, default=1e-2)
     p.add_argument("--ckpt", default=None,
                    help="directory for an orbax checkpoint; saved at the "
@@ -80,7 +84,8 @@ def main():
     m = models.create_model(
         "gpt_pipe", vocab_size=args.vocab, max_seq=args.seq, dim=args.dim,
         num_heads=args.heads, num_layers=args.layers,
-        tp_axis="tp", vocab_tp=True, interleave=interleave)
+        tp_axis="tp", vocab_tp=True, interleave=interleave,
+        pos_encoding="rope" if args.rope else "learned")
     m.set_optimizer(opt.DistOpt(opt.SGD(lr=args.lr, momentum=0.9),
                                 axis="data", mesh=mesh))
 
