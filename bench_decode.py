@@ -170,7 +170,7 @@ def main():
         "metric": f"gpt_decode_tok_s_d{args.dim}_l{args.layers}"
                   f"_v{args.vocab}"
                   f"_b{args.batch}_p{args.prompt}_n{args.new}_{args.dtype}"
-                  + (f"_kv{Hkv}" if Hkv != H else "")
+                  + (f"_gqa{Hkv}" if Hkv != H else "")
                   + ("_rope" if args.rope else "")
                   + ("_kv8" if args.kv_dtype == "int8" else "")
                   + ("_cpu" if on_cpu else ""),
