@@ -1,0 +1,39 @@
+"""singa_tpu: a TPU-native deep learning framework with the capabilities of
+Apache SINGA (reference: /root/reference), redesigned for JAX/XLA/Pallas.
+
+Module map (reference parity noted in each module's docstring):
+  - tensor     : Tensor facade over jax.Array   (ref python/singa/tensor.py)
+  - device     : Device registry over jax.Device (ref python/singa/device.py)
+  - autograd   : define-by-run tape over jnp     (ref python/singa/autograd.py)
+  - layer      : Layer zoo w/ deferred init      (ref python/singa/layer.py)
+  - model      : Model + graph(jit) buffering    (ref python/singa/model.py)
+  - opt        : optimizers + DistOpt            (ref python/singa/opt.py)
+  - parallel   : mesh / collectives / sharding   (ref src/io/communicator.cc)
+  - sonnx      : ONNX import/export              (ref python/singa/sonnx.py)
+  - initializer, data, image_tool, snapshot, utils
+"""
+
+__version__ = "0.1.0"
+
+from . import config  # noqa: F401
+
+# Lazy imports: importing singa_tpu should be cheap; heavy modules (autograd,
+# layer, sonnx) are imported on attribute access.
+_LAZY_MODULES = (
+    "tensor", "device", "autograd", "layer", "model", "opt",
+    "initializer", "sonnx", "data", "image_tool", "snapshot",
+    "parallel", "utils", "ops", "models", "io", "channel", "native",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_MODULES:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'singa_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_LAZY_MODULES))

@@ -1,0 +1,157 @@
+"""Data loading utilities (ref python/singa/data.py).
+
+`ImageBatchIter` keeps the reference's API (start/next/end, multiprocess
+prefetch into a bounded queue). On TPU the host-side pipeline matters more
+than on GPU — the chip stalls if the host can't feed it — so there is also
+`NumpyBatchIter` for in-memory arrays with background prefetch, used by the
+examples. A C-accelerated record reader lives in singa_tpu.io (native/).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from multiprocessing import Event, Process, Queue
+
+import numpy as np
+
+
+class ImageBatchIter:
+    """Iterate an image-list file, yielding (images_NCHW_uint8, labels).
+
+    Args mirror the reference (data.py:64): img_list_file lines are
+    "<path><delimiter><meta>"; image_transform(full_path) -> list of
+    augmented PIL images.
+    """
+
+    def __init__(self, img_list_file, batch_size, image_transform,
+                 shuffle=True, delimiter=' ', image_folder=None, capacity=10):
+        self.img_list_file = img_list_file
+        self.queue = Queue(capacity)
+        self.batch_size = batch_size
+        self.image_transform = image_transform
+        self.shuffle = shuffle
+        self.delimiter = delimiter
+        self.image_folder = image_folder
+        self.stop_flag = Event()  # shared with the worker process
+        self.p = None
+        with open(img_list_file, 'r') as fd:
+            self.num_samples = len(fd.readlines())
+
+    def start(self):
+        self.p = Process(target=self.run, daemon=True)
+        self.p.start()
+
+    def __next__(self):
+        assert self.p is not None, 'call start before next'
+        while self.queue.empty():
+            time.sleep(0.01)
+        return self.queue.get()
+
+    next = __next__
+
+    def __iter__(self):
+        return self
+
+    def end(self):
+        if self.p is not None:
+            self.stop_flag.set()
+            # drain so a blocked queue.put in the worker can finish cleanly
+            while not self.queue.empty():
+                self.queue.get_nowait()
+            self.p.join(timeout=1.0)
+            if self.p.is_alive():
+                self.p.terminate()
+
+    def run(self):
+        samples = []
+        with open(self.img_list_file, 'r') as fd:
+            for line in fd:
+                path, meta = line.strip().split(self.delimiter, 1)
+                samples.append((path, meta))
+        while not self.stop_flag.is_set():
+            if self.shuffle:
+                random.shuffle(samples)
+            i = 0
+            while i + self.batch_size <= len(samples) \
+                    and not self.stop_flag.is_set():
+                xs, ys = [], []
+                for path, meta in samples[i:i + self.batch_size]:
+                    full = os.path.join(self.image_folder, path) \
+                        if self.image_folder else path
+                    for img in self.image_transform(full):
+                        arr = np.asarray(img, dtype=np.float32)
+                        if arr.ndim == 2:
+                            arr = arr[:, :, None]
+                        xs.append(arr.transpose(2, 0, 1))
+                        ys.append(meta)
+                x = np.stack(xs)
+                try:
+                    y = np.asarray([int(v) for v in ys], np.int32)
+                except ValueError:
+                    y = ys  # non-integer meta: hand back raw strings
+                self.queue.put((x, y))
+                i += self.batch_size
+
+
+class NumpyBatchIter:
+    """Shuffled mini-batches over in-memory arrays with a one-deep
+    background prefetch thread (enough to hide host-side augmentation
+    behind device steps)."""
+
+    def __init__(self, x, y, batch_size, transform=None, shuffle=True,
+                 seed=0, drop_last=True):
+        assert len(x) == len(y)
+        self.x, self.y = x, y
+        self.bs = batch_size
+        self.transform = transform
+        self.shuffle = shuffle
+        self.rng = np.random.RandomState(seed)
+        n = len(x) // batch_size if drop_last else -(-len(x) // batch_size)
+        self.num_batches = n
+
+    def __len__(self):
+        return self.num_batches
+
+    def _make(self, order, b):
+        sel = order[b * self.bs:(b + 1) * self.bs]
+        xb = self.x[sel]
+        if self.transform is not None:
+            xb = self.transform(xb)
+        return xb, self.y[sel]
+
+    def __iter__(self):
+        order = np.arange(len(self.x))
+        if self.shuffle:
+            self.rng.shuffle(order)
+        nxt = {}
+        lock = threading.Condition()
+        stop = [False]  # set when the consumer abandons the iterator early
+
+        def producer():
+            for b in range(self.num_batches):
+                batch = self._make(order, b)
+                with lock:
+                    while (b in nxt or len(nxt) >= 2) and not stop[0]:
+                        lock.wait()
+                    if stop[0]:
+                        return
+                    nxt[b] = batch
+                    lock.notify_all()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            for b in range(self.num_batches):
+                with lock:
+                    while b not in nxt:
+                        lock.wait()
+                    batch = nxt.pop(b)
+                    lock.notify_all()
+                yield batch
+        finally:
+            with lock:
+                stop[0] = True
+                lock.notify_all()

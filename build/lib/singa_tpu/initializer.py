@@ -1,0 +1,120 @@
+"""Parameter initializers, in-place on Tensor.
+
+Reference parity: python/singa/initializer.py:41-246 — modern family
+(`eye`, `orthogonal`, `lecun/glorot/he × uniform/normal`) plus the legacy
+aliases (`uniform`, `gaussian`, `xavier`, `msra`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from .tensor import Tensor
+
+
+def _fans(t: Tensor):
+    shape = t.shape
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    elif len(shape) > 2:
+        # conv kernels OIHW: receptive = prod(spatial)
+        receptive = int(np.prod(shape[2:]))
+        fan_in = shape[1] * receptive
+        fan_out = shape[0] * receptive
+    else:
+        fan_in = fan_out = int(np.prod(shape)) if shape else 1
+    return fan_in, fan_out
+
+
+def eye(t: Tensor):
+    assert len(t.shape) == 2, "eye needs a 2D tensor"
+    import jax.numpy as jnp
+    t.data = jnp.eye(t.shape[0], t.shape[1], dtype=t.dtype)
+    return t
+
+
+def orthogonal(t: Tensor, gain: float = 1.0):
+    assert len(t.shape) >= 2
+    rows, cols = t.shape[0], int(np.prod(t.shape[1:]))
+    k = t.device.rand_key()
+    a = jax.random.normal(k, (max(rows, cols), min(rows, cols)))
+    q, r = np.linalg.qr(np.asarray(a))
+    q = q * np.sign(np.diag(r))
+    q = q.T if rows < cols else q
+    t.data = (gain * q[:rows, :cols]).reshape(t.shape).astype(t.dtype)
+    return t
+
+
+def _scaled_uniform(t: Tensor, scale: float):
+    limit = float(np.sqrt(scale))
+    return t.uniform(-limit, limit)
+
+
+def _scaled_normal(t: Tensor, scale: float):
+    return t.gaussian(0.0, float(np.sqrt(scale)))
+
+
+def lecun_uniform(t: Tensor):
+    fan_in, _ = _fans(t)
+    return _scaled_uniform(t, 3.0 / fan_in)
+
+
+def lecun_normal(t: Tensor):
+    fan_in, _ = _fans(t)
+    return _scaled_normal(t, 1.0 / fan_in)
+
+
+def glorot_uniform(t: Tensor):
+    fan_in, fan_out = _fans(t)
+    return _scaled_uniform(t, 6.0 / (fan_in + fan_out))
+
+
+def glorot_normal(t: Tensor):
+    fan_in, fan_out = _fans(t)
+    return _scaled_normal(t, 2.0 / (fan_in + fan_out))
+
+
+def he_uniform(t: Tensor):
+    fan_in, _ = _fans(t)
+    return _scaled_uniform(t, 6.0 / fan_in)
+
+
+def he_normal(t: Tensor):
+    fan_in, _ = _fans(t)
+    return _scaled_normal(t, 2.0 / fan_in)
+
+
+# ---- legacy API (initializer.py:157-246) ---------------------------------
+
+def uniform(t: Tensor, fan_in=0, fan_out=0):
+    avg = 2.0
+    if fan_in * fan_out == 0:
+        avg, fan_out = 1.0, fan_in
+    x = float(np.sqrt(3.0 * avg / max(fan_in + fan_out, 1)))
+    return t.uniform(-x, x)
+
+
+def gaussian(t: Tensor, fan_in=0, fan_out=0):
+    avg = 2.0
+    if fan_in * fan_out == 0:
+        avg, fan_out = 1.0, fan_in
+    std = float(np.sqrt(avg / max(fan_in + fan_out, 1)))
+    return t.gaussian(0.0, std)
+
+
+def xavier(t: Tensor):
+    return glorot_uniform(t)
+
+
+def msra(t: Tensor):
+    return he_normal(t)
+
+
+def glorot(t: Tensor):
+    """Legacy: gaussian(0,1) scaled by sqrt(2/(rows+cols))
+    (ref initializer.py:222)."""
+    import math
+    scale = math.sqrt(2.0 / (t.shape[0] + t.shape[1]))
+    t.gaussian(0, 1)
+    t.copy_from_numpy(t.numpy() * scale)

@@ -1,0 +1,127 @@
+"""Record file IO (ref src/io/binfile_{reader,writer}.cc, SURVEY.md §2.9).
+
+`RecordWriter`/`RecordReader` store length-framed, crc-checked key/value
+records. The reader prefetches on a C++ thread (singa_tpu/native) so record
+decode overlaps device steps; a pure-Python implementation of the same file
+format is the fallback when no compiler is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+import zlib
+
+from . import native
+
+_MAGIC = b"STPURIO1"
+
+
+class RecordWriter:
+
+    def __init__(self, path: str):
+        self.path = path
+        self._h = None
+        self._f = None
+        lb = native.lib()
+        if lb is not None:
+            self._lib = lb
+            self._h = lb.rio_writer_open(path.encode())
+            if not self._h:
+                raise OSError(f"cannot open {path}")
+        else:
+            self._f = open(path, "wb")
+            self._f.write(_MAGIC)
+
+    def write(self, key, value):
+        key = key.encode() if isinstance(key, str) else bytes(key)
+        value = bytes(value)
+        if self._h:
+            rc = self._lib.rio_writer_write(self._h, key, len(key), value,
+                                            len(value))
+            if rc != 0:
+                raise OSError("record write failed")
+        else:
+            crc = zlib.crc32(value) & 0xFFFFFFFF
+            self._f.write(struct.pack("<I", len(key)) + key +
+                          struct.pack("<Q", len(value)) + value +
+                          struct.pack("<I", crc))
+
+    def close(self):
+        if self._h:
+            self._lib.rio_writer_close(self._h)
+            self._h = None
+        elif self._f:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class RecordReader:
+    """Iterate (key: bytes, value: bytes) records; `depth` is the native
+    prefetch queue size."""
+
+    def __init__(self, path: str, depth: int = 8):
+        self.path = path
+        self._h = None
+        self._f = None
+        lb = native.lib()
+        if lb is not None:
+            self._lib = lb
+            self._h = lb.rio_reader_open(path.encode(), depth)
+            if not self._h:
+                raise OSError(f"cannot open {path}")
+        else:
+            self._f = open(path, "rb")
+            if self._f.read(8) != _MAGIC:
+                raise OSError(f"{path}: bad magic")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._h:
+            key = ctypes.c_char_p()
+            klen = ctypes.c_uint32()
+            val = ctypes.c_char_p()
+            vlen = ctypes.c_uint64()
+            rc = self._lib.rio_reader_next(
+                self._h, ctypes.byref(key), ctypes.byref(klen),
+                ctypes.byref(val), ctypes.byref(vlen))
+            if rc == 0:
+                raise StopIteration
+            if rc < 0:
+                raise OSError(f"{self.path}: corrupt record")
+            k = ctypes.string_at(key, klen.value)
+            v = ctypes.string_at(val, vlen.value)
+            return k, v
+        raw = self._f.read(4)
+        if len(raw) < 4:
+            raise StopIteration
+        klen = struct.unpack("<I", raw)[0]
+        k = self._f.read(klen)
+        vlen = struct.unpack("<Q", self._f.read(8))[0]
+        v = self._f.read(vlen)
+        crc = struct.unpack("<I", self._f.read(4))[0]
+        if (zlib.crc32(v) & 0xFFFFFFFF) != crc:
+            raise OSError(f"{self.path}: corrupt record")
+        return k, v
+
+    def close(self):
+        if self._h:
+            self._lib.rio_reader_close(self._h)
+            self._h = None
+        elif self._f:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,835 @@
+"""Model API with trace-once graph buffering.
+
+Reference parity: python/singa/model.py — `ModelMeta.buffer_operation`
+(model.py:41-100) makes the *first* `train_one_batch` call trace all ops
+into the C++ `Graph`, then replays `dev.RunGraph(sequential)` every
+iteration; `compile()` (:156-184) runs a dummy forward to shape-infer and
+init params; `save_states/load_states` use zip(npz + json) (:244-354).
+
+TPU-native redesign: "trace once, replay" IS `jax.jit`: the first call
+builds a functional step (model states + optimizer states threaded through,
+buffers donated so params update in place), compiles it with XLA, and every
+later call replays the executable with zero Python op dispatch. Distributed
+training shard_maps the same step over a mesh so DistOpt's `lax.psum` calls
+bind to the data axis — the XLA analog of submitting NCCL ops as graph
+nodes (communicator.cc:175-186).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+import zipfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import autograd
+from .layer import Layer, LayerMeta
+from .tensor import Tensor
+
+
+def _flatten_out(out):
+    """Flatten nested tuples/lists/dicts of Tensors -> (leaves, rebuild)."""
+    leaves = []
+
+    def build_template(o):
+        if isinstance(o, Tensor):
+            leaves.append(o)
+            return ("T", len(leaves) - 1)
+        if isinstance(o, (tuple, list)):
+            return ("L", type(o).__name__, [build_template(v) for v in o])
+        if isinstance(o, dict):
+            return ("D", {k: build_template(v) for k, v in o.items()})
+        return ("C", o)
+
+    template = build_template(out)
+    return leaves, template
+
+
+def _rebuild_out(template, tensors):
+    kind = template[0]
+    if kind == "T":
+        return tensors[template[1]]
+    if kind == "L":
+        seq = [_rebuild_out(t, tensors) for t in template[2]]
+        return tuple(seq) if template[1] == "tuple" else seq
+    if kind == "D":
+        return {k: _rebuild_out(v, tensors) for k, v in template[1].items()}
+    return template[1]
+
+
+class ModelMeta(LayerMeta):
+    def __new__(mcs, name, bases, attrs):
+        if "train_one_batch" in attrs:
+            attrs["train_one_batch"] = ModelMeta.buffer_operation(
+                attrs["train_one_batch"])
+        return super().__new__(mcs, name, bases, attrs)
+
+    @staticmethod
+    def buffer_operation(func):
+        """First call in graph mode builds + compiles the step; replays
+        after (mirrors model.py:57-93)."""
+
+        def wrapper(self, *args, **kwargs):
+            if self._device is None:
+                raise RuntimeError(
+                    "call Model.compile([inputs], ...) before training — "
+                    "params are shape-inferred from the compile inputs "
+                    "(ref model.py:156)")
+            if not (self.graph_mode and self.training):
+                return func(self, *args, **kwargs)
+            if self._compiled_step is None:
+                self._build_step(func, args, kwargs)
+            return self._invoke_step(args)
+
+        wrapper.__wrapped__ = func
+        return wrapper
+
+
+class Model(Layer, metaclass=ModelMeta):
+    """Base user model: subclass, define `forward` and (optionally)
+    `train_one_batch` (ref model.py:103)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.training = True
+        self.graph_mode = True
+        self.sequential = False
+        self._optimizer = None
+        self._device = None
+        self._compiled_step = None
+        self._step_stats = {"compile_s": 0.0, "steps": 0}
+
+    # ---- configuration (ref model.py:185-243) ----------------------------
+    def set_optimizer(self, opt):
+        self._optimizer = opt
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def graph(self, mode=True, sequential=False):
+        """Turn graph (jit) execution on/off after compile
+        (ref model.py:224). `sequential=True` is the serial debug mode
+        (jax.disable_jit), mirroring the reference's RunInSerial."""
+        if mode == self.graph_mode and sequential == self.sequential:
+            return  # idempotent: keep the compiled executables
+        self.graph_mode = mode
+        self.sequential = sequential
+        if isinstance(self._compiled_step, dict):
+            self._compiled_step = {}   # drop stale-flag executables
+        self._compiled_eval = None
+
+    def compile(self, inputs, is_train=True, use_graph=False,
+                sequential=False, pipeline_axis=None, n_micro=1,
+                pipeline_schedule="gpipe", amp=None,
+                eval_buckets="auto"):
+        """Dummy forward with concrete inputs to init all params
+        (ref model.py:156-184).
+
+        pipeline_axis/n_micro: mesh axis + microbatch count for pipeline
+        execution; consumed by pipeline-capable models (e.g.
+        models.transformer.PipelinedGPT) at param-init time.
+        pipeline_schedule: "gpipe" (autodiff through the forward scan; all
+        microbatch residuals live until backward) or "1f1b" (fused
+        fwd+bwd interleave with in-schedule loss; in-flight activations
+        bounded by ~2*stages, stage vjp rematerialized).
+
+        amp: compute dtype for mixed-precision training ("bfloat16"):
+        fp32 master weights with differentiable casts at matmul/conv
+        boundaries; normalizations and losses stay fp32 (VERDICT r1 #14).
+
+        eval_buckets: pad varying eval batch sizes to power-of-two buckets
+        (O(log B) compiled variants instead of a retrace per size). Only
+        valid when forward's outputs are all per-sample — a forward that
+        reduces over the batch dim would average in the padding. Default
+        "auto": the first eval call detects whether every output is
+        per-sample (leading dim == batch) and enables bucketing for later
+        batch sizes only if so; True forces it (loud error on
+        non-per-sample outputs), False disables it."""
+        assert len(inputs) > 0 and isinstance(inputs[0], Tensor)
+        self._device = inputs[0].device
+        self.graph_mode = use_graph
+        self.sequential = sequential
+        assert pipeline_schedule in ("gpipe", "1f1b"), pipeline_schedule
+        self.pipeline_axis = pipeline_axis
+        self.n_micro = n_micro
+        self.pipeline_schedule = pipeline_schedule
+        if amp in ("bf16", True):
+            amp = "bfloat16"
+        self.amp = amp
+        self.eval_buckets = eval_buckets
+        prev = autograd.training
+        autograd.training = False  # init pass builds no tape
+        try:
+            self.forward(*inputs)
+        finally:
+            autograd.training = prev
+        self.train(is_train)
+        if self._optimizer is not None:
+            self._optimizer.setup(self.get_params().values())
+
+    def train(self, mode: bool = True):
+        self.training = mode
+        autograd.training = mode
+
+    def eval(self):
+        self.train(False)
+
+    # ---- default hooks ---------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def train_one_batch(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        prev_cd = autograd.compute_dtype
+        if getattr(self, "amp", None) is not None:
+            autograd.compute_dtype = self.amp  # eager path; jitted steps
+        try:                                   # set it at trace time too
+            if self.training:
+                return self.train_one_batch(*args, **kwargs)
+            if self.graph_mode and self._device is not None and not kwargs \
+                    and all(isinstance(a, Tensor) for a in args):
+                return self._eval_step(args)
+            return self.forward(*args, **kwargs)
+        finally:
+            autograd.compute_dtype = prev_cd
+
+    # ---- the jitted step -------------------------------------------------
+    def _build_step(self, func, example_args, kwargs):
+        from .opt import DistOpt  # local import to avoid cycle
+
+        t0 = time.perf_counter()
+        opt = self._optimizer
+        if opt is not None:
+            opt.setup(self.get_params().values())
+        # shard_map whenever a multi-device mesh is attached — the data
+        # axis may be size 1 when the mesh is carved for tp/pp only
+        dist = (isinstance(opt, DistOpt)
+                and opt.communicator.mesh is not None
+                and opt.communicator.mesh.size > 1)
+        if dist:
+            # Expert-parallel layers REQUIRE the gradient reduction to
+            # cover their ep axis (tuple DistOpt axis): reducing over data
+            # alone leaves each ep rank's replicated expert tables updated
+            # from only its own slice grads — silent divergence, so refuse.
+            mesh_axes = set(opt.communicator.mesh.shape.keys())
+            red_axes = set(opt.axis if isinstance(opt.axis, tuple)
+                           else (opt.axis,))
+            stack = [self]
+            while stack:
+                lyr = stack.pop()
+                stack.extend(getattr(lyr, "_layers", {}).values())
+                ep = getattr(lyr, "ep_axis", None)
+                if (ep is not None and hasattr(lyr, "num_experts")
+                        and ep in mesh_axes and ep not in red_axes):
+                    raise ValueError(
+                        f"MoE layer routes experts over mesh axis '{ep}' "
+                        f"but DistOpt reduces only over {sorted(red_axes)}"
+                        f"; expert gradients would diverge across '{ep}'. "
+                        f"Use DistOpt(axis={tuple(sorted(red_axes) + [ep])}"
+                        f", mesh=mesh)")
+
+        states = self.get_states()
+        state_tensors = list(states.values())
+        param_ids = {id(t) for t in self.get_params().values()}
+        aux_idx = [i for i, t in enumerate(state_tensors)
+                   if id(t) not in param_ids]
+        dev = self._device
+
+        tensor_pos = [i for i, a in enumerate(example_args)
+                      if isinstance(a, Tensor)]
+        static_args = {i: a for i, a in enumerate(example_args)
+                       if not isinstance(a, Tensor)}
+        self._tensor_pos = tensor_pos
+        self._static_args = static_args
+        out_template_box = {}
+
+        def make_step(tag):
+            """Build + jit the step for one static step-tag. Tag 0 is the
+            only tag for ordinary optimizers; DistOpt's partial-update
+            strategy rotates tags so each compiled variant contains ONLY
+            its parameter partition's collectives (true bandwidth rotation,
+            unlike a runtime mask — resolves the opt.py partial NOTE)."""
+
+            def step(state_arrs, opt_arrs, rng, input_arrs):
+                if opt is not None:
+                    opt._partial_static_idx = tag
+                if dist:
+                    # flattened rank (communicator handles tuple axes for
+                    # multi-axis reductions like DP+EP)
+                    dev.rng_state = jax.random.fold_in(
+                        rng, opt.communicator.rank())
+                else:
+                    dev.rng_state = rng
+                for t, a in zip(state_tensors, state_arrs):
+                    t.data = a
+                if opt is not None and opt_arrs:
+                    opt.load_state_arrays(opt_arrs)
+                call_args = []
+                j = 0
+                for i in range(len(example_args)):
+                    if i in static_args:
+                        call_args.append(static_args[i])
+                    else:
+                        call_args.append(Tensor(data=input_arrs[j],
+                                                device=dev,
+                                                requires_grad=False))
+                        j += 1
+                autograd.training = True
+                prev_cd = autograd.compute_dtype
+                autograd.compute_dtype = getattr(self, "amp", None)
+                try:
+                    out = func(self, *call_args, **kwargs)
+                finally:
+                    autograd.compute_dtype = prev_cd
+                    if opt is not None:
+                        # trace-time tag must not leak into later EAGER
+                        # partial updates (they rotate via a host counter)
+                        opt._partial_static_idx = None
+                out_leaves, template = _flatten_out(out)
+                out_template_box["t"] = template
+                outs = [o.data for o in out_leaves]
+                if dist:
+                    # scalars (loss): average across shards; batched
+                    # outputs: gather to global batch so callers see one
+                    # coherent result
+                    outs = [lax.pmean(o, opt.axis) if o.ndim == 0
+                            else lax.all_gather(o, opt.axis, axis=0,
+                                                tiled=True)
+                            for o in outs]
+                new_states = [t.data for t in state_tensors]
+                if dist:
+                    # non-param states (BN running stats) differ per shard:
+                    # average them (syncBN-style) so the replicated
+                    # out-spec holds
+                    for i in aux_idx:
+                        new_states[i] = lax.pmean(new_states[i], opt.axis)
+                new_opt = opt.state_arrays() if opt is not None else []
+                new_rng = jax.random.split(rng, 1)[0] if dist \
+                    else dev.rng_state
+                return new_states, new_opt, new_rng, outs
+
+            if dist:
+                from jax.sharding import PartitionSpec as P
+                mesh = opt.communicator.mesh
+                wrapped = jax.shard_map(
+                    step, mesh=mesh,
+                    in_specs=(state_in, opt_in, P(), P(opt.axis)),
+                    out_specs=(state_in, opt_in, P(), P()),
+                    check_vma=False)
+            else:
+                wrapped = step
+            if self.sequential:
+                # RunGraph(sequential=true) parity (ref device.cc / SURVEY
+                # §2.1): execute ops one-by-one eagerly for debugging —
+                # op-level python breakpoints and immediate error locations
+                # instead of one fused XLA program
+                def serial(*a):
+                    with jax.disable_jit():
+                        return wrapped(*a)
+                return serial
+            return jax.jit(wrapped, donate_argnums=(0, 1))
+
+        self._dist_shardings = None
+        state_in = opt_in = None
+        if dist:
+            from jax.sharding import PartitionSpec as P, NamedSharding
+            mesh = opt.communicator.mesh
+            assert mesh is not None, \
+                "DistOpt needs a mesh for multi-device training"
+
+            def sanitize(spec):
+                """Drop spec axes the mesh doesn't carry: a model built
+                with tp_axis="tp" but trained on a {data, pp} mesh keeps
+                those params REPLICATED (the layer forwards gate their
+                collectives on axis_bound, so the math degrades to the
+                serial path consistently)."""
+                if spec is None:
+                    return None
+                axes = set(mesh.shape.keys())
+                out = []
+                for el in spec:
+                    if el is None:
+                        out.append(None)
+                    elif isinstance(el, tuple):
+                        kept = tuple(a for a in el if a in axes)
+                        out.append(kept if kept else None)
+                    else:
+                        out.append(el if el in axes else None)
+                if not any(e is not None for e in out):
+                    return None
+                return P(*out)
+
+            # TP-sharded params (Tensor.spec set by tp_axis layers) enter
+            # the shard_map partitioned; everything else is replicated. A
+            # plain P() prefix is kept in the no-TP case so strategies with
+            # dynamically growing optimizer state (sparse residuals) still
+            # pytree-match.
+            sanitized = [sanitize(getattr(t, "spec", None))
+                         for t in state_tensors]
+            state_specs = [s or P() for s in sanitized]
+            has_tp = any(s is not None for s in sanitized)
+            if has_tp:
+                state_in = state_specs
+                opt_in = [sanitize(s) or P() for s in opt.state_specs()]
+                self._dist_shardings = (
+                    NamedSharding(mesh, P()),
+                    NamedSharding(mesh, P(opt.axis)),
+                    [NamedSharding(mesh, s) for s in state_specs],
+                    [NamedSharding(mesh, s) for s in opt_in],
+                )
+            else:
+                state_in = opt_in = P()
+                self._dist_shardings = (NamedSharding(mesh, P()),
+                                        NamedSharding(mesh, P(opt.axis)),
+                                        None, None)
+        self._state_tensors = state_tensors
+        self._out_template_box = out_template_box
+        self._step_builder = make_step
+        self._compiled_step = {}   # step-tag -> jitted executable
+        self._step_stats["compile_s"] = time.perf_counter() - t0
+
+    def _invoke_step(self, args):
+        opt = self._optimizer
+        dev = self._device
+        # non-Tensor args (dist_option, spars, ...) are baked into the
+        # compiled step at trace time; changing them later must not be
+        # silently ignored
+        cur_static = {i: a for i, a in enumerate(args)
+                      if not isinstance(a, Tensor)}
+        if cur_static != self._static_args:
+            raise ValueError(
+                f"graph mode compiled with static args {self._static_args}, "
+                f"got {cur_static}; non-Tensor arguments cannot change "
+                "between calls (recompile by resetting the model, or run "
+                "with use_graph=False)")
+        state_arrs = [t.data for t in self._state_tensors]
+        opt_arrs = opt.state_arrays() if opt is not None else []
+        input_arrs = [args[i].data for i in self._tensor_pos]
+        self._last_input_arrs = input_arrs
+        rng = dev.rng_state
+        if self._dist_shardings is not None:
+            # replicate (or TP-shard) states over the mesh, shard the batch
+            # on the data axis (a no-op after step 1: outputs already carry
+            # these shardings, so only fresh host batches actually move)
+            rep, shard, state_sh, opt_sh = self._dist_shardings
+
+            def put(a, sh):
+                if getattr(a, "sharding", None) == sh:
+                    return a
+                if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                    # already a global array (a previous step's output);
+                    # re-putting is impossible and unnecessary
+                    return a
+                if jax.process_count() > 1:
+                    # multi-host: device_put cannot scatter across hosts.
+                    # Every process holds the FULL host value (params init
+                    # from a shared seed, batches fed as global arrays), so
+                    # each builds its addressable shards by indexing into
+                    # it — correct for replicated AND partitioned specs.
+                    if jnp.issubdtype(getattr(a, "dtype", None),
+                                      jax.dtypes.prng_key):
+                        # typed keys can't pass np.asarray; ship the raw
+                        # key data (rng shardings are replicated, so the
+                        # spec is rank-agnostic)
+                        kd = np.asarray(jax.random.key_data(a))
+                        g = jax.make_array_from_callback(
+                            kd.shape, sh, lambda idx: kd[idx])
+                        return jax.random.wrap_key_data(g)
+                    host = np.asarray(a)
+                    return jax.make_array_from_callback(
+                        host.shape, sh, lambda idx: host[idx])
+                return jax.device_put(a, sh)
+
+            if state_sh is None:
+                state_arrs = [put(a, rep) for a in state_arrs]
+                opt_arrs = [put(a, rep) for a in opt_arrs]
+            else:
+                state_arrs = [put(a, s)
+                              for a, s in zip(state_arrs, state_sh)]
+                opt_arrs = [put(a, s)
+                            for a, s in zip(opt_arrs, opt_sh)]
+            rng = put(rng, rep)
+            input_arrs = [put(a, shard) for a in input_arrs]
+        tag = opt.step_tag() if opt is not None else 0
+        fn = self._compiled_step.get(tag)
+        if fn is None:
+            fn = self._compiled_step[tag] = self._step_builder(tag)
+        profiling = (dev.verbosity > 0 and
+                     self._step_stats["steps"] >= dev.skip_iteration)
+        if profiling:
+            if dev.cost_analysis is None and dev.verbosity >= 2:
+                dev.cost_analysis = self.step_cost_analysis() \
+                    if self._step_stats["steps"] > 0 else {}
+            t0 = time.perf_counter()
+        new_states, new_opt, new_rng, outs = fn(
+            state_arrs, opt_arrs, rng, input_arrs)
+        if profiling:
+            jax.block_until_ready(new_states)
+            dev.step_times.append(time.perf_counter() - t0)
+        for t, a in zip(self._state_tensors, new_states):
+            t.data = a
+        if opt is not None and new_opt:
+            opt.load_state_arrays(new_opt)
+        if self._dist_shardings is not None and (
+                not isinstance(new_rng, jax.Array)
+                or new_rng.is_fully_addressable):
+            # un-replicate the key so later eager/single-device work (fresh
+            # param init, eval) doesn't inherit a mesh sharding. (On a
+            # multi-host mesh the key is not addressable here; it stays
+            # global and step feeds consume it in place.)
+            new_rng = jax.device_put(new_rng, dev.jax_device)
+        dev.rng_state = new_rng
+        self._step_stats["steps"] += 1
+        tensors = [Tensor(data=a, device=dev, requires_grad=False)
+                   for a in outs]
+        return _rebuild_out(self._out_template_box["t"], tensors)
+
+    def lower_step(self, tag=0):
+        """Re-lower a compiled step variant for inspection (HLO text, cost
+        analysis). Lowering re-traces the step, which assigns tracers into
+        dev.rng_state and the state Tensors as a side effect — snapshot and
+        restore them so no tracer escapes into later eager work."""
+        if not self._compiled_step or \
+                getattr(self, "_last_input_arrs", None) is None:
+            return None
+        fn = self._compiled_step.get(tag)
+        if fn is None:
+            return None
+        opt = self._optimizer
+        dev = self._device
+        snap_state = [t.data for t in self._state_tensors]
+        snap_opt = list(opt.state_arrays()) if opt is not None else []
+        snap_rng = dev.rng_state
+        state_arrs, opt_arrs, rng = snap_state, snap_opt, snap_rng
+        if self._dist_shardings is not None:
+            rep, _, state_sh, opt_sh = self._dist_shardings
+            state_arrs = [jax.device_put(a, s) for a, s in
+                          zip(state_arrs, state_sh)] if state_sh else \
+                [jax.device_put(a, rep) for a in state_arrs]
+            opt_arrs = [jax.device_put(a, s) for a, s in
+                        zip(opt_arrs, opt_sh)] if opt_sh else \
+                [jax.device_put(a, rep) for a in opt_arrs]
+            rng = jax.device_put(rng, rep)
+        snap_training = autograd.training
+        try:
+            return fn.lower(state_arrs, opt_arrs, rng,
+                            self._last_input_arrs)
+        finally:
+            # restore the PRE-replication snapshots: leaving mesh-committed
+            # arrays in globally shared state would poison later
+            # single-device work
+            autograd.training = snap_training
+            dev.rng_state = snap_rng
+            for t, a in zip(self._state_tensors, snap_state):
+                t.data = a
+            if opt is not None and snap_opt:
+                opt.load_state_arrays(snap_opt)
+
+    def step_cost_analysis(self):
+        """XLA cost analysis of the compiled training step (flops, bytes
+        accessed, ...) — the TPU analog of the reference's per-node
+        profiling tables (scheduler.cc:240-295). Requires at least one
+        graph-mode train call. Returns {} if unavailable."""
+        try:
+            lowered = self.lower_step()
+            if lowered is None:
+                return {}
+            ca = lowered.compile().cost_analysis()
+            return ca[0] if isinstance(ca, list) else (ca or {})
+        except Exception:
+            return {}
+
+    # ---- jitted inference (graph mode for eval; the reference replays its
+    # buffered graph for eval too, model.py:94-100) ------------------------
+    def _eval_step(self, args):
+        if getattr(self, "_compiled_eval", None) is None:
+            states = self.get_states()
+            eval_tensors = list(states.values())
+
+            def efwd(state_arrs, input_arrs):
+                # host-side trace counter: jit re-runs this body only on a
+                # retrace, so tests can assert bucketing avoids retraces
+                self._eval_trace_count = \
+                    getattr(self, "_eval_trace_count", 0) + 1
+                for t, a in zip(eval_tensors, state_arrs):
+                    t.data = a
+                prev = autograd.training
+                prev_cd = autograd.compute_dtype
+                autograd.training = False
+                autograd.compute_dtype = getattr(self, "amp", None)
+                try:
+                    out = self.forward(*[Tensor(data=a, device=self._device,
+                                                requires_grad=False)
+                                         for a in input_arrs])
+                finally:
+                    autograd.training = prev
+                    autograd.compute_dtype = prev_cd
+                leaves, template = _flatten_out(out)
+                self._eval_template = template
+                return [o.data for o in leaves]
+
+            self._eval_tensors = eval_tensors
+            self._compiled_eval = jax.jit(efwd)
+        concrete = [t.data for t in self._eval_tensors]
+        # batch-shape bucketing: pad the batch dim up to the next power of
+        # two so varying eval sizes (e.g. the last partial batch) reuse
+        # O(log B) compiled variants instead of retracing per size. Only
+        # sound when every output is per-sample (leading dim == batch); a
+        # forward that reduces over the batch would see the zero padding —
+        # so the default "auto" mode probes the first (unbucketed) call's
+        # output shapes and enables bucketing only when they are all
+        # per-sample; compile(eval_buckets=True) forces it.
+        arrs = [a.data for a in args]
+        nb = arrs[0].shape[0] if arrs and arrs[0].ndim > 0 else None
+        mode = getattr(self, "eval_buckets", "auto")
+        enabled = (mode is True or
+                   (mode == "auto"
+                    and getattr(self, "_eval_per_sample", None) is True))
+        bucket = None
+        if enabled and nb is not None \
+                and nb > 0 and all(
+                a.ndim > 0 and a.shape[0] == nb for a in arrs):
+            bucket = 1
+            while bucket < nb:
+                bucket *= 2
+            if bucket != nb:
+                arrs = [jnp.concatenate(
+                    [a, jnp.zeros((bucket - nb,) + a.shape[1:], a.dtype)])
+                    for a in arrs]
+            else:
+                bucket = None
+        try:
+            if self.sequential:
+                # serial debug mode applies to inference too (RunInSerial)
+                with jax.disable_jit():
+                    outs = self._compiled_eval(concrete, arrs)
+            else:
+                outs = self._compiled_eval(concrete, arrs)
+        finally:
+            # tracing assigns tracers into the state Tensors; put the real
+            # arrays back so later eager/train calls see concrete buffers
+            for t, a in zip(self._eval_tensors, concrete):
+                t.data = a
+        if bucket is not None:
+            # the eval_buckets contract is "every output is per-sample";
+            # enforce it loudly (ValueError, not assert: -O must not turn
+            # this back into silent truncation of a fixed-size output that
+            # merely matches the bucket)
+            for o in outs:
+                if o.ndim == 0 or o.shape[0] != bucket:
+                    raise ValueError(
+                        f"eval_buckets requires per-sample outputs; "
+                        f"got shape {o.shape} with batch bucket {bucket} "
+                        f"(compile with eval_buckets=False to retrace "
+                        f"per shape instead)")
+            outs = [o[:nb] for o in outs]
+        elif mode == "auto" and nb is not None and \
+                getattr(self, "_eval_per_sample", None) is not False and \
+                nb not in getattr(self, "_eval_probed_nbs", ()):
+            # auto-detect on unbucketed calls. Shape alone is not proof —
+            # a batch-coupled output (softmax over axis 0) is batch-shaped
+            # too — so PROBE semantics: re-run on the first half of the
+            # batch and require out(x[:h]) == out(x)[:h]. The probe
+            # re-runs once per NEW batch-size class (a coupling that was
+            # numerically invisible at one size may not be at another),
+            # and a failed re-probe permanently disables bucketing rather
+            # than silently zero-padding a coupled model.
+            shaped = all(o.ndim > 0 and o.shape[0] == nb for o in outs)
+            ok = False
+            if shaped and nb > 1:
+                h = nb // 2
+                try:
+                    houts = self._compiled_eval(
+                        concrete, [a[:h] for a in arrs])
+                    ok = all(
+                        np.allclose(np.asarray(jax.device_get(ho)),
+                                    np.asarray(jax.device_get(o))[:h],
+                                    rtol=1e-5, atol=1e-6)
+                        for ho, o in zip(houts, outs))
+                except Exception:
+                    ok = False
+                finally:
+                    for t, a in zip(self._eval_tensors, concrete):
+                        t.data = a
+            if not hasattr(self, "_eval_probed_nbs"):
+                self._eval_probed_nbs = set()
+            self._eval_probed_nbs.add(nb)
+            self._eval_per_sample = shaped and ok
+        tensors = [Tensor(data=a, device=self._device, requires_grad=False)
+                   for a in outs]
+        return _rebuild_out(self._eval_template, tensors)
+
+    # ---- checkpointing (ref model.py:244-354) ----------------------------
+    def save_states(self, fpath: str, aux_states: dict | None = None):
+        """zip(tensor_dict.npz + states_attr.json), same layout as the
+        reference so checkpoints are inspectable with stdlib tools."""
+        states = {k: t.numpy() for k, t in self.get_states().items()}
+        if aux_states:
+            for k, v in aux_states.items():
+                states[f"aux.{k}"] = np.asarray(
+                    v.numpy() if isinstance(v, Tensor) else v)
+        attrs = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                 for k, v in states.items()}
+        npz_buf = io.BytesIO()
+        np.savez(npz_buf, **states)
+        with zipfile.ZipFile(fpath, "w") as zf:
+            zf.writestr("tensor_dict.npz", npz_buf.getvalue())
+            zf.writestr("states_attr.json", json.dumps(attrs))
+
+    # ---- full training checkpoints (orbax) -------------------------------
+    # save_states/load_states keep the reference's zip(npz+json) layout
+    # for MODEL states; these save the full TRAINING state — params,
+    # layer states, optimizer state, the device RNG — through orbax,
+    # which writes sharded jax.Arrays per-shard (no host gather): the
+    # pod-scale checkpoint path the zip format cannot be.
+    def save_checkpoint(self, ckpt_dir: str, step: int = 0,
+                        overwrite: bool = False):
+        """Write a resumable training checkpoint under `ckpt_dir/step_N`.
+        Captures model states, optimizer state (slot buffers + step
+        counter) and the device PRNG stream, so training resumed from it
+        is bit-identical to uninterrupted training (tests/test_model.py::
+        test_checkpoint_resume_equivalence). An existing step_N directory
+        raises unless `overwrite=True` (a save-latest loop should either
+        thread a real step counter or pass overwrite)."""
+        import jax
+        import orbax.checkpoint as ocp
+        from .device import get_default_device
+        dev = self._device or get_default_device()
+        rng = dev.rng_state
+        if jnp.issubdtype(getattr(rng, "dtype", None), jax.dtypes.prng_key):
+            rng = jax.random.key_data(rng)
+        # RAW arrays throughout (no np.asarray): optimizer slots of
+        # sharded params are themselves sharded jax.Arrays and orbax
+        # writes them per-shard — a host gather here would defeat the
+        # point (and fail outright on non-addressable multi-host arrays)
+        opt_tree = {}
+        res_tree = {}
+        if self._optimizer is not None:
+            opt_tree = {f"s{i}": a for i, a in
+                        enumerate(self._optimizer.state_arrays())}
+            # sparse error-feedback residuals are per-DEVICE state under a
+            # replicated spec: save every device's buffer, not device 0's
+            get_stacks = getattr(self._optimizer,
+                                 "residual_device_stacks", None)
+            if get_stacks is not None:
+                res_tree = {f"r{i}": v for i, v in get_stacks().items()}
+        tree = {
+            "model": {k: t.data for k, t in self.get_states().items()},
+            "opt": opt_tree,
+            "res": res_tree,
+            "rng": rng,
+        }
+        ck = ocp.StandardCheckpointer()
+        path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+        ck.save(path, tree, force=overwrite)
+        ck.wait_until_finished()
+        return path
+
+    def _restore_template(self, path):
+        """Abstract restore targets carrying THIS process's current
+        shardings, so orbax reads only the shards each host addresses —
+        the multi-host restore path (every process calls load_checkpoint
+        with the same path; arrays come back sharded exactly as the live
+        training state is). Leaves whose live counterpart does not exist
+        yet (sparse residual stacks, the rng key-data) fall back to the
+        checkpoint's own metadata with a replicated sharding."""
+        import jax
+        import orbax.checkpoint as ocp
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                        sharding=a.sharding)
+
+        mesh = None
+        if self._optimizer is not None:
+            mesh = getattr(
+                getattr(self._optimizer, "communicator", None),
+                "mesh", None)
+
+        def meta_leaf(m):
+            # replicated target: correct on one host, and on a pod every
+            # host holds the full (small) array
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                return jax.ShapeDtypeStruct(
+                    tuple(m.shape), np.dtype(m.dtype),
+                    sharding=NamedSharding(mesh, PartitionSpec()))
+            return jax.ShapeDtypeStruct(tuple(m.shape), np.dtype(m.dtype))
+
+        meta = ocp.StandardCheckpointer().metadata(
+            os.path.abspath(path)).item_metadata
+        tpl = {
+            "model": {k: sds(t.data)
+                      for k, t in self.get_states().items()},
+            "opt": {}, "res": {},
+            "rng": meta_leaf(meta["rng"]),
+        }
+        if self._optimizer is not None and meta.get("opt"):
+            self._optimizer.setup(self.get_params().values())
+            tpl["opt"] = {f"s{i}": sds(a) for i, a in
+                          enumerate(self._optimizer.state_arrays())}
+        tpl["res"] = {k: meta_leaf(m)
+                      for k, m in (meta.get("res") or {}).items()}
+        return tpl
+
+    def load_checkpoint(self, path: str):
+        """Restore a `save_checkpoint` directory (a .../step_N path) into
+        this model + its optimizer + the device RNG. The model must be
+        built/compiled to the same topology first (params exist; under
+        `jax.distributed` every process calls this with the same path and
+        receives its own shards — restore targets carry the live training
+        state's shardings, so no host ever gathers the full arrays).
+        Optimizer state (including sparse error-feedback residuals saved
+        before/after their order existed) resumes exactly; bit-identical
+        continuation is asserted single-process by tests/test_model.py::
+        test_checkpoint_resume_equivalence and across 2 processes by
+        examples/multihost/ckpt_2proc.py (the CI leg)."""
+        import jax
+        import orbax.checkpoint as ocp
+        ck = ocp.StandardCheckpointer()
+        tree = ck.restore(os.path.abspath(path),
+                          self._restore_template(path))
+        # direct buffer assignment: the restored arrays already carry the
+        # live shardings (template), so no host round-trip — required on
+        # multi-host, where np.asarray of a global array would throw
+        states = self.get_states()
+        for k, v in tree["model"].items():
+            states[k].data = v
+        if self._optimizer is not None and tree.get("opt"):
+            # (setup already ran while building the restore template, so
+            # the positional slot order below cannot misalign)
+            opt_tree = tree["opt"]
+            arrs = [opt_tree[f"s{i}"] for i in range(len(opt_tree))]
+            self._optimizer.load_state_arrays(arrs)
+            load_stacks = getattr(self._optimizer,
+                                  "load_residual_device_stacks", None)
+            if load_stacks is not None and tree.get("res"):
+                load_stacks({int(k[1:]): np.asarray(v)
+                             for k, v in tree["res"].items()})
+        from .device import get_default_device
+        dev = self._device or get_default_device()
+        dev.rng_state = jax.random.wrap_key_data(
+            jnp.asarray(np.asarray(tree["rng"]), jnp.uint32))
+        self._compiled_step = None  # drop stale executable state binding
+        return self
+
+    def load_states(self, fpath: str) -> dict:
+        with zipfile.ZipFile(fpath, "r") as zf:
+            with zf.open("tensor_dict.npz") as f:
+                loaded = dict(np.load(io.BytesIO(f.read())))
+        aux = {k[len("aux."):]: v for k, v in loaded.items()
+               if k.startswith("aux.")}
+        model_states = {k: v for k, v in loaded.items()
+                        if not k.startswith("aux.")}
+        self.set_states(model_states)
+        self._compiled_step = None  # drop stale executable state binding
+        return aux

@@ -1,0 +1,36 @@
+"""Shared classifier base: forward -> logits, softmax-CE loss, and the
+dist_option dispatch that every reference example model repeats verbatim
+(e.g. examples/cnn/model/cnn.py:53-71)."""
+
+from __future__ import annotations
+
+from .. import layer, model
+
+
+class Classifier(model.Model):
+    """Subclass and define `forward(x) -> logits`."""
+
+    def __init__(self, num_classes=10, name=None):
+        super().__init__(name)
+        self.num_classes = num_classes
+        self.softmax_cross_entropy = layer.SoftMaxCrossEntropy()
+
+    def train_one_batch(self, x, y, dist_option="plain", spars=None):
+        out = self.forward(x)
+        loss = self.softmax_cross_entropy(out, y)
+        opt = self.optimizer
+        if dist_option == "plain":
+            opt(loss)
+        elif dist_option == "half":
+            opt.backward_and_update_half(loss)
+        elif dist_option == "partialUpdate":
+            opt.backward_and_partial_update(loss)
+        elif dist_option == "sparseTopK":
+            opt.backward_and_sparse_update(loss, topK=True,
+                                           spars=spars if spars else 0.05)
+        elif dist_option == "sparseThreshold":
+            opt.backward_and_sparse_update(loss, topK=False,
+                                           spars=spars if spars else 0.05)
+        else:
+            raise ValueError(f"unknown dist_option {dist_option!r}")
+        return out, loss

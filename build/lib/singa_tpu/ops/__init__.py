@@ -1,0 +1,7 @@
+"""Custom fused ops: scan RNNs, attention (flash/ring), Pallas kernels.
+
+These replace the reference's handle-backed C++/CUDA primitives
+(src/model/operation/*) with XLA/Pallas-native implementations.
+"""
+
+from . import rnn  # noqa: F401

@@ -1,0 +1,164 @@
+"""Fused scan-based LSTM — TPU-native replacement for CudnnRNNHandle.
+
+Reference parity: src/model/operation/rnn.cc (`GpuRNNForwardTraining`,
+`GpuRNNBackwardx/W`, rnn.h:99-131) binds cuDNN's fused RNN. On TPU the same
+fusion is a `lax.scan` whose per-step body is one fused (x_t@Wx + h@Wh)
+matmul hitting the MXU; backward comes from the scan's vjp (XLA materializes
+the reverse scan), replacing the hand-rolled cuDNN backward calls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+from ..autograd import Operator
+from .. import initializer
+
+
+def init_lstm_params(in_size: int, hidden: int, device, dtype):
+    Wx = Tensor((in_size, 4 * hidden), device=device, dtype=dtype)
+    initializer.glorot_uniform(Wx)
+    Wh = Tensor((hidden, 4 * hidden), device=device, dtype=dtype)
+    initializer.glorot_uniform(Wh)
+    b = Tensor((4 * hidden,), device=device, dtype=dtype)
+    b.set_value(0.0)
+    # forget-gate bias 1.0 (standard practice; cuDNN default is 0)
+    b.data = b.data.at[hidden:2 * hidden].set(1.0)
+    return Wx, Wh, b
+
+
+def _lstm_cell(carry, xt, Wx, Wh, b, hidden):
+    h, c = carry
+    z = xt @ Wx + h @ Wh + b
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
+class _LSTMScan(Operator):
+    """Multi-step LSTM as one tape node; outputs (ys, hy, cy)."""
+
+    def __init__(self, hidden: int):
+        super().__init__("LSTMScan")
+        self.hidden = hidden
+
+    def forward(self, x, hx, cx, Wx, Wh, b):
+        def body(carry, xt):
+            return _lstm_cell(carry, xt, Wx, Wh, b, self.hidden)
+
+        (hy, cy), ys = lax.scan(body, (hx, cx), x)
+        return ys, hy, cy
+
+
+def lstm_scan(x: Tensor, hx: Tensor, cx: Tensor, Wx: Tensor, Wh: Tensor,
+              b: Tensor):
+    """x: (seq, batch, feature) -> (ys, hy, cy) Tensors."""
+    return _LSTMScan(Wh.shape[0])(x, hx, cx, Wx, Wh, b)
+
+
+class _LSTMScanEx(Operator):
+    """Variable-length batch LSTM — parity with the reference's
+    `GpuRNNForwardTrainingEx` packed-sequence API (rnn.h:117-131): padded
+    (seq, batch, feat) input + per-sample lengths. Steps beyond a sample's
+    length freeze its (h, c) carry and zero its output, so hy/cy are the
+    states at each sample's true last step, exactly like cuDNN's Ex
+    variants. Lengths ride the tape as a non-differentiable int input."""
+
+    def __init__(self, hidden: int):
+        super().__init__("LSTMScanEx")
+        self.hidden = hidden
+
+    def forward(self, x, lengths, hx, cx, Wx, Wh, b):
+        T = x.shape[0]
+
+        def body(carry, inp):
+            h, c = carry
+            xt, t = inp
+            (h2, c2), _ = _lstm_cell((h, c), xt, Wx, Wh, b, self.hidden)
+            mask = (t < lengths)[:, None]
+            h_new = jnp.where(mask, h2, h)
+            c_new = jnp.where(mask, c2, c)
+            y = jnp.where(mask, h2, jnp.zeros_like(h2))
+            return (h_new, c_new), y
+
+        (hy, cy), ys = lax.scan(
+            body, (hx, cx), (x, jnp.arange(T, dtype=jnp.int32)))
+        return ys, hy, cy
+
+
+def lstm_scan_ex(x: Tensor, lengths: Tensor, hx: Tensor, cx: Tensor,
+                 Wx: Tensor, Wh: Tensor, b: Tensor):
+    """Variable-length lstm_scan; lengths (batch,) int32."""
+    return _LSTMScanEx(Wh.shape[0])(x, lengths, hx, cx, Wx, Wh, b)
+
+
+class _ReversePadded(Operator):
+    """Reverse each sample's valid prefix along time (padding stays put) —
+    the input transform for the backward direction of a bidirectional RNN
+    over variable-length batches."""
+
+    def forward(self, x, lengths):
+        T = x.shape[0]
+        t = jnp.arange(T, dtype=jnp.int32)[:, None]          # (T, 1)
+        idx = jnp.where(t < lengths[None, :], lengths[None, :] - 1 - t, t)
+        return jnp.take_along_axis(x, idx[:, :, None], axis=0)
+
+
+def reverse_padded(x: Tensor, lengths: Tensor):
+    return _ReversePadded()(x, lengths)
+
+
+class _GRUScan(Operator):
+    def __init__(self, hidden: int, linear_before_reset: bool = True):
+        super().__init__("GRUScan")
+        self.hidden = hidden
+        self.lbr = bool(linear_before_reset)
+
+    def forward(self, x, hx, Wx, Wh, b, rb=None):
+        H = self.hidden
+        lbr = self.lbr
+
+        def body(h, xt):
+            zx = xt @ Wx + b
+            # lbr=0 recomputes the candidate's recurrent term from r*h, so
+            # only the r/u gate columns of Wh are needed up front
+            Whg = Wh if lbr else Wh[:, :2 * H]
+            zh = h @ Whg
+            if rb is not None:
+                zh = zh + (rb if lbr else rb[:2 * H])
+            r = jax.nn.sigmoid(zx[..., :H] + zh[..., :H])
+            u = jax.nn.sigmoid(zx[..., H:2 * H] + zh[..., H:2 * H])
+            if lbr:
+                # n = tanh(Wn x + Wbn + r * (Rn h + Rbn))
+                n = jnp.tanh(zx[..., 2 * H:] + r * zh[..., 2 * H:])
+            else:
+                # n = tanh(Wn x + Wbn + (r*h) Rn + Rbn): reset applies to h
+                # BEFORE the recurrent matmul (ONNX linear_before_reset=0)
+                nr = (r * h) @ Wh[:, 2 * H:]
+                if rb is not None:
+                    nr = nr + rb[2 * H:]
+                n = jnp.tanh(zx[..., 2 * H:] + nr)
+            h_new = (1 - u) * n + u * h
+            return h_new, h_new
+
+        hy, ys = lax.scan(body, hx, x)
+        return ys, hy
+
+
+def gru_scan(x: Tensor, hx: Tensor, Wx: Tensor, Wh: Tensor, b: Tensor,
+             rb: Tensor | None = None, linear_before_reset: bool = True):
+    """Optional `rb` is a separate recurrent bias (3H,). With
+    `linear_before_reset` (torch/keras-reset_after exports) it is added to
+    `h @ Wh` inside the reset multiply; without, the reset gate multiplies
+    `h` before the candidate's recurrent matmul (ONNX GRU lbr=0)."""
+    op = _GRUScan(Wh.shape[0], linear_before_reset)
+    return op(x, hx, Wx, Wh, b, rb) if rb is not None \
+        else op(x, hx, Wx, Wh, b)

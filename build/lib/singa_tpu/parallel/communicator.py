@@ -1,0 +1,167 @@
+"""Mesh-axis collectives — the NCCL Communicator, TPU-native.
+
+Reference parity: `Communicator` (include/singa/io/communicator.h:76-152,
+src/io/communicator.cc) exposes synch / fusedSynch / synchHalf /
+fusedSynchHalf / sparsification / fusedSparsification / wait over NCCL with
+a 3-stream copy-in/comm/copy-out pipeline.
+
+TPU-native redesign: each method is a jnp/lax expression over a *mesh axis*;
+when called inside Model's shard_mapped step the axis is bound and XLA emits
+an ICI all-reduce/all-gather, scheduled asynchronously by the latency-hiding
+scheduler (this subsumes the reference's stream/event pipeline and the
+fused-buffer trick — XLA's all-reduce combiner fuses small collectives).
+With world_size == 1 every method degrades to the identity, which is what
+lets the reference's `test_dist.py` pattern pass without a cluster.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import data_parallel_mesh
+
+
+class Communicator:
+    """`axis` may be one mesh axis name or a TUPLE of names — a tuple
+    reduces over the product group (e.g. ("data", "ep") for DP+EP training,
+    where expert grads need the reduction to cover the ep axis too)."""
+
+    def __init__(self, axis="data", mesh=None):
+        self.axis = axis
+        self.mesh = mesh
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        if mesh is not None:
+            ws = 1
+            for a in axes:
+                ws *= int(mesh.shape[a])
+            self.world_size = ws
+        else:
+            self.world_size = 1
+        # parity attributes (communicator.h): global/local rank only
+        # meaningful inside the mapped step via lax.axis_index
+        self.global_rank = 0
+        self.local_rank = 0
+
+    def rank(self):
+        """Traced rank inside the mapped step (row-major over tuple axes)."""
+        if self.world_size == 1:
+            return jnp.zeros((), jnp.int32)
+        if isinstance(self.axis, tuple):
+            idx = jnp.zeros((), jnp.int32)
+            for a in self.axis:
+                idx = idx * lax.axis_size(a) + lax.axis_index(a)
+            return idx
+        return lax.axis_index(self.axis)
+
+    # -- synch / fusedSynch (communicator.cc:212-327) ----------------------
+    def all_reduce(self, x):
+        """Sum over the axis (reference `synch`). Fusion of small tensors is
+        XLA's all-reduce combiner; no manual buffer packing needed."""
+        if self.world_size == 1:
+            return x
+        return lax.psum(x, self.axis)
+
+    # -- synchHalf (communicator.cc:330-467) -------------------------------
+    def all_reduce_half(self, x):
+        """Halved-width allreduce: bf16 over ICI (fp16 in the reference)."""
+        if self.world_size == 1:
+            return x
+        return lax.psum(x.astype(jnp.bfloat16), self.axis).astype(x.dtype)
+
+    def all_gather(self, x, tiled=True):
+        if self.world_size == 1:
+            return x
+        return lax.all_gather(x, self.axis, axis=0, tiled=tiled)
+
+    def broadcast(self, x, root=0):
+        """Tree broadcast via ppermute (binomial doubling): ceil(log2 n)
+        rounds, total wire bytes (n-1)·|x| — vs the masked-psum fallback
+        whose allreduce moves ~2(n-1)·|x| regardless of the zeros. Only
+        root's value is consumed; every other device's x is ignored."""
+        if self.world_size == 1:
+            return x
+        assert not isinstance(self.axis, tuple), \
+            "broadcast over a tuple axis is ambiguous; pick one axis"
+        n = self.world_size
+        rel = (self.rank() - root) % n        # root-relative index
+        val = x
+        k = 1
+        while k < n:
+            # relative devices [0, k) send to [k, 2k)
+            pairs = [((i + root) % n, (i + k + root) % n)
+                     for i in range(min(k, n - k))]
+            recv = lax.ppermute(val, self.axis, pairs)
+            adopt = (rel >= k) & (rel < 2 * k)
+            val = jnp.where(adopt, recv, val)
+            k *= 2
+        return val
+
+    def reduce_scatter(self, x):
+        if self.world_size == 1:
+            return x
+        return lax.psum_scatter(x, self.axis, scatter_dimension=0, tiled=True)
+
+    def wait(self):
+        """Stream fence (communicator.cc:169-186): nothing to do — XLA's
+        dataflow ordering subsumes the reference's cross-stream events."""
+
+    # -- sparsification (communicator.cc:619-807) --------------------------
+    def sparse_all_reduce_topk(self, x, frac: float):
+        """Top-K sparsified allreduce.
+
+        Reference (`topKSparsAllReduce`, communicator.cc:721-807): thrust
+        sort for top-K, allgather of (index, value) pairs, cusparse axpy
+        accumulate. Here: lax.top_k + all_gather of the (idx, val) pairs
+        (2*K*world elements over ICI instead of N) + one scatter-add.
+        Returns (summed_dense, residual_for_error_feedback).
+        """
+        flat = x.ravel()
+        n = flat.size
+        k = max(1, int(n * float(frac)))
+        _, idx = lax.top_k(jnp.abs(flat), k)
+        vals = jnp.take(flat, idx)
+        residual = flat.at[idx].set(0.0).reshape(x.shape)
+        if self.world_size == 1:
+            out = jnp.zeros_like(flat).at[idx].add(vals)
+            return out.reshape(x.shape), residual
+        gidx = lax.all_gather(idx, self.axis)    # (world, k)
+        gvals = lax.all_gather(vals, self.axis)  # (world, k)
+        out = jnp.zeros_like(flat).at[gidx.ravel()].add(gvals.ravel())
+        return out.reshape(x.shape), residual
+
+    def sparse_all_reduce_threshold(self, x, threshold: float,
+                                    capacity_frac: float = 0.1):
+        """Threshold-sparsified allreduce with REAL packed communication
+        (`valSparsAllReduce`, communicator.cc:619-719).
+
+        The reference pads to the runtime max-nnz across ranks and
+        allgathers (index, value) pairs (communicator.cc:667-688). XLA
+        requires static shapes, so the pad target is a static `capacity`
+        (= n * capacity_frac) instead of the runtime max: each rank packs
+        its up-to-`capacity` largest above-threshold entries, allgathers
+        2*capacity elements (vs n for dense), and scatter-adds. Entries
+        beyond capacity stay in the residual, exactly like sub-threshold
+        ones — the error-feedback accumulation (ref `sparsification`
+        backup tensor) re-sends them on later steps, so nothing is lost.
+        Returns (summed_dense, residual_for_error_feedback).
+        """
+        flat = x.ravel()
+        n = flat.size
+        cap = max(1, min(n, int(n * float(capacity_frac))))
+        absx = jnp.abs(flat)
+        score = jnp.where(absx >= threshold, absx, -jnp.inf)
+        _, idx = lax.top_k(score, cap)
+        taken = jnp.take(score, idx) > -jnp.inf   # really above threshold
+        vals = jnp.where(taken, jnp.take(flat, idx), 0.0)
+        idx_safe = jnp.where(taken, idx, 0)       # 0-adds land on index 0
+        sent = jnp.zeros_like(flat).at[idx_safe].add(vals)
+        residual = (flat - sent).reshape(x.shape)
+        if self.world_size == 1:
+            return sent.reshape(x.shape), residual
+        # wire payload: 2 * cap elements per rank (idx + val), NOT n
+        gidx = lax.all_gather(idx_safe, self.axis)   # (world, cap)
+        gvals = lax.all_gather(vals, self.axis)      # (world, cap)
+        out = jnp.zeros_like(flat).at[gidx.ravel()].add(gvals.ravel())
+        return out.reshape(x.shape), residual

@@ -1,0 +1,54 @@
+"""Device-mesh construction helpers.
+
+The mesh is the TPU-native replacement for the reference's
+rank/world_size/NCCL-id bootstrap (src/io/communicator.cc:54-114): axes name
+the parallelism dimensions (dp/tp/sp/pp/ep) and XLA routes collectives over
+ICI within an axis.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(axis_sizes: dict, devices=None) -> Mesh:
+    """make_mesh({'data': 4, 'model': 2}) -> Mesh over the first 8 devices.
+
+    Axis order follows dict order; innermost (last) axis maps to physically
+    adjacent devices so its collectives ride the fastest ICI links.
+    """
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(int(v) for v in axis_sizes.values())
+    n = int(np.prod(sizes))
+    devs = list(devices if devices is not None else jax.devices())[:n]
+    assert len(devs) == n, f"need {n} devices, have {len(devs)}"
+    return Mesh(np.array(devs).reshape(sizes), names)
+
+
+def data_parallel_mesh(n: int | None = None, axis: str = "data") -> Mesh:
+    n = n if n is not None else local_device_count()
+    return make_mesh({axis: n})
+
+
+def factor_mesh(n_devices: int, axes=("dp", "sp", "tp")) -> Mesh:
+    """Balanced factorization of n_devices over the given axes (trailing
+    axes get the larger factors so tp/sp collectives stay on close links)."""
+    sizes = [1] * len(axes)
+    remaining = n_devices
+    i = len(axes) - 1
+    while remaining > 1:
+        # largest power-of-two factor first onto the innermost axis
+        f = 2 if remaining % 2 == 0 else remaining
+        sizes[i] *= f
+        remaining //= f
+        i = (i - 1) % len(axes)
+    assert math.prod(sizes) == n_devices
+    return make_mesh(dict(zip(axes, sizes)))

@@ -1,0 +1,153 @@
+"""Mixture-of-Experts with expert parallelism over a mesh axis (no
+reference counterpart — SINGA has no MoE; EP is first-class here).
+
+Top-k routing with capacity (k=1 is the Switch Transformer, k=2 the
+GShard/ST-MoE default): tokens pick k experts by gate probability and the
+gates are renormalized over the chosen k; each expert accepts at most
+`capacity` tokens per device (overflow tokens pass through that choice with
+zero expert output, standard switch behavior — the dropped fraction is
+surfaced in `stats`). A router z-loss (ST-MoE: mean squared logsumexp of
+the router logits) is also returned so training can keep router logits
+small. Under EP, experts are sharded over the 'ep' axis and token blocks
+move with TWO lax.all_to_all hops (dispatch + return) — the all-to-all
+rides ICI and XLA overlaps it with the expert matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def topk_gating(x, Wg, capacity: int, k: int = 1):
+    """x: (T, D) tokens; Wg: (D, E). Returns (dispatch (T,E,C), combine
+    (T,E,C), aux, z_loss, overflow):
+      dispatch — one-hot token->(expert, slot) routing for kept choices
+      combine  — dispatch weighted by the renormalized gate
+      aux      — switch load-balance loss (E * sum frac_tokens*frac_probs,
+                 first-choice assignment fractions)
+      z_loss   — mean(logsumexp(logits)^2), the ST-MoE router z-loss
+      overflow — fraction of (token, choice) routes dropped by capacity
+    """
+    T = x.shape[0]
+    logits = jnp.dot(x, Wg)                               # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    E = probs.shape[-1]
+    z = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    z_loss = jnp.mean(z * z)
+
+    topv, topi = lax.top_k(probs, k)                      # (T, k)
+    renorm = topv / jnp.sum(topv, axis=-1, keepdims=True)
+
+    fill = jnp.zeros((E,), x.dtype)      # per-expert queue fill so far
+    dispatch = jnp.zeros((T, E, capacity), x.dtype)
+    combine = jnp.zeros((T, E, capacity), x.dtype)
+    kept_total = jnp.zeros((), x.dtype)
+    for j in range(k):
+        mask = jax.nn.one_hot(topi[:, j], E, dtype=x.dtype)   # (T, E)
+        # queue position = tokens already kept by earlier choices (fill)
+        # + this choice's own running count
+        pos = (jnp.cumsum(mask, axis=0) - 1.0) * mask + fill[None, :] * mask
+        keep = mask * (pos < capacity).astype(x.dtype)
+        pos_idx = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)  # (T,)
+        slot = jax.nn.one_hot(pos_idx, capacity, dtype=x.dtype)   # (T, C)
+        d_j = keep[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d_j
+        combine = combine + d_j * renorm[:, j][:, None, None]
+        fill = fill + jnp.sum(keep, axis=0)
+        kept_total = kept_total + jnp.sum(keep)
+
+    # load balance on FIRST-choice assignment (switch-transformer form)
+    mask0 = jax.nn.one_hot(topi[:, 0], E, dtype=x.dtype)
+    frac_tokens = jnp.mean(mask0, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    overflow = 1.0 - kept_total / (T * k)
+    return dispatch, combine, aux, z_loss, overflow
+
+
+def top1_gating(x, Wg, capacity: int):
+    """Back-compat switch (k=1) gating: (dispatch, combine, aux)."""
+    dispatch, combine, aux, _, _ = topk_gating(x, Wg, capacity, k=1)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(blocks, W1, b1, W2, b2, act):
+    """blocks: (E, C, D); per-expert two-layer FFN, batched over E."""
+    h = act(jnp.einsum("ecd,edh->ech", blocks, W1) + b1[:, None, :])
+    return jnp.einsum("ech,ehd->ecd", h, W2) + b2[:, None, :]
+
+
+def moe_ffn(x, Wg, W1, b1, W2, b2, capacity_factor=1.25, act=None, k=1):
+    """Single-device MoE: x (T, D); W1 (E, D, H); W2 (E, H, D).
+    Returns (y, aux, stats) with stats = (z_loss, overflow)."""
+    act = act or jax.nn.gelu
+    T = x.shape[0]
+    E = W1.shape[0]
+    capacity = max(1, int(T * k * capacity_factor / E))
+    dispatch, combine, aux, z_loss, overflow = topk_gating(
+        x, Wg, capacity, k)
+    blocks = jnp.einsum("tec,td->ecd", dispatch, x)       # (E, C, D)
+    out_blocks = _expert_ffn(blocks, W1, b1, W2, b2, act)
+    y = jnp.einsum("tec,ecd->td", combine, out_blocks)
+    return y, aux, (z_loss, overflow)
+
+
+def _a2a(x, axis_name: str, split_axis: int, concat_axis: int):
+    """lax.all_to_all with an explicit custom vjp: the transpose of an
+    all_to_all is the mirrored all_to_all (it permutes data across
+    devices, so its linear adjoint is the inverse permutation). JAX's
+    built-in transpose rule mis-lowers when the op is differentiated
+    through a lax.scan (the PP x EP pipeline case: expert dispatch
+    inside the gpipe slot scan) — the explicit rule sidesteps it and is
+    what the math says anyway."""
+
+    @jax.custom_vjp
+    def run(v):
+        return lax.all_to_all(v, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis)
+
+    def fwd(v):
+        return run(v), None
+
+    def bwd(_, dy):
+        return (lax.all_to_all(dy, axis_name, split_axis=concat_axis,
+                               concat_axis=split_axis),)
+
+    run.defvjp(fwd, bwd)
+    return run(x)
+
+
+def moe_ffn_ep(x, Wg, W1, b1, W2, b2, axis_name: str,
+               capacity_factor=1.25, act=None, k=1):
+    """Expert-parallel MoE inside shard_map.
+
+    x: (T_local, D) this device's tokens; Wg (D, E_global) replicated;
+    W1/b1/W2/b2 hold only the E_local = E_global/n experts this device
+    owns. Token blocks for remote experts travel via all_to_all.
+    Returns (y, aux, stats); aux/stats are pmean'd over the axis.
+    """
+    act = act or jax.nn.gelu
+    n = lax.axis_size(axis_name)
+    T = x.shape[0]
+    E = Wg.shape[1]
+    e_local = E // n
+    capacity = max(1, int(T * k * capacity_factor / E))
+    dispatch, combine, aux, z_loss, overflow = topk_gating(
+        x, Wg, capacity, k)
+    blocks = jnp.einsum("tec,td->ecd", dispatch, x)       # (E, C, D)
+    # group by owning device and exchange: (n, E_local, C, D) -> each
+    # device receives its expert group from everyone -> (E_local, n, C, D)
+    grouped = blocks.reshape(n, e_local, capacity, -1)
+    received = _a2a(grouped, axis_name, 0, 1)             # (e_local,n,C,D)
+    stacked = received.reshape(e_local, n * capacity, -1)
+    out = _expert_ffn(stacked, W1, b1, W2, b2, act)       # (e_local,nC,D)
+    out = out.reshape(e_local, n, capacity, -1)
+    returned = _a2a(out, axis_name, 1, 0)                 # (n,e_local,C,D)
+    out_blocks = returned.reshape(E, capacity, -1)
+    y = jnp.einsum("tec,ecd->td", combine, out_blocks)
+    aux = lax.pmean(aux, axis_name)
+    z_loss = lax.pmean(z_loss, axis_name)
+    overflow = lax.pmean(overflow, axis_name)
+    return y, aux, (z_loss, overflow)

@@ -1,0 +1,378 @@
+"""Pipeline parallelism: GPipe-style SPMD pipeline over a mesh axis (no
+reference counterpart — SURVEY.md §2.3).
+
+`gpipe` runs inside shard_map: every device holds ONE stage's params; the
+microbatch stream flows through the ring with `lax.ppermute` (the jax-level
+form of the inter-chip RDMA ring in /opt/skills/guides/pallas_guide.md §18).
+The whole schedule is a lax.scan, so jax.grad differentiates through it —
+backward replays the scan reversed with ppermute transposed, giving the
+reverse pipeline for free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(stage_fn, stage_params, x_micro, axis_name, with_aux=False):
+    """Run the pipeline.
+
+    stage_fn(params, x) -> y: one stage's computation; activation shape
+        must be the same for every stage (classic GPipe constraint).
+        With `with_aux`, stage_fn returns (y, aux) where aux is a
+        fixed-shape array of per-stage scalars (e.g. MoE router losses);
+        aux is accumulated ONLY over this stage's active slots (warmup/
+        drain slots run on garbage and must not pollute it).
+    stage_params: this device's stage params (pytree of arrays).
+    x_micro: (n_micro, mb, ...) microbatched input, same value on every
+        device (only stage 0 consumes it).
+    Returns (n_micro, mb, ...) outputs — valid on the LAST stage; other
+        stages hold zeros (psum/select on the caller side if needed).
+    With `with_aux`: (outs, aux_sum) — aux_sum is this DEVICE's stage's
+        aux summed over the n_micro active slots (psum over the axis and
+        divide by n_micro for the per-microbatch mean).
+    """
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    steps = n_micro + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    buf = jnp.zeros_like(x_micro[0])
+    outs = jnp.zeros_like(x_micro)
+
+    def step(carry, t):
+        buf, outs, aux_acc = carry
+        mb = jnp.clip(t, 0, n_micro - 1)
+        inp = jnp.where(stage == 0,
+                        lax.dynamic_index_in_dim(x_micro, mb, 0,
+                                                 keepdims=False),
+                        buf)
+        if with_aux:
+            y, aux = stage_fn(stage_params, inp)
+            active = ((t >= stage) & (t - stage < n_micro)).astype(
+                aux.dtype)
+            aux_acc = aux_acc + aux * active
+        else:
+            y = stage_fn(stage_params, inp)
+        out_idx = t - (n - 1)
+        write = jnp.logical_and(stage == n - 1, out_idx >= 0)
+        safe_idx = jnp.maximum(out_idx, 0)
+        cur = lax.dynamic_index_in_dim(outs, safe_idx, 0, keepdims=False)
+        upd = jnp.where(write, y, cur)
+        outs = lax.dynamic_update_index_in_dim(outs, upd, safe_idx, 0)
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, outs, aux_acc), None
+
+    if with_aux:
+        # derive the aux accumulator's shape/dtype from stage_fn itself
+        # (not a hardcoded (2,) float32): any fixed-shape aux works
+        import jax
+        _, aux_sd = jax.eval_shape(stage_fn, stage_params, x_micro[0])
+        aux0 = jnp.zeros(aux_sd.shape, aux_sd.dtype)
+    else:
+        aux0 = jnp.zeros((), jnp.float32)
+    (buf, outs, aux_acc), _ = lax.scan(step, (buf, outs, aux0),
+                                       jnp.arange(steps))
+    return (outs, aux_acc) if with_aux else outs
+
+
+def gpipe_interleaved(chunk_fn, stage_params, x_micro, axis_name,
+                      n_chunks):
+    """Interleaved (virtual-stage) GPipe: each device holds `n_chunks`
+    model chunks assigned ROUND-ROBIN (device d owns global stages
+    {c*n + d : c < n_chunks}), so the activation stream makes n_chunks
+    passes around the same d->d+1 ring and each warmup/drain slot costs
+    1/n_chunks of a device's model — bubble (n-1)/(V*M + ...) instead of
+    GPipe's (n-1)/(M+n-1) (see schedule_table; V=2, n=8, M=32: 9.9% vs
+    17.9%) at the same autodiff-through-scan memory profile.
+
+    The closed-form schedule: microbatch m = q*n + r runs chunk c on
+    device d at slot t = (q*V + c)*n + r + d. Every hop — including the
+    wrap from device n-1 to chunk c+1 on device 0 — lands exactly at
+    t+1 on the same ring permute, so the whole schedule is one lax.scan
+    and jax.grad differentiates through it like `gpipe`.
+
+    chunk_fn(params, x, c) -> y: apply THIS device's chunk `c` (a traced
+        int32 in [0, n_chunks)) to x.
+    Returns (n_micro, mb, ...) outputs, valid on the last device (the
+    holder of the final chunk's final stage).
+    """
+    n = lax.axis_size(axis_name)
+    d = lax.axis_index(axis_name)
+    M = x_micro.shape[0]
+    V = n_chunks
+    Q = -(-M // n)
+    T = ((Q - 1) * V + (V - 1)) * n + 2 * (n - 1) + 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    buf = jnp.zeros_like(x_micro[0])
+    outs = jnp.zeros_like(x_micro)
+
+    def step(carry, t):
+        buf, outs = carry
+        u = t - d
+        j = jnp.maximum(u, 0) // n
+        r = jnp.maximum(u, 0) % n
+        c = j % V
+        q = j // V
+        m = q * n + r
+        on = (u >= 0) & (m < M)
+        m_safe = jnp.clip(m, 0, M - 1)
+        g = c * n + d                    # global stage index
+        inp = jnp.where(g == 0,
+                        lax.dynamic_index_in_dim(x_micro, m_safe, 0,
+                                                 keepdims=False),
+                        buf)
+        y = chunk_fn(stage_params, inp, c)
+        is_final = (c == V - 1) & (d == n - 1)
+        prev = lax.dynamic_index_in_dim(outs, m_safe, 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(on & is_final, y, prev), m_safe, 0)
+        buf = lax.ppermute(jnp.where(on, y, jnp.zeros_like(y)),
+                           axis_name, perm)
+        return (buf, outs), None
+
+    (buf, outs), _ = lax.scan(step, (buf, outs), jnp.arange(T))
+    return outs
+
+
+def one_f_one_b(stage_fn, last_fn, stage_params, last_params, x_micro,
+                tgt_micro, axis_name):
+    """1F1B schedule as one fused fwd+bwd scan (Megatron's memory-bounded
+    pipeline, in SPMD form).
+
+    GPipe-by-autodiff (`gpipe` + jax.vjp) must finish ALL forwards before
+    any backward, so every stage holds n_micro residual sets. Here forward
+    of microbatch m+Δ overlaps backward of microbatch m inside ONE scan:
+
+        t_fwd(stage s, mb m)  = s + m
+        t_bwd(stage s, mb m)  = 2n - 1 - s + m
+
+    so in steady state every slot does one fwd AND one bwd (both useful
+    work), the cotangent ring runs opposite to the activation ring, and a
+    stage's in-flight saved activations are bounded by t_bwd - t_fwd =
+    2(n - s) - 1 <= 2n - 1 — independent of n_micro. Only the stage INPUT
+    is saved (activation checkpointing at stage boundaries); the stage vjp
+    is recomputed when the cotangent arrives.
+
+    The LOSS lives inside the schedule: `last_fn(last_params, y, tgt)` is
+    applied by the last stage (LN/head/CE for a GPT), because 1F1B's
+    interleaving is only possible when the backward can start while other
+    microbatches are still going forward — a tape op that returns
+    activations and waits for a cotangent cannot interleave by
+    construction.
+
+    Returns (loss_mean, outs, d_stage_params, d_last_params, dx_micro):
+      loss_mean  — mean over microbatches, broadcast to every stage
+      outs       — (n_micro, mb, ...) last-stage activations (for the
+                   caller-facing logits path), valid on the last stage
+      d_stage_params — this device's stage-param cotangents (local slice)
+      d_last_params  — last_fn param cotangents, psum'd over the axis so
+                   replicated params see replicated grads
+      dx_micro   — cotangent of x_micro, nonzero on stage 0 (psum it over
+                   the axis if the producer is replicated — Model's
+                   tp_copy on the pipeline input already does)
+    """
+    import jax
+
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = x_micro.shape[0]
+    BUF = min(2 * n, M) if M > 0 else 1
+    T = M + 2 * n - 2        # last slot index: t_bwd(0, M-1) = (2n-1)+(M-1)
+    perm_fwd = [(i, (i + 1) % n) for i in range(n)]
+    perm_bwd = [(i, (i - 1) % n) for i in range(n)]
+    is_last = stage == n - 1
+    is_first = stage == 0
+
+    zero_stage_g = jax.tree.map(jnp.zeros_like, stage_params)
+    zero_last_g = jax.tree.map(jnp.zeros_like, last_params)
+
+    act_buf = jnp.zeros((BUF,) + x_micro.shape[1:], x_micro.dtype)
+    outs = jnp.zeros_like(x_micro)
+    dx_out = jnp.zeros_like(x_micro)
+    fwd_buf = jnp.zeros_like(x_micro[0])
+    bwd_buf = jnp.zeros_like(x_micro[0])
+    loss_acc = jnp.zeros((), jnp.float32)
+
+    def slot(carry, t):
+        (act_buf, outs, dx_out, fwd_buf, bwd_buf, d_stage, d_last,
+         loss_acc) = carry
+
+        # ---- backward half, part 1: read mb m_b's saved input BEFORE the
+        # forward half reuses its circular-buffer slot (when M < 2n the
+        # consuming and producing microbatch can share a slot in the same
+        # scan iteration) ----
+        m_b = t - (2 * n - 1 - stage)
+        b_on = (m_b >= 0) & (m_b < M)
+        m_b_safe = jnp.clip(m_b, 0, M - 1)
+        x_saved = lax.dynamic_index_in_dim(act_buf, m_b_safe % BUF, 0,
+                                           keepdims=False)
+        tgt_b = lax.dynamic_index_in_dim(tgt_micro, m_b_safe, 0,
+                                         keepdims=False)
+
+        # ---- forward half: mb m_f enters this stage ----
+        m_f = t - stage
+        f_on = (m_f >= 0) & (m_f < M)
+        m_f_safe = jnp.clip(m_f, 0, M - 1)
+        x_in = jnp.where(is_first,
+                         lax.dynamic_index_in_dim(x_micro, m_f_safe, 0,
+                                                  keepdims=False),
+                         fwd_buf)
+        y = stage_fn(stage_params, x_in)
+        # save the stage INPUT for the remat vjp at backward time
+        slot_i = m_f_safe % BUF
+        prev = lax.dynamic_index_in_dim(act_buf, slot_i, 0, keepdims=False)
+        act_buf = lax.dynamic_update_index_in_dim(
+            act_buf, jnp.where(f_on, x_in, prev), slot_i, 0)
+        o_prev = lax.dynamic_index_in_dim(outs, m_f_safe, 0, keepdims=False)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(f_on & is_last, y, o_prev), m_f_safe, 0)
+
+        # ---- backward half, part 2: remat + vjp ----
+
+        # remat: rebuild this stage's vjp from the saved input
+        y_b, stage_vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        # last stage seeds the cotangent from the in-schedule loss.
+        # COST NOTE (schedule_compute_overhead): this fwd+vjp of last_fn
+        # runs in EVERY slot on EVERY stage, gated out below on all but
+        # the last — uniform SPMD keeps the tp collectives inside last_fn
+        # legal, at the price of duplicating the head matmul n_stages x.
+        # A lax.cond on the stage index would trade that for collectives
+        # inside conditional branches; measured honest accounting is
+        # preferred over that fragility.
+        loss_m, last_vjp = jax.vjp(last_fn, last_params, y_b, tgt_b)
+        dlast_m, dy_loss, _ = last_vjp(jnp.float32(1.0 / M))
+        dy_in = jnp.where(is_last, dy_loss.astype(bwd_buf.dtype), bwd_buf)
+        dparams_m, dx_m = stage_vjp(dy_in.astype(y_b.dtype))
+
+        gate = b_on.astype(jnp.float32)
+        lgate = (b_on & is_last).astype(jnp.float32)
+        d_stage = jax.tree.map(
+            lambda acc, g: acc + g * gate.astype(g.dtype),
+            d_stage, dparams_m)
+        d_last = jax.tree.map(
+            lambda acc, g: acc + g * lgate.astype(g.dtype),
+            d_last, dlast_m)
+        loss_acc = loss_acc + loss_m.astype(jnp.float32) * lgate / M
+        dxp = lax.dynamic_index_in_dim(dx_out, m_b_safe, 0, keepdims=False)
+        dx_out = lax.dynamic_update_index_in_dim(
+            dx_out, jnp.where(b_on & is_first, dx_m, dxp), m_b_safe, 0)
+
+        # rings: activations flow down-stage, cotangents up-stage
+        fwd_buf = lax.ppermute(jnp.where(f_on, y, jnp.zeros_like(y)),
+                               axis_name, perm_fwd)
+        bwd_buf = lax.ppermute(
+            jnp.where(b_on, dx_m, jnp.zeros_like(dx_m)).astype(
+                bwd_buf.dtype),
+            axis_name, perm_bwd)
+        return (act_buf, outs, dx_out, fwd_buf, bwd_buf, d_stage, d_last,
+                loss_acc), None
+
+    carry = (act_buf, outs, dx_out, fwd_buf, bwd_buf, zero_stage_g,
+             zero_last_g, loss_acc)
+    carry, _ = lax.scan(slot, carry, jnp.arange(T + 1))
+    (act_buf, outs, dx_out, fwd_buf, bwd_buf, d_stage, d_last,
+     loss_acc) = carry
+    loss_mean = last_stage_value(loss_acc, axis_name)
+    d_last = jax.tree.map(lambda g: lax.psum(g, axis_name), d_last)
+    return loss_mean, outs, d_stage, d_last, dx_out
+
+
+def pipeline_bubble_fraction(n_stages: int, n_micro: int,
+                             schedule: str = "gpipe",
+                             n_chunks: int = 2) -> float:
+    """Idle fraction of the pipeline schedule (reported by the dryrun).
+
+    gpipe: (n-1) warmup + (n-1) drain slots around n_micro useful slots,
+    in each of the forward and backward phases -> (n-1)/(n_micro+n-1).
+    1f1b: the fused scan runs n_micro + 2n - 1 slots (arange(T+1) in
+    one_f_one_b), each slot worth one microbatch of fwd+bwd when fully
+    utilized, n_micro of them useful -> (2n-1)/(n_micro+2n-1). NOTE this
+    is WORSE than gpipe at equal n_micro — 1f1b's win is the O(n) bound
+    on in-flight activations (vs O(n_micro)), not the bubble.
+    interleaved: V*n_micro useful chunk-slots out of
+    T = ((ceil(M/n)-1)*V + V-1)*n + 2(n-1) + 1 — below gpipe's bubble
+    because each warmup/drain slot idles only 1/V of a device's model.
+    """
+    n, M, V = n_stages, n_micro, n_chunks
+    if n <= 1 or M <= 0:
+        return 0.0
+    if schedule == "1f1b":
+        return (2 * n - 1) / (M + 2 * n - 1)
+    if schedule == "interleaved":
+        Q = -(-M // n)
+        T = ((Q - 1) * V + (V - 1)) * n + 2 * (n - 1) + 1
+        return 1.0 - (V * M) / T        # V*M useful chunk-slots of T
+    return (n - 1) / (M + n - 1)
+
+
+def schedule_compute_overhead(schedule: str) -> float:
+    """Per-microbatch compute relative to gpipe's fwd+bwd (= 1 fwd + 2
+    bwd = 3 units), stated honestly so bubble%% columns can't mislead:
+
+    gpipe / interleaved: autodiff through the scan saves residuals — no
+      recompute -> 1.0x (memory: O(n_micro) in-flight activation sets).
+    1f1b: the backward half REMATERIALIZES the stage forward from the
+      saved stage input (one extra fwd per microbatch -> 4/3), and the
+      SPMD formulation runs last_fn's fwd+vjp (final LN + head + CE) in
+      every slot on every stage with the result gated out on all but the
+      last — with a GPT-2-scale vocab that head matmul is the largest
+      single op in the step, duplicated n_stages x. What 1f1b buys for
+      that is in-flight activations bounded by O(n_stages), independent
+      of n_micro.
+    """
+    return 4.0 / 3.0 if schedule == "1f1b" else 1.0
+
+
+def schedule_table(n_stages: int, n_micro: int, n_chunks: int = 2):
+    """Rows of (schedule, bubble_fraction, compute_overhead,
+    inflight_activation_sets) for the dryrun/docs — the honest
+    three-way comparison."""
+    n, M = n_stages, n_micro
+    return [
+        ("gpipe", pipeline_bubble_fraction(n, M, "gpipe"), 1.0,
+         f"O(M)={M}"),
+        ("1f1b", pipeline_bubble_fraction(n, M, "1f1b"),
+         schedule_compute_overhead("1f1b") , f"O(n)={min(2 * n, M)}"),
+        (f"interleaved x{n_chunks}",
+         pipeline_bubble_fraction(n, M, "interleaved", n_chunks), 1.0,
+         f"O(M)={M}"),
+    ]
+
+
+def last_stage_value(x, axis_name):
+    """Broadcast the last stage's value to every device (psum of a one-hot
+    mask — cheap for scalars/small outputs like a loss)."""
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    mask = (stage == n - 1).astype(x.dtype)
+    return lax.psum(x * mask, axis_name)
+
+
+def bcast_from_last(axis_name, x):
+    """last_stage_value with a per-device-correct vjp for use by tape ops
+    differentiated INSIDE the shard_map body: psum's transpose under an
+    in-body jax.vjp is another psum, which would scale the cotangent by
+    the axis size; the true per-device rule is dy * mask (only the last
+    stage's input influenced the broadcast value)."""
+    import functools
+    import jax
+
+    @functools.partial(jax.custom_vjp)
+    def _bcast(x):
+        return last_stage_value(x, axis_name)
+
+    def _fwd(x):
+        return _bcast(x), None
+
+    def _bwd(_, dy):
+        n = lax.axis_size(axis_name)
+        stage = lax.axis_index(axis_name)
+        mask = (stage == n - 1).astype(dy.dtype)
+        return (dy * mask,)
+
+    _bcast.defvjp(_fwd, _bwd)
+    return _bcast(x)

@@ -1,0 +1,151 @@
+"""Tensor parallelism: Megatron-style column/row parallel matmuls over a
+mesh axis (no reference counterpart — SINGA is data-parallel only,
+SURVEY.md §2.3; TP is first-class here).
+
+These are shard_map-side functions: weights arrive already sharded (the
+caller partitions with `shard_columns/shard_rows` specs), activations are
+replicated on entry. The canonical pairing for an MLP block is
+column-parallel fc1 (output sharded, no comm) followed by row-parallel fc2
+(one psum over the axis) — a single all-reduce per block riding ICI.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+
+def column_parallel(x, W, axis_name, b=None):
+    """x replicated, W column-sharded: y_shard = x @ W_shard (+ b_shard).
+    Output stays sharded on the feature dim — feed into row_parallel."""
+    y = jnp.dot(x, W)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def row_parallel(x_shard, W, axis_name, b=None):
+    """x feature-sharded, W row-sharded: full y = psum(x_shard @ W_shard).
+    Bias is added once (post-reduction)."""
+    y = lax.psum(jnp.dot(x_shard, W), axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def shard_columns(mesh, axis_name):
+    """NamedSharding for a (in, out) weight split on the output dim."""
+    return NamedSharding(mesh, P(None, axis_name))
+
+
+def shard_rows(mesh, axis_name):
+    """NamedSharding for a (in, out) weight split on the input dim."""
+    return NamedSharding(mesh, P(axis_name, None))
+
+
+def megatron_f(x, axis_name):
+    """Megatron's `f`: identity forward, psum backward — marks the point
+    where a replicated activation enters column-parallel compute. Written
+    with custom_vjp so it is ALSO correct when differentiated by jax.vjp
+    inside a shard_map body (check_vma=False): the auto-transpose of a
+    raw psum there is another psum, which double-counts."""
+    import jax
+
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    f.defvjp(lambda v: (v, None),
+             lambda _, g: (lax.psum(g, axis_name),))
+    return f(x)
+
+
+def megatron_g(x, axis_name):
+    """Megatron's `g`: psum forward, identity backward — reduces a
+    row-parallel partial output. custom_vjp for the same reason as
+    `megatron_f`."""
+    import jax
+
+    @jax.custom_vjp
+    def g(v):
+        return lax.psum(v, axis_name)
+
+    g.defvjp(lambda v: (lax.psum(v, axis_name), None),
+             lambda _, dy: (dy,))
+    return g(x)
+
+
+def vp_ce_forward(x, t, axis_name, valid_vocab=None):
+    """Shared forward math for Megatron vocab-parallel cross-entropy:
+    x (..., V/tp) local logits slice, t global target ids. Returns
+    (token-mean loss, residuals) — the single source of truth used by
+    BOTH the tape operator (autograd._VocabParallelSCE) and the
+    custom_vjp wrapper below, so the gpipe and 1F1B loss paths cannot
+    drift apart."""
+    xf = x.astype(jnp.float32).reshape(-1, x.shape[-1])
+    tf = t.reshape(-1)
+    vp = xf.shape[-1]
+    off = lax.axis_index(axis_name) * vp
+    if valid_vocab is not None:
+        gcol = off + jnp.arange(vp)[None, :]
+        xf = jnp.where(gcol < valid_vocab, xf, -jnp.inf)
+    m = lax.pmax(jnp.max(xf, axis=-1), axis_name)
+    z = jnp.exp(xf - m[:, None])
+    s = lax.psum(jnp.sum(z, axis=-1), axis_name)
+    local_t = tf - off
+    ok = (local_t >= 0) & (local_t < vp)
+    safe = jnp.clip(local_t, 0, vp - 1)
+    tl = jnp.where(ok,
+                   jnp.take_along_axis(xf, safe[:, None], -1)[:, 0],
+                   0.0)
+    tl = lax.psum(tl, axis_name)
+    loss = jnp.mean(jnp.log(s) + m - tl)
+    return loss, (z, s, safe, ok)
+
+
+def vp_ce_backward(res, dy):
+    """Shared backward: local (softmax - onehot) * dy/N in fp32, flat
+    (N, V/tp); no collective (see vp_ce_forward)."""
+    z, s, safe, ok = res
+    n = z.shape[0]
+    p = z / s[:, None]
+    onehot = ((jnp.arange(z.shape[-1])[None, :] == safe[:, None])
+              & ok[:, None])
+    return (p - onehot.astype(p.dtype)) * (dy / n)
+
+
+def vocab_parallel_ce(logits_local, targets, axis_name, valid_vocab=None):
+    """Token-mean softmax-CE over VOCAB-SHARDED logits, differentiable
+    inside a shard_map body (custom_vjp; see megatron_f). The math lives
+    in vp_ce_forward/vp_ce_backward."""
+    import jax
+
+    # static facts captured in the closure: custom_vjp residuals must be
+    # JAX values only
+    in_shape = tuple(logits_local.shape)
+    in_dtype = logits_local.dtype
+
+    @jax.custom_vjp
+    def ce(x, t):
+        loss, _ = vp_ce_forward(x, t, axis_name, valid_vocab)
+        return loss
+
+    def _fwd(x, t):
+        return vp_ce_forward(x, t, axis_name, valid_vocab)
+
+    def _bwd(res, dy):
+        dx = vp_ce_backward(res, dy)
+        return dx.astype(in_dtype).reshape(in_shape), None
+
+    ce.defvjp(_fwd, _bwd)
+    return ce(logits_local, targets)
+
+
+def tp_mlp(x, W1, b1, W2, b2, axis_name, act=None):
+    """Two-layer MLP with exactly one collective: column-parallel W1,
+    activation, row-parallel W2, psum."""
+    import jax
+    h = column_parallel(x, W1, axis_name, b1)
+    h = (act or jax.nn.gelu)(h)
+    return row_parallel(h, W2, axis_name, b2)

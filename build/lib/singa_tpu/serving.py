@@ -1,0 +1,680 @@
+"""Serving engine: KV-cached autoregressive decoding for GPT-family models.
+
+Framework infrastructure, not a "model": the decode core (prefill +
+single-token cached step), weight-only int8 quantization, int8 KV caches,
+greedy/sampled and beam decoding loops, and the decode-param memo all live
+here; `models/transformer.py` keeps only the model definitions and thin
+`generate()`/`generate_beam()` wrappers.
+
+The reference's LLM-serving story is ONNX-imported GPT-2 replaying the
+full graph per token (/root/reference/examples/onnx/gpt2/gpt2.py re-runs
+the whole prefix each step). TPU-native redesign: one jitted function =
+prefill + lax.scan over decode steps with a preallocated (T-length) KV
+cache updated via dynamic_update_slice — O(T) per token instead of
+O(T^2), no retrace per step, static shapes throughout.
+
+Serving-roofline design notes (PROFILE.md "KV-cached decode"):
+- HEAD-PACKED KV caches, (B, H/P, T, P*D) with P = 128//D: TPU bf16
+  tiles are (16 sublanes, 128 lanes), so a (B,H,T,D) cache with D=64
+  pads every row to 128 lanes — the cache physically occupies and
+  STREAMS 2x its logical bytes. Packing P heads into the minor dim
+  fills the lanes while keeping the per-token cache update a contiguous
+  row write; scores stay exactly per-head via BLOCK-DIAGONAL queries.
+- Wq/Wk/Wv fuse into one (E, 3E) matmul at decode-param prep.
+- `dtype="int8"` weight-only quantization (per-output-channel symmetric)
+  halves the dominant weight traffic; `kv_dtype="int8"` additionally
+  quantizes the KV cache with per-(head, position) scales.
+"""
+
+from __future__ import annotations
+
+import weakref
+
+
+def _quant8(W):
+    """Per-output-channel symmetric int8 quantization of a (in, out)
+    weight: q8 int8 + fp32 scale row. The scale commutes with the
+    contraction (y_j = (sum_i x_i q_ij) * s_j), so the matmul runs on the
+    int8 bytes and only the tiny (out,) output is rescaled — halving
+    weight HBM traffic vs bf16 on the bandwidth-bound decode path."""
+    import jax.numpy as jnp
+    s = jnp.max(jnp.abs(W), axis=0, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(W / s), -127, 127).astype(jnp.int8)
+    return {"q8": q, "sc": s.astype(jnp.float32)}
+
+
+def _mm(x, W):
+    """x @ W where W is a plain array or a _quant8 dict."""
+    if isinstance(W, dict):
+        y = x @ W["q8"].astype(x.dtype)
+        return y * W["sc"].astype(x.dtype)
+    return x @ W
+
+
+_Q8_KEYS = ("Wqkv", "Wo", "W1", "W2", "head")
+
+
+def _cast_params(p, dtype):
+    """Decode-param tree in the serving dtype: None = as-stored (fp32),
+    "bfloat16" = bf16 weights/activations, "int8" = weight-only int8
+    (the big streamed matrices quantize; biases, LN params, embedding —
+    its gather reads only B rows — and MoE weights stay bf16; W8A16)."""
+    import jax
+    import jax.numpy as jnp
+    if dtype is None:
+        return p
+    if dtype != "int8":
+        cd = jnp.dtype(dtype)
+        return jax.tree.map(
+            lambda a: a.astype(cd)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+    bf = jnp.bfloat16
+
+    def cast_leaf(a):
+        return a.astype(bf) \
+            if jnp.issubdtype(a.dtype, jnp.floating) else a
+
+    out = {k: cast_leaf(v) for k, v in p.items() if k != "blocks"}
+    out["head"] = _quant8(p["head"])
+    blocks = []
+    for bp in p["blocks"]:
+        nb = {k: cast_leaf(v) for k, v in bp.items()}
+        for k in _Q8_KEYS:
+            if k in bp:
+                nb[k] = _quant8(bp[k])
+        blocks.append(nb)
+    out["blocks"] = blocks
+    return out
+
+
+class _DecodeCore:
+    """Shared functional decode math for greedy/sampled and beam decoding.
+
+    One implementation of the fp32-island LayerNorm, the causal prefill
+    (which also fills the KV caches), and the single-token cached block
+    step — so every decode flavor shares numerics by construction (the
+    beam-1 == greedy test leans on this). See the module docstring for
+    the roofline design notes.
+    """
+
+    def __init__(self, H, E, S0, T, scale, moe_ks=None, kv_heads=None,
+                 rope=False, rope_theta=10000.0, kv8=False):
+        self.H, self.E, self.S0, self.T, self.scale = H, E, S0, T, scale
+        self.rope = bool(rope)
+        self.rope_theta = float(rope_theta)
+        # kv8: int8 KV cache with per-(head, position) symmetric scales.
+        # The algebra stays exact-in-structure: K-scales multiply scores
+        # per source position after the packed matmul, and V-scales fold
+        # into the attention weights for the DIAGONAL (own-head) block —
+        # the only block the packed extraction keeps, so the off-block
+        # garbage scaling is discarded with the cross-terms.
+        self.kv8 = bool(kv8)
+        # static per-layer MoE routing degree (None = dense MLP); must be
+        # static (int() under jit) so it lives here, not in the param tree
+        self.moe_ks = moe_ks or []
+        # GQA: Hkv kv heads each serve G = H/Hkv query heads; the caches
+        # hold Hkv heads (the serving win — KV traffic shrinks G x) and
+        # the packed block-diagonal contraction places G query rows per
+        # kv-head block instead of 1
+        self.Hkv = kv_heads or H
+        self.G = H // self.Hkv
+        D = E // H
+        P = max(1, 128 // D)
+        self.P = P if (P > 1 and self.Hkv % P == 0) else 1
+
+    def cast(self, p, dtype):
+        return _cast_params(p, dtype)
+
+    def ln(self, x, g, b, eps=1e-5):
+        # fp32 island like autograd.LayerNorm: variance in bf16 is
+        # catastrophically lossy
+        import jax.numpy as jnp
+        from jax import lax
+        x32 = x.astype(jnp.float32)
+        m = jnp.mean(x32, axis=-1, keepdims=True)
+        v = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - m) * lax.rsqrt(v + eps) * g.astype(jnp.float32) \
+            + b.astype(jnp.float32)
+        return y.astype(x.dtype)
+
+    def mlp(self, bp, x, li):
+        """Block MLP on (..., E): dense two-layer, or the MoE FFN when
+        layer `li` routes to experts (decode uses the single-device
+        dense-dispatch path; generous capacity so no token drops)."""
+        import jax
+        import jax.numpy as jnp
+        kcf = self.moe_ks[li] if li < len(self.moe_ks) else None
+        if kcf is not None:
+            # NOTE: capacity-limited routing is a BATCH-GLOBAL effect (a
+            # token's drop depends on the other tokens in the dispatch),
+            # so cached decode == full forward only in the no-drop regime
+            # (generous capacity_factor); the layer's own factor is used
+            # here for honest replication.
+            k, cf = kcf
+            from .parallel.moe import moe_ffn
+            lead = x.shape[:-1]
+            flat = x.reshape(-1, x.shape[-1])
+            y, _, _ = moe_ffn(flat, bp["moeWg"], bp["moeW1"], bp["moeb1"],
+                              bp["moeW2"], bp["moeb2"],
+                              capacity_factor=cf, k=k)
+            return y.reshape(*lead, x.shape[-1]).astype(x.dtype)
+        return _mm(jax.nn.gelu(_mm(x, bp["W1"]) + bp["bb1"]),
+                   bp["W2"]) + bp["bb2"]
+
+    def qkv(self, bp, x, n, S=None):
+        """Fused QKV projection: one (E, E + 2*Hkv*D) matmul, split into
+        q (n,[S,]H,D) and k/v (n,[S,]Hkv,D)."""
+        import jax.numpy as jnp
+        H, D, E, Hkv = self.H, self.E // self.H, self.E, self.Hkv
+        KE = Hkv * D
+        fused = _mm(x, bp["Wqkv"]) + bp["bqkv"]
+        bounds = ((0, E, H), (E, E + KE, Hkv), (E + KE, E + 2 * KE, Hkv))
+        if S is None:
+            q, k, v = (fused[..., a:b].reshape(n, h, D)
+                       for a, b, h in bounds)
+        else:
+            q, k, v = (fused[..., a:b].reshape(n, S, h, D).swapaxes(1, 2)
+                       for a, b, h in bounds)
+        return q, k, v
+
+    def _pack(self, kv, n, S):
+        """(n,Hkv,S,D) per-kv-head K/V -> head-packed
+        (n, Hkv/P, S, P*D)."""
+        D, P, Hkv = self.E // self.H, self.P, self.Hkv
+        return kv.reshape(n, Hkv // P, P, S, D).swapaxes(2, 3) \
+            .reshape(n, Hkv // P, S, P * D)
+
+    def _quant_kv(self, kv, n, S):
+        """(n,Hkv,S,D) -> (packed int8 (n,Hp,S,P*D),
+        scales (n,Hp,S,P) fp32): per-(head, position) symmetric."""
+        import jax.numpy as jnp
+        P, Hkv = self.P, self.Hkv
+        s = jnp.maximum(jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1),
+                        1e-8) / 127.0                       # (n,Hkv,S)
+        q = jnp.clip(jnp.round(kv.astype(jnp.float32) / s[..., None]),
+                     -127, 127).astype(jnp.int8)
+        sp = s.reshape(n, Hkv // P, P, S).swapaxes(2, 3)    # (n,Hp,S,P)
+        return self._pack(q, n, S), sp
+
+    def _scale_rows(self, sp, G):
+        """(n,Hp,T,P) per-position scales -> (n,Hp,P*G,T) row factors
+        (packed query row q = c*G + g reads lane block c)."""
+        import jax.numpy as jnp
+        return jnp.repeat(sp.swapaxes(2, 3), G, axis=2)
+
+    def prefill(self, p, prompt, n):
+        """Causal pass over the (n, S0) prompt; returns the last-position
+        logits (n, V) and per-block head-packed KV caches of time-length
+        T, shape (n, H/P, T, P*D) (see class docstring).
+
+        Attention runs through the Pallas flash kernel (O(S0) score
+        memory — the same kernel the training path uses, GQA via repeat),
+        so a 16k+-token prompt prefills on one chip instead of
+        materializing an (S0, S0) score matrix per head; short prompts
+        that don't tile the kernel fall back to the O(S0^2) reference
+        path inside flash_attention itself."""
+        import jax.numpy as jnp
+        from .ops.attention import flash_attention
+        H, D, S0, T, P = self.H, self.E // self.H, self.S0, self.T, self.P
+        ln = self.ln
+        h = p["emb"][prompt] + (0 if self.rope else p["pos"][:S0])
+
+        caches = []
+        Hkv, G = self.Hkv, self.G
+        if self.rope:
+            from .autograd import rope_tables, apply_rope
+            rcos, rsin = rope_tables(jnp.arange(S0), D, self.rope_theta)
+        for li, bp in enumerate(p["blocks"]):
+            x = ln(h, bp["g1"], bp["b1"])
+            q, k, v = self.qkv(bp, x, n, S0)    # q (n,H,·); kv (n,Hkv,·)
+            if self.rope:
+                # rotate q/k; the cache stores ROTATED keys (standard),
+                # so decode steps only rotate their own position
+                q = apply_rope(q, rcos, rsin)
+                k = apply_rope(k, rcos, rsin)
+            kr = jnp.repeat(k, G, axis=1) if G > 1 else k
+            vr = jnp.repeat(v, G, axis=1) if G > 1 else v
+            o = flash_attention(q, kr, vr, True, self.scale)
+            h = h + _mm(o.swapaxes(1, 2).reshape(n, S0, self.E),
+                        bp["Wo"]) + bp["bo"]
+            x = ln(h, bp["g2"], bp["b2"])
+            h = h + self.mlp(bp, x, li)
+            if self.kv8:
+                k8, ks = self._quant_kv(k, n, S0)
+                v8, vs = self._quant_kv(v, n, S0)
+                Kc = (jnp.zeros((n, Hkv // P, T, P * D), jnp.int8)
+                      .at[:, :, :S0].set(k8),
+                      jnp.zeros((n, Hkv // P, T, P), jnp.float32)
+                      .at[:, :, :S0].set(ks))
+                Vc = (jnp.zeros((n, Hkv // P, T, P * D), jnp.int8)
+                      .at[:, :, :S0].set(v8),
+                      jnp.zeros((n, Hkv // P, T, P), jnp.float32)
+                      .at[:, :, :S0].set(vs))
+            else:
+                Kc = jnp.zeros((n, Hkv // P, T, P * D), k.dtype) \
+                    .at[:, :, :S0].set(self._pack(k, n, S0))
+                Vc = jnp.zeros((n, Hkv // P, T, P * D), v.dtype) \
+                    .at[:, :, :S0].set(self._pack(v, n, S0))
+            caches.append((Kc, Vc))
+        logits0 = _mm(ln(h[:, -1], p["gf"], p["bf"]), p["head"])
+        return logits0, caches
+
+    def token_step(self, p, tok, caches, i, n):
+        """Feed token `tok` (n,) at generated-index `i` (position S0+i)
+        through all blocks against the caches; returns (logits (n, V),
+        new caches)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        H, D, E, P = self.H, self.E // self.H, self.E, self.P
+        Hkv, G = self.Hkv, self.G
+        Hp = Hkv // P
+        ln = self.ln
+        pos_idx = self.S0 + i
+        h = p["emb"][tok] + (0 if self.rope else p["pos"][pos_idx])
+        kmask = (jnp.arange(self.T) <= pos_idx)
+        ar = jnp.arange(P)
+        if self.rope:
+            from .autograd import rope_tables, apply_rope
+            rcos, rsin = rope_tables(pos_idx[None], D, self.rope_theta)
+            rcos, rsin = rcos[0], rsin[0]          # (D,) broadcast
+        new_caches = []
+        for li, ((Kc, Vc), bp) in enumerate(zip(caches, p["blocks"])):
+            x = ln(h, bp["g1"], bp["b1"])
+            q, kn, vn = self.qkv(bp, x, n)   # q (n,H,D); kv (n,Hkv,D)
+            if self.rope:
+                q = apply_rope(q, rcos, rsin)
+                kn = apply_rope(kn, rcos, rsin)
+            # packed caches: one contiguous (P*D)-lane row per token
+            if self.kv8:
+                (K8, Ks), (V8, Vs) = Kc, Vc
+                k8, ks = self._quant_kv(kn[:, :, None], n, 1)
+                v8, vs = self._quant_kv(vn[:, :, None], n, 1)
+                K8 = lax.dynamic_update_slice(K8, k8, (0, 0, pos_idx, 0))
+                Ks = lax.dynamic_update_slice(Ks, ks, (0, 0, pos_idx, 0))
+                V8 = lax.dynamic_update_slice(V8, v8, (0, 0, pos_idx, 0))
+                Vs = lax.dynamic_update_slice(Vs, vs, (0, 0, pos_idx, 0))
+                Kc, Vc = (K8, Ks), (V8, Vs)
+                Kmat, Vmat = K8.astype(x.dtype), V8.astype(x.dtype)
+            else:
+                Kc = lax.dynamic_update_slice(
+                    Kc, kn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
+                Vc = lax.dynamic_update_slice(
+                    Vc, vn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
+                Kmat, Vmat = Kc, Vc
+            # block-diagonal queries: packed slot c holds kv head
+            # (hp*P + c)'s G query rows in block c, zeros elsewhere —
+            # the full-width contraction with the packed K then yields
+            # exactly the per-head scores (GQA: G rows per block; MHA is
+            # the G=1 case)
+            q6 = jnp.moveaxis(q.reshape(n, Hp, P, G, D), 2, 0)
+            Q2 = jnp.zeros((n, Hp, P, G, P, D), q.dtype) \
+                .at[:, :, ar, :, ar, :].set(q6) \
+                .reshape(n, Hp, P * G, P * D)
+            s = jnp.einsum("nhqj,nhtj->nhqt", Q2, Kmat) * self.scale
+            if self.kv8:
+                # K-scales: one factor per (source position, own block)
+                s = s * self._scale_rows(Ks, G)
+            a = jax.nn.softmax(jnp.where(kmask, s, -jnp.inf), axis=-1)
+            if self.kv8:
+                # V-scales fold into the weights for the own-head block
+                # (the only one extracted below)
+                a = (a * self._scale_rows(Vs, G)).astype(x.dtype)
+            O2 = jnp.einsum("nhqt,nhtj->nhqj", a, Vmat)  # (n,Hp,P*G,P*D)
+            o = jnp.moveaxis(
+                O2.reshape(n, Hp, P, G, P, D)[:, :, ar, :, ar, :],
+                0, 2).reshape(n, E)
+            h = h + _mm(o, bp["Wo"]) + bp["bo"]
+            x = ln(h, bp["g2"], bp["b2"])
+            h = h + self.mlp(bp, x, li)
+            new_caches.append((Kc, Vc))
+        logits = _mm(ln(h, p["gf"], p["bf"]), p["head"])
+        return logits, new_caches
+
+
+def _set_col(buf, i, vals):
+    """buf (B,K,L) with column `i` (traced index) set to vals (B,K)."""
+    from jax import lax
+    return lax.dynamic_update_slice_in_dim(
+        buf, vals[..., None], i, axis=2)
+
+
+def _pool_merge(pool_tok, pool_norm, pool_raw, cand_tok, cand_norm,
+                cand_raw, K):
+    """Merge candidate finished hypotheses into the K-slot pool, keeping
+    the K best by normalized score. Shapes: pool (B,K,L)/(B,K); cand
+    (B,kk,L)/(B,kk). Candidates not actually finished carry NEG norm."""
+    import jax.numpy as jnp
+    all_norm = jnp.concatenate([pool_norm, cand_norm], axis=1)
+    all_raw = jnp.concatenate([pool_raw, cand_raw], axis=1)
+    all_tok = jnp.concatenate([pool_tok, cand_tok], axis=1)
+    from jax import lax
+    top_norm, pick = lax.top_k(all_norm, K)
+    new_raw = jnp.take_along_axis(all_raw, pick, axis=1)
+    new_tok = jnp.take_along_axis(all_tok, pick[..., None], axis=1)
+    return new_tok, top_norm, new_raw
+
+
+def _decode_core(m, S0, max_new, moe_capacity_factor=None, kv8=False):
+    """Build the _DecodeCore matching model `m`'s static config."""
+    H = m.blocks[0].attn.num_heads
+    kv = m.blocks[0].attn.num_kv_heads
+    T = S0 + max_new
+    assert T <= m.max_seq, \
+        f"prompt {S0} + new {max_new} exceeds max_seq {m.max_seq}"
+    # decode-time capacity override: capacity-limited routing is a
+    # batch-global effect, so cached decode == full forward only in the
+    # no-drop regime; a tight TRAINING capacity_factor shouldn't silently
+    # drop tokens at serving time — pass moe_capacity_factor (e.g.
+    # float(num_experts) for guaranteed no drops) to generate()/
+    # generate_beam() to decouple the two.
+    moe_ks = [(b.moe.k, float(moe_capacity_factor
+                              if moe_capacity_factor is not None
+                              else b.moe.capacity_factor))
+              if b.moe_experts else None for b in m.blocks]
+    return _DecodeCore(H, m.dim, S0, T, (m.dim // H) ** -0.5, moe_ks,
+                       kv_heads=kv,
+                       rope=(getattr(m, "pos_encoding", "learned")
+                             == "rope"),
+                       rope_theta=getattr(m, "rope_theta", 10000.0),
+                       kv8=kv8)
+
+
+# ---- decode-param preparation + memo ------------------------------------
+
+def decode_raw(m):
+    """Every parameter array the decode consumes — the identity basis for
+    the fused/cast decode tree's memo."""
+    if not m._pos_init:
+        raise RuntimeError(
+            "generate() needs initialized weights - call "
+            "Model.compile([ids], ...) (or run a forward) first")
+    arrs = [m.tok_embed.W.data, m.ln_f.gamma.data, m.ln_f.beta.data]
+    if m.pos_encoding != "rope":
+        arrs.append(m.pos_embed.data)
+    if m.head is not None:
+        arrs.append(m.head.W.data)
+    for b in m.blocks:
+        arrs += [b.ln1.gamma.data, b.ln1.beta.data,
+                 b.ln2.gamma.data, b.ln2.beta.data,
+                 b.attn.Wq.data, b.attn.Wk.data, b.attn.Wv.data,
+                 b.attn.Wo.data]
+        if b.attn.use_bias:
+            arrs += [b.attn.bq.data, b.attn.bk.data, b.attn.bv.data,
+                     b.attn.bo.data]
+        if b.moe_experts:
+            arrs += [b.moe.Wg.data, b.moe.W1.data, b.moe.b1.data,
+                     b.moe.W2.data, b.moe.b2.data]
+        else:
+            arrs += [b.fc1.W.data, b.fc1.b.data,
+                     b.fc2.W.data, b.fc2.b.data]
+    return arrs
+
+
+def _live_refs(arrs):
+    """Weakrefs to the param buffers when supported (a freed buffer then
+    invalidates the memo deterministically — id() reuse after GC cannot
+    produce a false hit); falls back to strong refs, which pin the old
+    buffers alive so their ids stay unique until the next decode_state
+    call rebuilds the cache."""
+    try:
+        return tuple(weakref.ref(a) for a in arrs), True
+    except TypeError:
+        return tuple(arrs), False
+
+
+def decode_state(m, dtype):
+    """Memoized decode-param tree per serving dtype: the QKV fusion, bf16
+    cast, and int8 quantization run once per weight set instead of on
+    every generate() call. The memo key holds (weak) references to the
+    live param buffers and hits only while every buffer is IDENTICAL
+    (`is`) to the referenced one — replacing any param (set_params /
+    load_checkpoint / load_gpt2_weights) misses deterministically, with
+    no reliance on id() non-reuse."""
+    arrs = decode_raw(m)
+    cached = getattr(m, "_param_cache", None)
+    if cached is not None:
+        refs, weak, _ = cached
+        live = (a() if weak else a for a in refs)
+        if len(refs) != len(arrs) or \
+                any(r is not a for r, a in zip(live, arrs)):
+            cached = None
+    if cached is None:
+        refs, weak = _live_refs(arrs)
+        cached = m._param_cache = (refs, weak, {})
+    trees = cached[2]
+    if dtype not in trees:
+        trees[dtype] = _cast_params(decode_params(m), dtype)
+    return trees[dtype]
+
+
+def decode_params(m):
+    """The functional decode-param tree for model `m` (fp32, unfused
+    biases zero-filled, QKV fused, head tied/truncated under vocab_tp)."""
+    if not m._pos_init:
+        raise RuntimeError(
+            "generate() needs initialized weights - call "
+            "Model.compile([ids], ...) (or run a forward) first")
+    import jax.numpy as jnp
+    blocks = []
+    zeros = jnp.zeros((m.dim,), m.blocks[0].attn.Wq.data.dtype)
+    for b in m.blocks:
+        ab = b.attn.use_bias
+        bp = {
+            "g1": b.ln1.gamma.data, "b1": b.ln1.beta.data,
+            # fused QKV: one (E,3E) weight stream per block instead of
+            # three — fewer ops on the bandwidth-bound decode path
+            "Wqkv": jnp.concatenate(
+                [b.attn.Wq.data, b.attn.Wk.data, b.attn.Wv.data],
+                axis=1),
+            "bqkv": jnp.concatenate(
+                [b.attn.bq.data, b.attn.bk.data, b.attn.bv.data])
+            if ab else jnp.zeros(
+                (b.attn.Wq.shape[1] + b.attn.Wk.shape[1]
+                 + b.attn.Wv.shape[1],), zeros.dtype),
+            "Wo": b.attn.Wo.data,
+            "bo": b.attn.bo.data if ab else zeros,
+            "g2": b.ln2.gamma.data, "b2": b.ln2.beta.data,
+        }
+        if b.moe_experts:
+            # routing degree/capacity stay STATIC on _DecodeCore
+            # (moe_ks), not in the traced param tree
+            bp.update({
+                "moeWg": b.moe.Wg.data,
+                "moeW1": b.moe.W1.data, "moeb1": b.moe.b1.data,
+                "moeW2": b.moe.W2.data, "moeb2": b.moe.b2.data,
+            })
+        else:
+            bp.update({
+                "W1": b.fc1.W.data, "bb1": b.fc1.b.data,
+                "W2": b.fc2.W.data, "bb2": b.fc2.b.data,
+            })
+        blocks.append(bp)
+    emb = m.tok_embed.W.data
+    if m.vocab_tp:
+        # tied head, truncated to the true vocab so padded rows (never
+        # trained toward anything) cannot win an argmax during decode
+        head = emb[:m.vocab_size].T
+    else:
+        head = m.head.W.data
+    return {
+        "emb": emb,
+        "pos": (jnp.zeros((m.max_seq, 0), emb.dtype)
+                if m.pos_encoding == "rope"
+                else m.pos_embed.data),
+        "gf": m.ln_f.gamma.data, "bf": m.ln_f.beta.data,
+        "head": head, "blocks": blocks,
+    }
+
+
+# ---- decode-loop builders -----------------------------------------------
+
+def build_decode(m, B, S0, max_new, temperature, top_k,
+                 dtype=None, moe_capacity_factor=None, kv_dtype=None):
+    """Jitted greedy/sampled decode fn: (params, prompt, key) -> ids."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    core = _decode_core(m, S0, max_new, moe_capacity_factor,
+                        kv8=(kv_dtype == "int8"))
+
+    def sample(logits, key):
+        logits = logits.astype(jnp.float32)
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
+
+    def decode(p, prompt, key):
+        # p arrives pre-cast/quantized (decode_state memo)
+        logits0, caches = core.prefill(p, prompt, B)
+        key, sub = jax.random.split(key)
+        tok0 = sample(logits0, sub)                   # (B,)
+
+        # ---- decode: one token per scan step, O(T) attention ----
+        def step(carry, i):
+            tok, caches, key = carry
+            logits, caches = core.token_step(p, tok, caches, i, B)
+            key, sub = jax.random.split(key)
+            nxt = sample(logits, sub)
+            return (nxt, caches, key), nxt
+
+        if max_new > 1:
+            (_, _, _), toks = lax.scan(
+                step, (tok0, caches, key), jnp.arange(max_new - 1))
+            toks = jnp.concatenate([tok0[:, None], toks.T], axis=1)
+        else:
+            toks = tok0[:, None]
+        return jnp.concatenate([prompt, toks], axis=1)
+
+    return jax.jit(decode)
+
+
+def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
+                      eos_id, dtype, pad_id=None, moe_capacity_factor=None,
+                      kv_dtype=None):
+    """Jitted beam-search decode fn: (params, prompt) -> (ids, score)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    V = m.vocab_size
+    K = num_beams
+    core = _decode_core(m, S0, max_new, moe_capacity_factor,
+                        kv8=(kv_dtype == "int8"))
+    NEG = jnp.float32(-1e9)
+    pad = 0 if eos_id is None else (pad_id if pad_id is not None
+                                    else eos_id)
+
+    def norm_len(score, length):
+        return score / (length.astype(jnp.float32) ** length_penalty)
+
+    def decode(p, prompt):
+        # p arrives pre-cast/quantized (decode_state memo)
+        # ---- prefill on the B prompts, then tile caches to B*K ----
+        logits0, caches = core.prefill(p, prompt, B)
+        # beam b*K+k from prompt b (tree-map: kv8 caches are
+        # (int8, scales) tuples)
+        caches = jax.tree.map(lambda a: jnp.repeat(a, K, axis=0),
+                              caches)
+        logp0 = jax.nn.log_softmax(
+            logits0.astype(jnp.float32), axis=-1)     # (B,V)
+        tokens = jnp.full((B, K, max_new), pad, jnp.int32)
+        # finished-hypothesis pool (HF-style): finished beams move
+        # here with a length-normalized score and stop competing by
+        # raw score against still-growing beams
+        pool_tok = jnp.full((B, K, max_new), pad, jnp.int32)
+        pool_norm = jnp.full((B, K), NEG)
+        pool_raw = jnp.full((B, K), NEG)
+
+        if eos_id is None:
+            s0, t0 = lax.top_k(logp0, K)              # (B,K)
+            alive_scores = s0
+            tokens = tokens.at[:, :, 0].set(t0)
+        else:
+            # consider 2K candidates so K alive beams survive even if
+            # eos ranks high
+            kk = min(2 * K, V)
+            cs, ct = lax.top_k(logp0, kk)             # (B,kk)
+            is_eos = ct == eos_id
+            # finished at length 1 -> pool
+            cand_pool_tok = jnp.broadcast_to(
+                jnp.full((max_new,), pad, jnp.int32)
+                .at[0].set(eos_id)[None, None],
+                (B, kk, max_new))
+            pool_tok, pool_norm, pool_raw = _pool_merge(
+                pool_tok, pool_norm, pool_raw,
+                cand_pool_tok,
+                jnp.where(is_eos, norm_len(cs, jnp.asarray(1)), NEG),
+                cs, K)
+            # alive beams: best K non-eos
+            alive_cs = jnp.where(is_eos, NEG, cs)
+            s0, pick = lax.top_k(alive_cs, K)         # (B,K) of [0,kk)
+            t0 = jnp.take_along_axis(ct, pick, axis=1)
+            alive_scores = s0
+            tokens = tokens.at[:, :, 0].set(t0)
+
+        def step(carry, i):
+            tokens, scores, caches, pool_tok, pool_norm, pool_raw = \
+                carry
+            tok = lax.dynamic_index_in_dim(
+                tokens, i, axis=2, keepdims=False)    # (B,K)
+            logits, caches = core.token_step(
+                p, tok.reshape(B * K), caches, i, B * K)
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32), axis=-1).reshape(B, K, V)
+            total = scores[..., None] + logp          # (B,K,V)
+            flat = total.reshape(B, K * V)
+            kk = min(2 * K, K * V)
+            cs, idx = lax.top_k(flat, kk)             # (B,kk)
+            beam_idx = idx // V
+            cand_tok = (idx % V).astype(jnp.int32)
+            gather = jnp.take_along_axis
+            cand_hist = gather(tokens, beam_idx[..., None], axis=1)
+            cand_hist = _set_col(cand_hist, i + 1, cand_tok)
+
+            if eos_id is not None:
+                is_eos = cand_tok == eos_id
+                pool_tok, pool_norm, pool_raw = _pool_merge(
+                    pool_tok, pool_norm, pool_raw, cand_hist,
+                    jnp.where(is_eos,
+                              norm_len(cs, jnp.asarray(i + 2)), NEG),
+                    cs, K)
+                cs = jnp.where(is_eos, NEG, cs)
+            new_scores, pick = lax.top_k(cs, K)       # (B,K)
+            keep_beam = gather(beam_idx, pick, axis=1)
+            tokens = gather(cand_hist, pick[..., None], axis=1)
+            src = (jnp.arange(B)[:, None] * K
+                   + keep_beam).reshape(B * K)        # flat rows
+            caches = jax.tree.map(lambda a: a[src], caches)
+            return (tokens, new_scores, caches,
+                    pool_tok, pool_norm, pool_raw), None
+
+        carry = (tokens, alive_scores, caches,
+                 pool_tok, pool_norm, pool_raw)
+        if max_new > 1:
+            carry, _ = lax.scan(step, carry, jnp.arange(max_new - 1))
+        tokens, scores, _, pool_tok, pool_norm, pool_raw = carry
+
+        # final selection: best of {pool, alive} by normalized score
+        alive_norm = norm_len(scores, jnp.asarray(max_new))
+        all_norm = jnp.concatenate([pool_norm, alive_norm], axis=1)
+        all_raw = jnp.concatenate([pool_raw, scores], axis=1)
+        all_tok = jnp.concatenate([pool_tok, tokens], axis=1)
+        best = jnp.argmax(all_norm, axis=1)           # (B,)
+        out = jnp.take_along_axis(
+            all_tok, best[:, None, None], axis=1)[:, 0]
+        best_score = jnp.take_along_axis(
+            all_raw, best[:, None], axis=1)[:, 0]
+        return jnp.concatenate([prompt, out], axis=1), best_score
+
+    return jax.jit(decode)
+
+
+__all__ = ["build_decode", "build_beam_decode", "decode_state",
+           "decode_params", "decode_raw"]

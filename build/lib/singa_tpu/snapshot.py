@@ -1,0 +1,173 @@
+"""Snapshot: named-tensor kv store on disk (ref python/singa/snapshot.py +
+src/io/snapshot.cc).
+
+Two backends behind the same API:
+- native (default when g++ is available): `<prefix>.bin` in the
+  CRC-framed binfile format of native/snapshot.cc, drained to disk by a
+  C++ background thread holding no GIL — CRC/IO of one record overlaps
+  marshalling of the next (the reference's BinFileWriter is likewise
+  native; src/io/binfile_writer.cc).
+- npz fallback: `<prefix>.npz`.
+
+Both write a `<prefix>.meta` json manifest (names/shapes/dtypes). Reads
+auto-detect the backend from what's on disk.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+
+import numpy as np
+
+from . import native
+from .tensor import Tensor, from_numpy
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+class Snapshot:
+
+    def __init__(self, fpath: str, mode_write: bool, buffer_size: int = 0):
+        """mode_write=True opens for writing (ref snapshot.py:42)."""
+        self.fpath = fpath
+        self.mode_write = mode_write
+        self._store = {}
+        if not mode_write:
+            self._load()
+
+    # -- paths -------------------------------------------------------------
+
+    def _prefix(self):
+        root, ext = os.path.splitext(self.fpath)
+        return root if ext in (".npz", ".bin") else self.fpath
+
+    # -- write side --------------------------------------------------------
+
+    def write(self, param_name: str, param_val: Tensor):
+        assert self.mode_write
+        self._store[param_name] = param_val.numpy() \
+            if isinstance(param_val, Tensor) else np.asarray(param_val)
+
+    def flush(self):
+        if not self.mode_write:
+            return
+        # an explicit extension pins the backend; only extensionless
+        # prefixes auto-select (native preferred)
+        lb = None if self.fpath.endswith(".npz") else native.snapshot_lib()
+        if self.fpath.endswith(".bin") and lb is None:
+            raise OSError("explicit .bin path requested but no C++ "
+                          "toolchain is available")
+        if lb is not None:
+            self._flush_native(lb)
+            stale = self._prefix() + ".npz"
+        else:
+            np.savez(self._prefix() + ".npz", **self._store)
+            stale = self._prefix() + ".bin"
+        # a leftover other-format file from an earlier flush of the same
+        # extensionless prefix would shadow this one on read — remove it
+        if not self.fpath.endswith((".npz", ".bin")) \
+                and os.path.exists(stale):
+            os.remove(stale)
+        meta = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                for k, v in self._store.items()}
+        with open(self._prefix() + ".meta", "w") as f:
+            json.dump(meta, f, indent=1)
+
+    def _flush_native(self, lb):
+        path = self._prefix() + ".bin"
+        h = lb.snp_writer_open(path.encode())
+        if not h:
+            raise OSError(f"cannot open {path} for writing")
+        try:
+            for name, arr in self._store.items():
+                shape = arr.shape  # before ascontiguousarray: it 1d-ifies 0-d
+                arr = np.ascontiguousarray(arr)
+                dims = (ctypes.c_uint64 * len(shape))(*shape)
+                rc = lb.snp_writer_write(
+                    h, name.encode(), str(arr.dtype).encode(),
+                    len(shape), dims, arr.ctypes.data_as(ctypes.c_char_p),
+                    arr.nbytes)
+                if rc != 0:
+                    raise OSError(f"snapshot write failed for {name}")
+        finally:
+            if lb.snp_writer_close(h) != 0:
+                raise OSError(f"snapshot flush to {path} failed")
+
+    # -- read side ---------------------------------------------------------
+
+    def _load(self):
+        prefix = self._prefix()
+        # explicit extension pins the backend on read too (mirrors flush)
+        bin_path = None if self.fpath.endswith(".npz") else prefix + ".bin"
+        npz_path = None if self.fpath.endswith(".bin") else prefix + ".npz"
+        lb = native.snapshot_lib()
+        if bin_path and os.path.exists(bin_path) and lb is not None:
+            self._load_native(lb, bin_path)
+        elif npz_path and os.path.exists(npz_path):
+            with np.load(npz_path) as z:
+                self._store = {k: z[k] for k in z.files}
+        elif bin_path and os.path.exists(bin_path):
+            raise OSError(f"{bin_path} needs the native reader but no "
+                          "C++ toolchain is available")
+        else:
+            raise FileNotFoundError(f"no snapshot at {prefix}(.bin|.npz)")
+
+    def _load_native(self, lb, path):
+        h = lb.snp_reader_open(path.encode())
+        if not h:
+            raise OSError(f"cannot open snapshot {path} (bad magic?)")
+        try:
+            key = ctypes.c_char_p()
+            dtype = ctypes.c_char_p()
+            ndim = ctypes.c_uint8()
+            dims = ctypes.POINTER(ctypes.c_uint64)()
+            data = ctypes.c_char_p()
+            nbytes = ctypes.c_uint64()
+            while True:
+                rc = lb.snp_reader_next(
+                    h, ctypes.byref(key), ctypes.byref(dtype),
+                    ctypes.byref(ndim), ctypes.byref(dims),
+                    ctypes.byref(data), ctypes.byref(nbytes))
+                if rc == 0:
+                    break
+                if rc < 0:
+                    raise OSError(f"corrupt snapshot record in {path}")
+                shape = tuple(dims[i] for i in range(ndim.value))
+                raw = ctypes.string_at(data, nbytes.value)
+                arr = np.frombuffer(
+                    raw, dtype=_np_dtype(dtype.value.decode()))
+                self._store[key.value.decode()] = arr.reshape(shape).copy()
+        finally:
+            lb.snp_reader_close(h)
+        # a file truncated exactly at a record boundary reads as clean
+        # EOF; cross-check against the .meta manifest when present
+        meta_path = self._prefix() + ".meta"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                expected = set(json.load(f))
+            missing = expected - set(self._store)
+            if missing:
+                raise OSError(
+                    f"truncated snapshot {path}: missing "
+                    f"{sorted(missing)[:5]} (and possibly more) "
+                    "per the .meta manifest")
+
+    def read(self, param_name: str) -> Tensor:
+        assert not self.mode_write
+        return from_numpy(self._store[param_name])
+
+    def names(self):
+        return list(self._store)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.flush()

@@ -1,0 +1,112 @@
+"""sonnx: ONNX interop (ref python/singa/sonnx.py).
+
+- `prepare(model_proto, device)` -> SingaRep with .run(inputs)  (import)
+- `export(model, inputs, path)` / `to_onnx_model(...)`          (export)
+- `SONNXModel` wraps an imported graph as a trainable Model      (retrain)
+- `load_model/save_model` on the self-contained protobuf codec (onnx_pb)
+"""
+
+from __future__ import annotations
+
+from .. import model as model_module
+from ..tensor import Tensor
+from . import onnx_pb
+from .onnx_pb import load_model, save_model  # noqa: F401
+from .backend import SingaBackend, SingaRep, prepare  # noqa: F401
+from .frontend import to_onnx_model, export  # noqa: F401
+
+
+class SONNXModel(model_module.Model):
+    """Re-trainable wrapper over an imported ONNX graph
+    (ref sonnx.py:2196). Subclass and define train_one_batch; forward
+    returns the graph outputs (a single Tensor if there is exactly one)."""
+
+    def __init__(self, onnx_model: "onnx_pb.ModelProto", device=None,
+                 name=None):
+        super().__init__(name)
+        self.backend = SingaBackend(onnx_model, device)
+        # surface imported weights as this Model's params so compile /
+        # optimizers / checkpointing see them
+        for pname, t in self.backend.params.items():
+            attr = "onnx__" + pname.replace(".", "_").replace("/", "_") \
+                .replace(":", "_")
+            self._register_param(attr, t)
+        for sname, t in self.backend.states.items():
+            attr = "onnxs__" + sname.replace(".", "_").replace("/", "_") \
+                .replace(":", "_")
+            self._register_state(attr, t)
+
+    def forward(self, *x, last_layers=None):
+        """last_layers: stop after that many graph nodes (negative counts
+        from the end) and return that node's outputs — the reference's
+        truncated-backbone retraining hook (ref sonnx.py:2212)."""
+        outs = self.backend.run(list(x), last_layers=last_layers)
+        return outs[0] if len(outs) == 1 else outs
+
+
+# ---- reference-name aliases (python/singa/sonnx.py) ----------------------
+from .backend import OnnxNode  # noqa: F401,E402
+from . import frontend as _frontend_module  # noqa: E402
+
+class SingaFrontend:
+    """Exporter entry points as classmethods, matching the reference's
+    class-of-staticmethods surface (sonnx.py:75/886-968); each delegates
+    to the functional exporter in frontend.py."""
+
+    @classmethod
+    def singa_to_onnx_model(cls, inputs, y, model_name="sonnx"):
+        return _frontend_module.to_onnx_model(inputs, y,
+                                              model_name=model_name)
+
+    @classmethod
+    def singa_to_onnx_graph(cls, inputs, y, model_name="sonnx"):
+        return cls.singa_to_onnx_model(inputs, y, model_name).graph
+
+    @classmethod
+    def handle_special_ops(cls, op, X, W):
+        raise NotImplementedError(
+            "special-op rewriting happens inside to_onnx_model here "
+            "(frontend.py); this hook is internal to the reference's "
+            "exporter and has no standalone equivalent")
+
+    @classmethod
+    def singa_op_to_onnx_node(cls, op, op_t):
+        """Export ONE traced op: the NodeProto list the exporter emits for
+        exactly this op, its inputs named from the tape edges
+        (ref sonnx.py:886)."""
+        del op_t  # the op carries its own outputs
+        f = _frontend_module
+        ctx = f._Ctx(None)
+        # name upstream producers' outputs without walking their
+        # subgraphs, and register Dummy leaves as graph INPUTS (cheap
+        # ValueInfo) rather than serialized initializers
+        input_ids = {}
+        for i, (src_op, x_id, _x, _s) in enumerate(op.src):
+            if isinstance(src_op, f.autograd.Dummy):
+                input_ids[x_id] = i
+            else:
+                key = (src_op, src_op.y_id2idx[x_id])
+                ctx.names.setdefault(key, ctx.fresh(f"in{i}"))
+        outs = f._out_names(ctx, op)
+        ins = [f._input_name(ctx, op, i, input_ids)
+               for i in range(len(op.src))]
+        return list(f._emit(ctx, op, ins, outs))
+
+
+class OnnxAttributes(dict):
+    """Plain-dict view of a node's ONNX attributes (ref sonnx.py:1023)."""
+
+    @staticmethod
+    def from_onnx(args):
+        d = OnnxAttributes()
+        for arg in args:
+            d[arg.name] = arg.value()  # AttributeProto.value
+        return d
+
+
+def onnx_type_to_singa_type(onnx_type):
+    """ONNX TensorProto dtype enum -> framework dtype name
+    (ref sonnx.py:64)."""
+    import numpy as np
+    np_dtype = onnx_pb._ONNX2NP.get(onnx_type)
+    return str(np.dtype(np_dtype)) if np_dtype is not None else None

@@ -1,0 +1,41 @@
+"""Third-party interop helpers.
+
+`export_torch_module` produces a genuine torch-exported .onnx without the
+`onnx` pip package: the TorchScript exporter imports it only to inline
+onnxscript functions, a no-op for plain modules, so that step is stubbed.
+Used by the example zoo and the interop tests (zero-egress stand-in for
+downloading zoo files).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _find_onnx_proto_utils():
+    """The private module moved across torch releases; probe known paths."""
+    try:
+        from torch.onnx._internal.torchscript_exporter import \
+            onnx_proto_utils  # torch >= 2.9
+        return onnx_proto_utils
+    except ImportError:
+        from torch.onnx._internal import onnx_proto_utils  # torch 2.x
+        return onnx_proto_utils
+
+
+def export_torch_module(m, args, path, opset=13):
+    """Export torch module `m` traced on `args` to ONNX at `path`."""
+    import torch
+    onnx_proto_utils = _find_onnx_proto_utils()
+    orig = onnx_proto_utils._add_onnxscript_fn
+    onnx_proto_utils._add_onnxscript_fn = lambda model_bytes, _: model_bytes
+    try:
+        m.eval()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        torch.onnx.export(m, args, str(path), opset_version=opset,
+                          dynamo=False)
+    finally:
+        onnx_proto_utils._add_onnxscript_fn = orig
+    return path

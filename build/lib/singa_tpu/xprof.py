@@ -1,0 +1,366 @@
+"""Per-op trace analysis: parse jax.profiler xplane dumps into op time tables.
+
+The reference's deepest profiling level is the scheduler's per-op CUDA-event
+table (reference src/core/scheduler/scheduler.cc:240-295: per-op fwd/bwd
+times printed after N iterations).  The TPU analog is the XLA profiler's
+xplane trace: every HLO op's device-side execution interval.  TensorBoard's
+profile plugin is the usual consumer, but it isn't available here — and a
+framework should be able to read its own profiles — so this module decodes
+the `*.xplane.pb` protobuf wire format directly (same approach as
+`sonnx/onnx_pb.py`: a ~100-line reader for the handful of message types we
+need, no protobuf dependency).
+
+Schema (tsl/profiler/protobuf/xplane.proto):
+  XSpace        { repeated XPlane planes = 1; }
+  XPlane        { int64 id=1; string name=2; repeated XLine lines=3;
+                  map<int64,XEventMetadata> event_metadata=4;
+                  map<int64,XStatMetadata> stat_metadata=5; }
+  XLine         { int64 id=1; string name=2; int64 timestamp_ns=3;
+                  repeated XEvent events=4; }
+  XEvent        { int64 metadata_id=1; int64 offset_ps=2;
+                  int64 duration_ps=3; repeated XStat stats=5; }
+  XEventMetadata{ int64 id=1; string name=2; string display_name=4; }
+  XStat         { int64 metadata_id=1; double double_value=2;
+                  uint64 uint64=3; int64 int64=4; string str=5; }
+  XStatMetadata { int64 id=1; string name=2; }
+
+Usage:
+    dev.StartTrace(logdir); ...steps...; dev.StopTrace()
+    table = xprof.op_table(logdir)          # list of dicts, sorted by time
+    print(xprof.format_table(table))
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from collections import defaultdict
+
+
+# ---- protobuf wire reader (subset) ----------------------------------------
+
+def _read_varint(buf: bytes, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) for one message body."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:          # varint
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:        # 64-bit
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:        # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:        # 32-bit
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _zigzag(v: int) -> int:
+    # xplane uses plain int64 (not sint64); varints of negatives are rare
+    # here and 2^63-wrapped; treat as signed two's-complement.
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+# ---- xplane model ----------------------------------------------------------
+
+class _Plane:
+    __slots__ = ("name", "lines", "event_meta", "stat_meta", "event_stats")
+
+    def __init__(self):
+        self.name = ""
+        self.lines = []          # list[(line_name, [(meta_id, dur_ps, stats)])]
+        self.event_meta = {}     # id -> name
+        self.stat_meta = {}      # id -> name
+        self.event_stats = {}    # id -> [raw XStat bytes] (from metadata)
+
+    def meta_stats(self, meta_id):
+        """Decoded {stat_name: value} attached to an event's METADATA
+        (XLA puts per-op constants here: hlo_category, flops,
+        raw_bytes_accessed, shape_with_layout, ...)."""
+        out = {}
+        for raw in self.event_stats.get(meta_id, ()):
+            sid, val = _parse_stat(raw)
+            nm = self.stat_meta.get(sid)
+            if nm:
+                out[nm] = val
+        return out
+
+
+def _parse_event(buf: bytes):
+    meta_id = 0
+    dur_ps = 0
+    stats = []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            meta_id = v
+        elif f == 3:
+            dur_ps = _zigzag(v)
+        elif f == 5 and w == 2:
+            stats.append(v)
+    return meta_id, dur_ps, stats
+
+
+def _parse_stat(buf: bytes):
+    """Return (metadata_id, value) with value decoded by wire type."""
+    import struct
+    meta_id = 0
+    val = None
+    for f, w, v in _fields(buf):
+        if f == 1:
+            meta_id = v
+        elif f == 2 and w == 1:
+            val = struct.unpack("<d", v)[0]
+        elif f in (3, 7):
+            val = v
+        elif f == 4:
+            val = _zigzag(v)
+        elif f in (5, 6):
+            try:
+                val = v.decode("utf-8", "replace")
+            except Exception:
+                val = v
+    return meta_id, val
+
+
+def _parse_line(buf: bytes):
+    name = ""
+    events = []
+    for f, w, v in _fields(buf):
+        if f == 2 and w == 2:
+            name = v.decode("utf-8", "replace")
+        elif f == 4 and w == 2:
+            events.append(_parse_event(v))
+    return name, events
+
+
+def _parse_metadata_entry(buf: bytes, name_field: int = 2):
+    """map<int64, X*Metadata> entry -> (id, name, [raw XStat bytes])."""
+    key = 0
+    name = ""
+    display = ""
+    stats = []
+    for f, w, v in _fields(buf):
+        if f == 1 and w == 0:
+            key = v
+        elif f == 2 and w == 2:
+            # value message (X*Metadata)
+            for f2, w2, v2 in _fields(v):
+                if f2 == name_field and w2 == 2:
+                    name = v2.decode("utf-8", "replace")
+                elif f2 == 4 and w2 == 2:      # display_name
+                    display = v2.decode("utf-8", "replace")
+                elif f2 == 5 and w2 == 2:      # XEventMetadata.stats
+                    stats.append(v2)
+    return key, (display or name), stats
+
+
+def _parse_plane(buf: bytes) -> _Plane:
+    p = _Plane()
+    for f, w, v in _fields(buf):
+        if f == 2 and w == 2:
+            p.name = v.decode("utf-8", "replace")
+        elif f == 3 and w == 2:
+            p.lines.append(_parse_line(v))
+        elif f == 4 and w == 2:
+            k, nm, st = _parse_metadata_entry(v)
+            p.event_meta[k] = nm
+            if st:
+                p.event_stats[k] = st
+        elif f == 5 and w == 2:
+            k, nm, _ = _parse_metadata_entry(v)
+            p.stat_meta[k] = nm
+    return p
+
+
+def parse_xspace(path: str):
+    """Parse one .xplane.pb file -> list of _Plane."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    planes = []
+    for f_, w, v in _fields(buf):
+        if f_ == 1 and w == 2:
+            planes.append(_parse_plane(v))
+    return planes
+
+
+# ---- aggregation -----------------------------------------------------------
+
+_CATEGORY_RULES = [
+    ("conv", re.compile(r"^(%?)conv(?!ert)", re.I)),
+    ("matmul", re.compile(r"^(%?)(dot|gemm|matmul)", re.I)),
+    ("fusion", re.compile(r"^(%?)fusion", re.I)),
+    ("allreduce", re.compile(r"(all-reduce|allreduce)", re.I)),
+    ("allgather", re.compile(r"(all-gather|allgather)", re.I)),
+    ("copy", re.compile(r"^(%?)(copy|transpose|bitcast)", re.I)),
+    ("reduce", re.compile(r"^(%?)reduce", re.I)),
+    ("infeed/outfeed", re.compile(r"(infeed|outfeed)", re.I)),
+]
+
+
+def _category(op_name: str) -> str:
+    for cat, rx in _CATEGORY_RULES:
+        if rx.search(op_name):
+            return cat
+    return "other"
+
+
+def find_xplane_files(logdir: str):
+    return sorted(glob.glob(
+        os.path.join(logdir, "**", "*.xplane.pb"), recursive=True))
+
+
+def op_table(logdir: str, device_only: bool = True,
+             include_async: bool = False):
+    """Aggregate per-op device time across all traces under `logdir`.
+
+    Returns a list of dicts sorted by total_ms desc:
+      {op, category, total_ms, count, avg_us, pct}
+    Only device planes (TPU/GPU/host-CPU XLA ops) are counted; python-side
+    planes are skipped so the table reflects accelerator time, like the
+    reference's per-op table reflects CUDA-event time.
+
+    A TPU device plane carries several lines: 'XLA Ops' is the exclusive
+    compute timeline (what this table reports), 'Async XLA Ops' are
+    DMA/copy events that OVERLAP compute (their durations double-count
+    wall-clock — excluded unless `include_async`), and 'Steps'/'XLA
+    Modules' are per-step envelopes (always excluded).
+    """
+    planes = [p for path in find_xplane_files(logdir)
+              for p in parse_xspace(path)]
+    dev_planes = [p for p in planes if "/device:" in p.name.lower()]
+    if device_only and dev_planes:
+        planes = dev_planes  # real accelerator planes (TPU/GPU)
+    # else: CPU-only traces put XLA op events on the /host:CPU plane —
+    # fall back to every plane that has op lines so tests work on CPU.
+    total_ps = defaultdict(int)
+    count = defaultdict(int)
+    for plane in planes:
+        for line_name, events in plane.lines:
+            nm = line_name.lower()
+            if ("module" in nm or "step" in nm or "overlay" in nm
+                    or "framework" in nm):
+                continue  # per-step/module envelopes, not leaf ops
+            if "async" in nm and not include_async:
+                continue  # overlapped DMA: double-counts wall-clock
+            for meta_id, dur_ps, _stats in events:
+                op = plane.event_meta.get(meta_id, f"op#{meta_id}")
+                total_ps[op] += dur_ps
+                count[op] += 1
+    grand = sum(total_ps.values()) or 1
+    rows = [
+        {
+            "op": op,
+            "category": _category(op),
+            "total_ms": ps / 1e9,
+            "count": count[op],
+            "avg_us": ps / 1e6 / max(count[op], 1),
+            "pct": 100.0 * ps / grand,
+        }
+        for op, ps in total_ps.items()
+    ]
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def hlo_category_table(logdir: str, steps: int = 1):
+    """Per-HLO-category time/bytes/flops table from the XLA-attached event
+    metadata (stat names `hlo_category`, `raw_bytes_accessed`,
+    `model_flops`). This is the honest profile: unlike the compile-time
+    cost analysis, the durations are measured and the categories are
+    XLA's own (convolution fusion / loop fusion / copy / formatting...).
+    `steps`: divide totals to get per-step numbers. Returns rows sorted by
+    time: {category, ms, gbytes, tflops, pct, achieved_gbs, tflops_s}."""
+    planes = [p for path in find_xplane_files(logdir)
+              for p in parse_xspace(path)]
+    dev = [p for p in planes if "/device:" in p.name.lower()]
+    agg = defaultdict(lambda: [0, 0.0, 0.0])
+    for plane in (dev or planes):
+        for line_name, events in plane.lines:
+            if line_name != "XLA Ops":
+                continue
+            for meta_id, dur_ps, _ in events:
+                st = plane.meta_stats(meta_id)
+                a = agg[st.get("hlo_category", "?")]
+                a[0] += dur_ps
+                a[1] += float(st.get("raw_bytes_accessed") or 0)
+                a[2] += float(st.get("model_flops") or st.get("flops") or 0)
+    grand_ps = sum(a[0] for a in agg.values()) or 1
+    rows = []
+    for cat, (ps, b, fl) in agg.items():
+        ms = ps / 1e9 / steps
+        sec = ps / 1e12
+        rows.append({
+            "category": cat,
+            "ms": ms,
+            "gbytes": b / 1e9 / steps,
+            "tflops": fl / 1e12 / steps,
+            "pct": 100.0 * ps / grand_ps,
+            "achieved_gbs": (b / steps) / (ms / 1e3) / 1e9 if ms else 0.0,
+            "tflops_s": (fl / 1e12) / sec if sec else 0.0,
+        })
+    rows.sort(key=lambda r: -r["ms"])
+    return rows
+
+
+def format_hlo_categories(rows) -> str:
+    lines = [f"{'category':<26} {'ms/step':>8} {'pct':>6} {'GB/step':>8} "
+             f"{'GB/s':>7} {'TF/step':>8} {'TF/s':>7}"]
+    for r in rows:
+        lines.append(
+            f"{r['category']:<26} {r['ms']:>8.3f} {r['pct']:>5.1f}% "
+            f"{r['gbytes']:>8.3f} {r['achieved_gbs']:>7.0f} "
+            f"{r['tflops']:>8.4f} {r['tflops_s']:>7.1f}")
+    return "\n".join(lines)
+
+
+def category_table(rows):
+    """Collapse an op_table into per-category totals."""
+    agg = defaultdict(lambda: [0.0, 0])
+    for r in rows:
+        agg[r["category"]][0] += r["total_ms"]
+        agg[r["category"]][1] += r["count"]
+    grand = sum(v[0] for v in agg.values()) or 1
+    out = [
+        {"category": c, "total_ms": ms, "count": n,
+         "pct": 100.0 * ms / grand}
+        for c, (ms, n) in agg.items()
+    ]
+    out.sort(key=lambda r: -r["total_ms"])
+    return out
+
+
+def format_table(rows, top: int = 25) -> str:
+    lines = [f"{'op':<56} {'cat':<10} {'total_ms':>9} {'count':>6} "
+             f"{'avg_us':>9} {'pct':>6}"]
+    for r in rows[:top]:
+        lines.append(
+            f"{r['op'][:56]:<56} {r['category']:<10} {r['total_ms']:>9.3f} "
+            f"{r['count']:>6} {r['avg_us']:>9.1f} {r['pct']:>5.1f}%")
+    rest = rows[top:]
+    if rest:
+        ms = sum(r["total_ms"] for r in rest)
+        pct = sum(r["pct"] for r in rest)
+        lines.append(f"{'... ' + str(len(rest)) + ' more':<56} {'':<10} "
+                     f"{ms:>9.3f} {'':>6} {'':>9} {pct:>5.1f}%")
+    return "\n".join(lines)
