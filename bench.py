@@ -96,6 +96,14 @@ def main():
                         "and print a per-op device-time table (singa_tpu."
                         "xprof) to stderr — the TPU analog of the "
                         "reference's scheduler per-op profile")
+    p.add_argument("--metrics-out", default=None, metavar="FILE",
+                   help="write the observe registry as Prometheus text "
+                        "after the run (step histograms, compile counts, "
+                        "and the bench numbers as singa_bench_* gauges)")
+    p.add_argument("--events-out", default=None, metavar="FILE",
+                   help="attach a JSONL EventLog: per-step records during "
+                        "the run plus the final bench record, same schema "
+                        "as runtime telemetry")
     args = p.parse_args()
     if args.amp is None:
         args.amp = True
@@ -108,7 +116,10 @@ def main():
 
     import numpy as np
     import jax
-    from singa_tpu import device, models, opt, tensor
+    from singa_tpu import device, models, observe, opt, tensor
+
+    if args.events_out:
+        observe.set_event_log(args.events_out)
 
     dev = device.best_device()
     on_cpu = dev.is_host()
@@ -317,6 +328,13 @@ def main():
     }
     if note:
         rec["note"] = note
+    # one schema: the BENCH_*.json record also lands in the registry
+    # (singa_bench_* gauges) and the EventLog, next to the per-step
+    # telemetry the run itself produced
+    observe.record_bench(rec)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            f.write(observe.to_prometheus_text())
     print(json.dumps(rec))
     return 0
 

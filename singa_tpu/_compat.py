@@ -1,0 +1,50 @@
+"""jax version-compatibility shims.
+
+The framework targets the current jax API surface; containers sometimes
+pin older releases (e.g. jax 0.4.x). Each shim here is version-gated —
+a no-op on modern jax — and installed by importing this module, which
+the jax-using core modules (model, ops.attention) and tests/conftest.py
+do. Importing this module imports jax but does NOT initialize a backend.
+
+Shims:
+- `jax.shard_map`: pre-0.6 jax only has
+  `jax.experimental.shard_map.shard_map`, whose replication-check kwarg
+  is `check_rep` rather than `check_vma`. The shim forwards and renames.
+- `jax.lax.axis_size`: absent on old jax; `lax.psum(1, name)` is the
+  classic spelling and constant-folds to a static Python int inside
+  mapped contexts (verified on 0.4.37), so the shim is exact.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install_shard_map():
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard_map(f=None, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        if f is None:  # functools.partial(jax.shard_map, mesh=...) style
+            return lambda g: shard_map(g, **kw)
+        return _sm(f, **kw)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size():
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = axis_size
+
+
+_install_shard_map()
+_install_axis_size()

@@ -143,12 +143,21 @@ class Device:
         _active_trace_dir = log_dir
 
     def StopTrace(self) -> "str | None":
-        """Stop the capture; returns the log dir (None if none active)."""
+        """Stop the capture; returns the log dir. Idempotent: with no
+        trace active (never started, or already stopped — including by a
+        second StopTrace or by jax.profiler directly) it returns None
+        cleanly instead of raising, so shutdown paths can call it
+        unconditionally."""
         global _active_trace_dir
         out = _active_trace_dir
         if out is not None:
             try:
                 jax.profiler.stop_trace()
+            except Exception:
+                # someone stopped the process-global profiler under us;
+                # idempotence beats raising — the flag reset below keeps
+                # future StartTrace working either way
+                pass
             finally:
                 _active_trace_dir = None  # never wedge future StartTrace
         return out

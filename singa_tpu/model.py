@@ -28,7 +28,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import _compat  # noqa: F401  (installs jax.shard_map on old jax)
 from . import autograd
+from . import observe
 from .layer import Layer, LayerMeta
 from .tensor import Tensor
 
@@ -395,7 +397,9 @@ class Model(Layer, metaclass=ModelMeta):
         self._out_template_box = out_template_box
         self._step_builder = make_step
         self._compiled_step = {}   # step-tag -> jitted executable
+        self._step_sigs = set()    # (tag, input shapes) variants seen
         self._step_stats["compile_s"] = time.perf_counter() - t0
+        observe.record_step_build(self._step_stats["compile_s"])
 
     def _invoke_step(self, args):
         opt = self._optimizer
@@ -463,6 +467,22 @@ class Model(Layer, metaclass=ModelMeta):
         fn = self._compiled_step.get(tag)
         if fn is None:
             fn = self._compiled_step[tag] = self._step_builder(tag)
+        obs = observe.is_enabled()
+        bs = None
+        if obs:
+            if input_arrs and getattr(input_arrs[0], "ndim", 0):
+                bs = input_arrs[0].shape[0]
+            # (tag, input-shape) signature: jit retraces exactly when it
+            # changes, so first-seen == a compile (first ever) or a
+            # recompile (new batch-size class / step tag)
+            sig = (tag, tuple(getattr(a, "shape", ()) for a in input_arrs))
+            if sig not in self._step_sigs:
+                observe.record_compile(
+                    bs, recompile=bool(self._step_sigs),
+                    donated_bytes=sum(int(getattr(a, "nbytes", 0))
+                                      for a in (*state_arrs, *opt_arrs)))
+                self._step_sigs.add(sig)
+            t_obs = time.perf_counter()
         profiling = (dev.verbosity > 0 and
                      self._step_stats["steps"] >= dev.skip_iteration)
         if profiling:
@@ -474,7 +494,9 @@ class Model(Layer, metaclass=ModelMeta):
             state_arrs, opt_arrs, rng, input_arrs)
         if profiling:
             jax.block_until_ready(new_states)
-            dev.step_times.append(time.perf_counter() - t0)
+            fenced = time.perf_counter() - t0
+            dev.step_times.append(fenced)
+            observe.record_step_fenced(fenced)
         for t, a in zip(self._state_tensors, new_states):
             t.data = a
         if opt is not None and new_opt:
@@ -489,6 +511,9 @@ class Model(Layer, metaclass=ModelMeta):
             new_rng = jax.device_put(new_rng, dev.jax_device)
         dev.rng_state = new_rng
         self._step_stats["steps"] += 1
+        if obs:
+            observe.record_step(time.perf_counter() - t_obs,
+                                batch=bs, tag=tag, device=dev)
         tensors = [Tensor(data=a, device=dev, requires_grad=False)
                    for a in outs]
         return _rebuild_out(self._out_template_box["t"], tensors)
@@ -765,8 +790,10 @@ class Model(Layer, metaclass=ModelMeta):
                     sharding=NamedSharding(mesh, PartitionSpec()))
             return jax.ShapeDtypeStruct(tuple(m.shape), np.dtype(m.dtype))
 
-        meta = ocp.StandardCheckpointer().metadata(
-            os.path.abspath(path)).item_metadata
+        meta = ocp.StandardCheckpointer().metadata(os.path.abspath(path))
+        # newer orbax wraps the tree in CheckpointMetadata.item_metadata;
+        # older releases return the tree directly
+        meta = getattr(meta, "item_metadata", meta)
         tpl = {
             "model": {k: sds(t.data)
                       for k, t in self.get_states().items()},

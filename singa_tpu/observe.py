@@ -1,0 +1,665 @@
+"""Runtime metrics & tracing: the live-telemetry layer.
+
+The reference's only observability is the scheduler's per-op CUDA-event
+table printed after N iterations (scheduler.cc:240-295), mirrored here by
+the post-hoc xplane parser (xprof.py) — both tell you nothing while a job
+is running. This module is the runtime layer every perf/robustness change
+measures itself against:
+
+  - `MetricsRegistry` with `Counter` / `Gauge` / `Histogram` (fixed
+    log-scale buckets, stdlib only — no prometheus_client dependency),
+  - `span(name, **attrs)`: a nesting context manager that records wall
+    time into the `singa_span_seconds` histogram AND forwards to
+    `jax.profiler.TraceAnnotation`, so the same spans appear in xplane
+    traces that `xprof.op_table` decodes (category "span") — one name
+    correlates the live histogram with the post-hoc device timeline,
+  - exporters: `to_prometheus_text()` (pull-style scrape body) and a
+    rotating JSONL `EventLog` for step/serving/bench records.
+
+Metric-name contract (enforced at registration AND by
+tools/check_metrics_names.py): names match ^singa_[a-z0-9_]+$ and a name
+is registered with exactly one type. Semantics under jit: helpers called
+from *traced* code (optimizer apply loops, communicator collectives) fire
+once per compilation, not per step — they record the traced program's
+shape (calls per step, bytes per step); wall-clock per executed step comes
+from the host-side callers (`record_step`, serving wrappers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+
+_NAME_RE = re.compile(r"^singa_[a-z0-9_]+$")
+
+# Log-scale bucket boundaries (seconds): 1e-6 .. 1e3, ratio sqrt(10).
+# Wide enough for a 2us collective and a 15-minute XLA compile alike.
+DEFAULT_BUCKETS = tuple(10.0 ** (e / 2.0) for e in range(-12, 7))
+
+
+def _label_key(labels: dict):
+    return tuple(sorted(labels.items()))
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_labels(key) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc(v)}"' for k, v in key) + "}"
+
+
+def _fmt_num(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return format(f, "g")
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} must match {_NAME_RE.pattern}")
+        self.name = name
+        self.help = help
+        # mutations are read-modify-write; serving threads update the
+        # same series concurrently, so each metric carries its own lock
+        # (uncontended acquire is ~100ns — noise on the step path)
+        self._mlock = threading.Lock()
+
+
+class Counter(_Metric):
+    """Monotonic counter; `inc` with optional labels."""
+
+    kind = "counter"
+
+    def __init__(self, name, help=""):
+        super().__init__(name, help)
+        self._values = {}
+
+    def inc(self, n: float = 1.0, **labels):
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        k = _label_key(labels)
+        with self._mlock:
+            self._values[k] = self._values.get(k, 0.0) + n
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def samples(self):
+        for k, v in sorted(self._values.items()):
+            yield self.name, k, v
+
+    def snapshot(self):
+        return [{"labels": dict(k), "value": v}
+                for k, v in sorted(self._values.items())]
+
+
+class Gauge(_Metric):
+    """Point-in-time value; `set`/`inc`/`dec` with optional labels."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help=""):
+        super().__init__(name, help)
+        self._values = {}
+
+    def set(self, v: float, **labels):
+        with self._mlock:
+            self._values[_label_key(labels)] = float(v)
+
+    def inc(self, n: float = 1.0, **labels):
+        k = _label_key(labels)
+        with self._mlock:
+            self._values[k] = self._values.get(k, 0.0) + n
+
+    def dec(self, n: float = 1.0, **labels):
+        self.inc(-n, **labels)
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def samples(self):
+        for k, v in sorted(self._values.items()):
+            yield self.name, k, v
+
+    def snapshot(self):
+        return [{"labels": dict(k), "value": v}
+                for k, v in sorted(self._values.items())]
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (cumulative-le on export, like Prometheus).
+
+    Buckets are static log-scale upper bounds; `observe` is O(#buckets)
+    worst case (linear scan — ~19 comparisons, cheap enough for the step
+    path) and tracks per-label-set count/sum alongside.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=None):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._series = {}  # label key -> [counts list, count, sum]
+
+    def _row(self, labels):
+        k = _label_key(labels)
+        row = self._series.get(k)
+        if row is None:
+            row = self._series[k] = [[0] * (len(self.buckets) + 1), 0, 0.0]
+        return row
+
+    def observe(self, v: float, **labels):
+        i = len(self.buckets)  # overflow (+Inf) slot
+        for j, ub in enumerate(self.buckets):
+            if v <= ub:
+                i = j
+                break
+        with self._mlock:
+            row = self._row(labels)
+            row[0][i] += 1
+            row[1] += 1
+            row[2] += float(v)
+
+    def count(self, **labels) -> int:
+        return self._series.get(_label_key(labels), [None, 0, 0.0])[1]
+
+    def sum(self, **labels) -> float:
+        return self._series.get(_label_key(labels), [None, 0, 0.0])[2]
+
+    def bucket_counts(self, **labels):
+        """Cumulative counts per upper bound (+Inf last)."""
+        row = self._series.get(_label_key(labels))
+        if row is None:
+            return [0] * (len(self.buckets) + 1)
+        out, acc = [], 0
+        for c in row[0]:
+            acc += c
+            out.append(acc)
+        return out
+
+    def snapshot(self):
+        out = []
+        for k, (counts, n, s) in sorted(self._series.items()):
+            cum, acc = {}, 0
+            for ub, c in zip(self.buckets, counts):
+                acc += c
+                cum[_fmt_num(ub)] = acc
+            cum["+Inf"] = n
+            out.append({"labels": dict(k), "count": n, "sum": s,
+                        "buckets": cum})
+        return out
+
+
+class EventLog:
+    """Rotating JSONL sink for step/serving/bench records.
+
+    `write(record)` appends one compact JSON line (a `ts` epoch field is
+    stamped if absent). When the file would exceed `max_bytes` it rotates
+    shift-style: path -> path.1 -> ... -> path.<backups> (oldest dropped).
+    """
+
+    def __init__(self, path: str, max_bytes: int = 10_000_000,
+                 backups: int = 3):
+        self.path = str(path)
+        self.max_bytes = int(max_bytes)
+        self.backups = int(backups)
+        self._lock = threading.Lock()
+        self._fh = None
+
+    def _open(self):
+        if self._fh is None or self._fh.closed:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        return self._fh
+
+    def _rotate(self):
+        if self._fh is not None and not self._fh.closed:
+            self._fh.close()
+        self._fh = None
+        if self.backups <= 0:
+            # no backups: truncate in place so max_bytes still holds
+            if os.path.exists(self.path):
+                os.remove(self.path)
+            return
+        for i in range(self.backups - 1, 0, -1):
+            src, dst = f"{self.path}.{i}", f"{self.path}.{i + 1}"
+            if os.path.exists(src):
+                os.replace(src, dst)
+        if os.path.exists(self.path):
+            os.replace(self.path, f"{self.path}.1")
+
+    def write(self, record: dict):
+        if "ts" not in record:
+            record = {"ts": round(time.time(), 6), **record}
+        line = json.dumps(record, separators=(",", ":"), default=str) + "\n"
+        with self._lock:
+            fh = self._open()
+            if fh.tell() + len(line) > self.max_bytes and fh.tell() > 0:
+                self._rotate()
+                fh = self._open()
+            fh.write(line)
+            fh.flush()
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None and not self._fh.closed:
+                self._fh.close()
+            self._fh = None
+
+    @staticmethod
+    def read(path: str):
+        """Parse one JSONL file back into a list of dicts (skips
+        torn/partial trailing lines rather than raising — a crash
+        mid-write must not make the whole log unreadable)."""
+        out = []
+        if not os.path.exists(path):
+            return out
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+        return out
+
+
+class MetricsRegistry:
+    """Process-wide metric store: get-or-create by (name, type), one type
+    per name (re-registering under a different type raises — the same
+    contract tools/check_metrics_names.py lints statically)."""
+
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.Lock()
+        self.event_log: EventLog | None = None
+        self.recent = deque(maxlen=512)  # last emitted records, in memory
+
+    def _register(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}, "
+                        f"cannot re-register as {cls.kind}")
+                return m
+            m = self._metrics[name] = cls(name, help, **kw)
+            return m
+
+    def counter(self, name, help="") -> Counter:
+        return self._register(Counter, name, help)
+
+    def gauge(self, name, help="") -> Gauge:
+        return self._register(Gauge, name, help)
+
+    def histogram(self, name, help="", buckets=None) -> Histogram:
+        return self._register(Histogram, name, help, buckets=buckets)
+
+    def get(self, name):
+        return self._metrics.get(name)
+
+    def names(self):
+        return sorted(self._metrics)
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+            self.recent.clear()
+
+    def emit(self, record: dict):
+        """Route a structured record to the in-memory ring and, when one
+        is attached, the JSONL EventLog."""
+        if "ts" not in record:
+            record = {"ts": round(time.time(), 6), **record}
+        self.recent.append(record)
+        log = self.event_log
+        if log is not None:
+            log.write(record)
+
+    # ---- exporters -------------------------------------------------------
+    def to_prometheus_text(self) -> str:
+        """Prometheus text exposition format (0.0.4): per metric a
+        `# HELP` / `# TYPE` header then its samples; histograms expand to
+        cumulative `_bucket{le=...}` + `_sum` + `_count`."""
+        lines = []
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            if m.help:
+                lines.append(f"# HELP {name} {_esc(m.help)}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            if isinstance(m, Histogram):
+                for k, (counts, n, s) in sorted(m._series.items()):
+                    acc = 0
+                    for ub, c in zip(m.buckets, counts):
+                        acc += c
+                        lk = _fmt_labels(k + (("le", _fmt_num(ub)),))
+                        lines.append(f"{name}_bucket{lk} {acc}")
+                    lk = _fmt_labels(k + (("le", "+Inf"),))
+                    lines.append(f"{name}_bucket{lk} {n}")
+                    lines.append(f"{name}_sum{_fmt_labels(k)} {repr(s)}")
+                    lines.append(f"{name}_count{_fmt_labels(k)} {n}")
+            else:
+                for _nm, k, v in m.samples():
+                    lines.append(f"{name}{_fmt_labels(k)} {_fmt_num(v)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict:
+        return {name: {"type": m.kind, "help": m.help,
+                       "samples": m.snapshot()}
+                for name, m in sorted(self._metrics.items())}
+
+
+# ---- process-wide default registry ----------------------------------------
+
+_default = MetricsRegistry()
+_enabled = True
+_tls = threading.local()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default
+
+
+def enable(flag: bool = True):
+    """Master switch for the built-in instrumentation hooks (the
+    record_* helpers become no-ops; explicit metric objects still work)."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def counter(name, help="") -> Counter:
+    return _default.counter(name, help)
+
+
+def gauge(name, help="") -> Gauge:
+    return _default.gauge(name, help)
+
+
+def histogram(name, help="", buckets=None) -> Histogram:
+    return _default.histogram(name, help, buckets=buckets)
+
+
+def set_event_log(log: "EventLog | str | None"):
+    """Attach a JSONL EventLog (or a path, or None to detach) that every
+    emitted step/serving/bench record is appended to."""
+    if isinstance(log, str):
+        log = EventLog(log)
+    _default.event_log = log
+    return log
+
+
+def get_event_log():
+    return _default.event_log
+
+
+def to_prometheus_text() -> str:
+    return _default.to_prometheus_text()
+
+
+def dump(path: str | None = None) -> dict:
+    """One JSON-able snapshot of every registered metric (and the recent
+    in-memory event records). With `path`, also written to disk — the
+    pull-less analog of a Prometheus scrape for batch jobs."""
+    data = {"ts": round(time.time(), 6),
+            "metrics": _default.snapshot(),
+            "recent_events": list(_default.recent)}
+    if path:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=1, default=str)
+    return data
+
+
+# ---- spans -----------------------------------------------------------------
+
+SPAN_TRACE_PREFIX = "singa.span/"
+
+
+def current_span() -> "str | None":
+    stack = getattr(_tls, "span_stack", None)
+    return stack[-1] if stack else None
+
+
+class span:
+    """`with span("serving.prefill", tokens=4096): ...`
+
+    Nests: the recorded label is the slash-joined path of enclosing spans
+    ("model.step/opt.apply_updates"), so one histogram
+    (`singa_span_seconds{span=...}`) holds the whole hierarchy. The same
+    path (prefixed `singa.span/`) is forwarded to
+    `jax.profiler.TraceAnnotation`, so an active `Device.StartTrace`
+    capture carries these spans and `xprof.op_table` surfaces them next
+    to the per-HLO device rows. Safe with no jax and inside jit tracing
+    (annotation + wall time then describe the trace, not the step).
+    """
+
+    __slots__ = ("name", "attrs", "path", "_t0", "_ann")
+
+    def __init__(self, name: str, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self.path = None
+        self._ann = None
+
+    def __enter__(self):
+        stack = getattr(_tls, "span_stack", None)
+        if stack is None:
+            stack = _tls.span_stack = []
+        self.path = f"{stack[-1]}/{self.name}" if stack else self.name
+        stack.append(self.path)
+        try:
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(
+                SPAN_TRACE_PREFIX + self.path, **self.attrs)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None  # no jax / no profiler: hist-only span
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+        stack = getattr(_tls, "span_stack", None)
+        if stack and stack[-1] == self.path:
+            stack.pop()
+        if _enabled:
+            _default.histogram(
+                "singa_span_seconds",
+                "wall seconds per span() region (label: slash-joined "
+                "span path)").observe(dt, span=self.path)
+        return False
+
+
+# ---- framework instrumentation hooks ---------------------------------------
+# Called from the hot paths (model/opt/serving/communicator/bench). Each is
+# a no-op when disabled; none of them may raise into the training loop.
+
+def record_step_build(seconds: float):
+    """Step-builder wall time (Model._build_step: trace prep, not the XLA
+    compile itself — that lands in the first step's latency)."""
+    if not _enabled:
+        return
+    histogram("singa_step_build_seconds",
+              "Model._build_step wall seconds").observe(seconds)
+
+
+def record_compile(batch_class, recompile: bool = False,
+                   donated_bytes: int | None = None):
+    """A new compiled step variant: first-ever -> compile, later
+    batch-size classes / step tags -> recompile. `batch_class` is the
+    leading batch dim (the retrace trigger under jit)."""
+    if not _enabled:
+        return
+    bc = str(batch_class)
+    if recompile:
+        counter("singa_model_recompile_total",
+                "step retraces beyond the first compile, per batch-size "
+                "class").inc(batch_class=bc)
+    counter("singa_model_compile_total",
+            "compiled step variants, per batch-size class"
+            ).inc(batch_class=bc)
+    if donated_bytes is not None:
+        gauge("singa_step_donated_bytes",
+              "bytes of state+opt buffers donated into the compiled "
+              "step").set(float(donated_bytes))
+
+
+def record_hbm(device):
+    """Per-step HBM gauges via jax.Device.memory_stats (the hook
+    device.get_gpu_mem_size reads); silently absent on backends without
+    memory stats (host CPU)."""
+    if not _enabled:
+        return
+    try:
+        stats = getattr(device.jax_device, "memory_stats", lambda: None)()
+    except Exception:
+        stats = None
+    if not stats:
+        return
+    if "bytes_in_use" in stats:
+        gauge("singa_hbm_bytes_in_use",
+              "device bytes in use").set(float(stats["bytes_in_use"]))
+    if "bytes_limit" in stats:
+        gauge("singa_hbm_bytes_limit",
+              "device bytes limit").set(float(stats["bytes_limit"]))
+    if "peak_bytes_in_use" in stats:
+        gauge("singa_hbm_peak_bytes_in_use",
+              "peak device bytes in use").set(
+            float(stats["peak_bytes_in_use"]))
+
+
+def record_step(seconds: float, batch=None, tag=0, device=None):
+    """One Model train step (un-fenced dispatch wall time: on an async
+    backend this is submit latency; fenced latency is the verbosity>0
+    `dev.step_times` path / `singa_step_fenced_seconds`)."""
+    if not _enabled:
+        return
+    histogram("singa_step_seconds",
+              "train step dispatch wall seconds").observe(seconds)
+    c = counter("singa_steps_total", "train steps invoked")
+    c.inc()
+    if device is not None:
+        record_hbm(device)
+    _default.emit({"kind": "step", "step": int(c.value()),
+                   "seconds": round(seconds, 9),
+                   "batch": batch, "tag": tag})
+
+
+def record_step_fenced(seconds: float):
+    """Fenced (block_until_ready) step latency — recorded by the
+    verbosity>0 profiling path alongside dev.step_times."""
+    if not _enabled:
+        return
+    histogram("singa_step_fenced_seconds",
+              "train step fenced wall seconds").observe(seconds)
+
+
+def record_opt_update(n_params: int, seconds: float, strategy: str):
+    """Optimizer apply-updates pass. Under graph mode this runs inside
+    the jit trace, so it fires once per compilation (it measures trace
+    cost and the per-step param count); on the eager path it fires per
+    step."""
+    if not _enabled:
+        return
+    counter("singa_opt_updates_total",
+            "parameter updates applied (per trace under jit)"
+            ).inc(n_params, strategy=strategy)
+    histogram("singa_opt_apply_seconds",
+              "apply-updates wall seconds (trace cost under jit)"
+              ).observe(seconds, strategy=strategy)
+
+
+def record_comm(op: str, nbytes: int, world_size: int = 1):
+    """One collective in the program. Called at trace time under jit
+    (shapes are static, so bytes are exact): counters describe the
+    compiled step's communication — multiply by singa_steps_total for
+    cumulative wire traffic; device time per collective comes from the
+    xprof tables (the collectives are wrapped in named scopes)."""
+    if not _enabled:
+        return
+    counter("singa_comm_calls_total",
+            "collectives in traced/eager programs").inc(op=op)
+    if world_size > 1:
+        counter("singa_comm_bytes_total",
+                "payload bytes per traced collective"
+                ).inc(float(nbytes), op=op)
+
+
+def record_decode(kind: str, seconds: float, new_tokens: int, batch: int,
+                  ttft: float | None = None, prompt_tokens: int = 0):
+    """One serving decode call (end-to-end, fenced)."""
+    if not _enabled:
+        return
+    histogram("singa_serving_decode_seconds",
+              "end-to-end decode seconds").observe(seconds, kind=kind)
+    if ttft is not None:
+        histogram("singa_serving_ttft_seconds",
+                  "time to first token (prefill + first sample)"
+                  ).observe(ttft, kind=kind)
+    counter("singa_serving_tokens_total",
+            "generated tokens").inc(float(new_tokens), kind=kind)
+    counter("singa_serving_requests_total",
+            "decode calls").inc(kind=kind)
+    tps = new_tokens / seconds if seconds > 0 else 0.0
+    gauge("singa_serving_tokens_per_sec",
+          "last decode call's generation rate").set(tps, kind=kind)
+    gauge("singa_serving_batch_occupancy",
+          "sequences in the last decode batch").set(float(batch), kind=kind)
+    _default.emit({"kind": "serving", "decode": kind,
+                   "seconds": round(seconds, 6),
+                   "ttft_seconds": round(ttft, 6) if ttft is not None
+                   else None,
+                   "new_tokens": new_tokens, "batch": batch,
+                   "prompt_tokens": prompt_tokens,
+                   "tokens_per_sec": round(tps, 3)})
+
+
+def record_bench(rec: dict):
+    """Mirror a bench.py result record into the registry (gauges named
+    singa_bench_<field>) and the EventLog, so BENCH_*.json artifacts and
+    runtime telemetry share one schema."""
+    if not _enabled:
+        return
+    for k, v in rec.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        name = "singa_bench_" + re.sub(r"[^a-z0-9_]", "_", str(k).lower())
+        gauge(name, "bench.py result field"
+              ).set(float(v), metric=str(rec.get("metric", "")))
+    _default.emit({"kind": "bench", **rec})
+
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "EventLog",
+    "span", "current_span", "get_registry", "enable", "is_enabled",
+    "counter", "gauge", "histogram", "set_event_log", "get_event_log",
+    "to_prometheus_text", "dump", "DEFAULT_BUCKETS", "SPAN_TRACE_PREFIX",
+    "record_step", "record_step_build", "record_step_fenced",
+    "record_compile", "record_hbm", "record_opt_update", "record_comm",
+    "record_decode", "record_bench",
+]

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import _compat  # noqa: F401  (installs jax.shard_map on old jax)
+
 _NEG_INF = -1e30
 
 

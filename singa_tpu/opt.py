@@ -18,10 +18,13 @@ stream calls. Optimizer state are Tensors threaded through the jitted step
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 import jax.numpy as jnp
 
 from . import autograd
+from . import observe
 from .tensor import Tensor
 
 
@@ -145,9 +148,17 @@ class Optimizer:
         return self.backward_and_update(loss)
 
     def backward_and_update(self, loss: Tensor):
-        for p, g in autograd.backward(loss):
-            self.apply(p, g)
+        # Under graph mode this runs at TRACE time, so the telemetry
+        # fires once per compilation (param count + trace cost), not per
+        # step — see observe.record_opt_update.
+        t0 = time.perf_counter()
+        n = 0
+        with observe.span("opt.apply_updates"):
+            for p, g in autograd.backward(loss):
+                self.apply(p, g)
+                n += 1
         self.step()
+        observe.record_opt_update(n, time.perf_counter() - t0, "local")
 
     def step(self):
         self.step_counter = self.step_counter + 1.0
@@ -471,10 +482,16 @@ class DistOpt(Optimizer):
 
     # -- strategy 1: plain synchronous allreduce (ref opt.py:826) ----------
     def backward_and_update(self, loss: Tensor):
-        for p, g in autograd.backward(loss):
-            g.data = self.communicator.all_reduce(g.data) / self.world_size
-            self.opt.apply(p, g)
+        t0 = time.perf_counter()
+        n = 0
+        with observe.span("opt.apply_updates"):
+            for p, g in autograd.backward(loss):
+                g.data = self.communicator.all_reduce(g.data) \
+                    / self.world_size
+                self.opt.apply(p, g)
+                n += 1
         self.opt.step()
+        observe.record_opt_update(n, time.perf_counter() - t0, "dense")
 
     def __call__(self, loss):
         return self.backward_and_update(loss)
@@ -484,14 +501,19 @@ class DistOpt(Optimizer):
                                  clip_value=100.0):
         """bf16 on TPU where the reference uses fp16 (ICI moves half the
         bytes; bf16 keeps fp32's exponent so no loss-scaling needed)."""
-        for p, g in autograd.backward(loss):
-            gd = g.data
-            if clipping:
-                gd = jnp.clip(gd, -clip_value, clip_value)
-            gd = self.communicator.all_reduce_half(gd) / self.world_size
-            g.data = gd.astype(p.dtype)
-            self.opt.apply(p, g)
+        t0 = time.perf_counter()
+        n = 0
+        with observe.span("opt.apply_updates"):
+            for p, g in autograd.backward(loss):
+                gd = g.data
+                if clipping:
+                    gd = jnp.clip(gd, -clip_value, clip_value)
+                gd = self.communicator.all_reduce_half(gd) / self.world_size
+                g.data = gd.astype(p.dtype)
+                self.opt.apply(p, g)
+                n += 1
         self.opt.step()
+        observe.record_opt_update(n, time.perf_counter() - t0, "half")
 
     # -- strategy 3: async partial-parameter update (ref opt.py:922) -------
     def step_tag(self) -> int:
@@ -521,12 +543,17 @@ class DistOpt(Optimizer):
         if sel is None:  # eager path: rotate on the host counter
             sel = self._partial_counter % k
             self._partial_counter += 1
-        for i, (p, g) in enumerate(autograd.backward(loss)):
-            if i % k == sel:
-                g.data = self.communicator.all_reduce(g.data) \
-                    / self.world_size
-            self.opt.apply(p, g)
+        t0 = time.perf_counter()
+        n = 0
+        with observe.span("opt.apply_updates"):
+            for i, (p, g) in enumerate(autograd.backward(loss)):
+                if i % k == sel:
+                    g.data = self.communicator.all_reduce(g.data) \
+                        / self.world_size
+                self.opt.apply(p, g)
+                n += 1
         self.opt.step()
+        observe.record_opt_update(n, time.perf_counter() - t0, "partial")
 
     # -- strategy 4: sparsified allreduce w/ error feedback (ref :994) -----
     # -- low-level reference surface (ref opt.py:738-817) ------------------
@@ -616,39 +643,46 @@ class DistOpt(Optimizer):
                 "error-feedback residuals on a model with sharded params "
                 "must be pre-created: construct "
                 "DistOpt(..., sparse_residuals=True)")
-        for p, g in autograd.backward(loss):
-            pid = id(p)
-            if getattr(p, "spec", None) is not None:
-                # sharded param: its gradient is already a mesh shard —
-                # sparsifying per-shard indices across the data axis is
-                # well-defined, but the payoff is small (in TP/PP models
-                # the sharded tensors dominate FLOPs, not DP wire bytes)
-                # and the residual would have to shard too; take the
-                # dense reduction and keep sparsification for the
-                # replicated params.
-                g.data = self.communicator.all_reduce(g.data) \
-                    / self.world_size
-                self.opt.apply(p, g)
-                continue
-            if corr and pid not in self._spars_residual:
-                pending = getattr(self, "_pending_residuals", None)
-                if pending:
-                    # restored from a checkpoint before the order existed
-                    self._spars_residual[pid] = pending.pop(0)
+        t0 = time.perf_counter()
+        n = 0
+        with observe.span("opt.apply_updates"):
+            for p, g in autograd.backward(loss):
+                n += 1
+                pid = id(p)
+                if getattr(p, "spec", None) is not None:
+                    # sharded param: its gradient is already a mesh shard
+                    # — sparsifying per-shard indices across the data
+                    # axis is well-defined, but the payoff is small (in
+                    # TP/PP models the sharded tensors dominate FLOPs,
+                    # not DP wire bytes) and the residual would have to
+                    # shard too; take the dense reduction and keep
+                    # sparsification for the replicated params.
+                    g.data = self.communicator.all_reduce(g.data) \
+                        / self.world_size
+                    self.opt.apply(p, g)
+                    continue
+                if corr and pid not in self._spars_residual:
+                    pending = getattr(self, "_pending_residuals", None)
+                    if pending:
+                        # restored from a checkpoint before the order
+                        # existed
+                        self._spars_residual[pid] = pending.pop(0)
+                    else:
+                        self._spars_residual[pid] = jnp.zeros(
+                            p.shape, dtype=p.dtype)
+                    self._spars_order.append(pid)
+                acc = self._spars_residual[pid] if corr else 0.0
+                x = g.data + acc
+                if topK:
+                    out, residual = \
+                        self.communicator.sparse_all_reduce_topk(x, spars)
                 else:
-                    self._spars_residual[pid] = jnp.zeros(p.shape,
-                                                          dtype=p.dtype)
-                self._spars_order.append(pid)
-            acc = self._spars_residual[pid] if corr else 0.0
-            x = g.data + acc
-            if topK:
-                out, residual = self.communicator.sparse_all_reduce_topk(
-                    x, spars)
-            else:
-                out, residual = self.communicator.sparse_all_reduce_threshold(
-                    x, spars)
-            if corr:
-                self._spars_residual[pid] = residual
-            g.data = out / self.world_size
-            self.opt.apply(p, g)
+                    out, residual = \
+                        self.communicator.sparse_all_reduce_threshold(
+                            x, spars)
+                if corr:
+                    self._spars_residual[pid] = residual
+                g.data = out / self.world_size
+                self.opt.apply(p, g)
         self.opt.step()
+        observe.record_opt_update(n, time.perf_counter() - t0, "sparse")

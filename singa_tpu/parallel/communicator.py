@@ -16,11 +16,26 @@ lets the reference's `test_dist.py` pattern pass without a cluster.
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import observe
 from .mesh import data_parallel_mesh
+
+
+def _payload_bytes(x) -> int:
+    """Static payload size of a (possibly traced) collective operand —
+    shapes are static under jit, so this is exact at trace time."""
+    try:
+        size = 1
+        for d in x.shape:
+            size *= int(d)
+        return size * np.dtype(x.dtype).itemsize
+    except Exception:
+        return 0
 
 
 class Communicator:
@@ -59,27 +74,44 @@ class Communicator:
     def all_reduce(self, x):
         """Sum over the axis (reference `synch`). Fusion of small tensors is
         XLA's all-reduce combiner; no manual buffer packing needed."""
+        observe.record_comm("all_reduce", _payload_bytes(x),
+                            self.world_size)
         if self.world_size == 1:
             return x
-        return lax.psum(x, self.axis)
+        with jax.named_scope("singa_comm_all_reduce"):
+            return lax.psum(x, self.axis)
 
     # -- synchHalf (communicator.cc:330-467) -------------------------------
     def all_reduce_half(self, x):
         """Halved-width allreduce: bf16 over ICI (fp16 in the reference)."""
+        try:  # wire payload is the bf16 cast: 2 bytes/element
+            n_el = 1
+            for d in x.shape:
+                n_el *= int(d)
+        except Exception:
+            n_el = 0
+        observe.record_comm("all_reduce_half", 2 * n_el, self.world_size)
         if self.world_size == 1:
             return x
-        return lax.psum(x.astype(jnp.bfloat16), self.axis).astype(x.dtype)
+        with jax.named_scope("singa_comm_all_reduce_half"):
+            return lax.psum(x.astype(jnp.bfloat16), self.axis) \
+                .astype(x.dtype)
 
     def all_gather(self, x, tiled=True):
+        observe.record_comm("all_gather", _payload_bytes(x),
+                            self.world_size)
         if self.world_size == 1:
             return x
-        return lax.all_gather(x, self.axis, axis=0, tiled=tiled)
+        with jax.named_scope("singa_comm_all_gather"):
+            return lax.all_gather(x, self.axis, axis=0, tiled=tiled)
 
     def broadcast(self, x, root=0):
         """Tree broadcast via ppermute (binomial doubling): ceil(log2 n)
         rounds, total wire bytes (n-1)·|x| — vs the masked-psum fallback
         whose allreduce moves ~2(n-1)·|x| regardless of the zeros. Only
         root's value is consumed; every other device's x is ignored."""
+        observe.record_comm("broadcast", _payload_bytes(x),
+                            self.world_size)
         if self.world_size == 1:
             return x
         assert not isinstance(self.axis, tuple), \
@@ -88,20 +120,25 @@ class Communicator:
         rel = (self.rank() - root) % n        # root-relative index
         val = x
         k = 1
-        while k < n:
-            # relative devices [0, k) send to [k, 2k)
-            pairs = [((i + root) % n, (i + k + root) % n)
-                     for i in range(min(k, n - k))]
-            recv = lax.ppermute(val, self.axis, pairs)
-            adopt = (rel >= k) & (rel < 2 * k)
-            val = jnp.where(adopt, recv, val)
-            k *= 2
+        with jax.named_scope("singa_comm_broadcast"):
+            while k < n:
+                # relative devices [0, k) send to [k, 2k)
+                pairs = [((i + root) % n, (i + k + root) % n)
+                         for i in range(min(k, n - k))]
+                recv = lax.ppermute(val, self.axis, pairs)
+                adopt = (rel >= k) & (rel < 2 * k)
+                val = jnp.where(adopt, recv, val)
+                k *= 2
         return val
 
     def reduce_scatter(self, x):
+        observe.record_comm("reduce_scatter", _payload_bytes(x),
+                            self.world_size)
         if self.world_size == 1:
             return x
-        return lax.psum_scatter(x, self.axis, scatter_dimension=0, tiled=True)
+        with jax.named_scope("singa_comm_reduce_scatter"):
+            return lax.psum_scatter(x, self.axis, scatter_dimension=0,
+                                    tiled=True)
 
     def wait(self):
         """Stream fence (communicator.cc:169-186): nothing to do — XLA's
@@ -120,14 +157,19 @@ class Communicator:
         flat = x.ravel()
         n = flat.size
         k = max(1, int(n * float(frac)))
+        # wire payload per rank: k int32 indices + k values (vs n dense)
+        observe.record_comm(
+            "sparse_all_reduce_topk",
+            k * (4 + np.dtype(x.dtype).itemsize), self.world_size)
         _, idx = lax.top_k(jnp.abs(flat), k)
         vals = jnp.take(flat, idx)
         residual = flat.at[idx].set(0.0).reshape(x.shape)
         if self.world_size == 1:
             out = jnp.zeros_like(flat).at[idx].add(vals)
             return out.reshape(x.shape), residual
-        gidx = lax.all_gather(idx, self.axis)    # (world, k)
-        gvals = lax.all_gather(vals, self.axis)  # (world, k)
+        with jax.named_scope("singa_comm_sparse_all_reduce_topk"):
+            gidx = lax.all_gather(idx, self.axis)    # (world, k)
+            gvals = lax.all_gather(vals, self.axis)  # (world, k)
         out = jnp.zeros_like(flat).at[gidx.ravel()].add(gvals.ravel())
         return out.reshape(x.shape), residual
 
@@ -150,6 +192,9 @@ class Communicator:
         flat = x.ravel()
         n = flat.size
         cap = max(1, min(n, int(n * float(capacity_frac))))
+        observe.record_comm(
+            "sparse_all_reduce_threshold",
+            cap * (4 + np.dtype(x.dtype).itemsize), self.world_size)
         absx = jnp.abs(flat)
         score = jnp.where(absx >= threshold, absx, -jnp.inf)
         _, idx = lax.top_k(score, cap)
@@ -161,7 +206,8 @@ class Communicator:
         if self.world_size == 1:
             return sent.reshape(x.shape), residual
         # wire payload: 2 * cap elements per rank (idx + val), NOT n
-        gidx = lax.all_gather(idx_safe, self.axis)   # (world, cap)
-        gvals = lax.all_gather(vals, self.axis)      # (world, cap)
+        with jax.named_scope("singa_comm_sparse_all_reduce_threshold"):
+            gidx = lax.all_gather(idx_safe, self.axis)   # (world, cap)
+            gvals = lax.all_gather(vals, self.axis)      # (world, cap)
         out = jnp.zeros_like(flat).at[gidx.ravel()].add(gvals.ravel())
         return out.reshape(x.shape), residual

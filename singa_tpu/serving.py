@@ -512,10 +512,27 @@ def decode_params(m):
 
 def build_decode(m, B, S0, max_new, temperature, top_k,
                  dtype=None, moe_capacity_factor=None, kv_dtype=None):
-    """Jitted greedy/sampled decode fn: (params, prompt, key) -> ids."""
+    """Greedy/sampled decode fn: (params, prompt, key) -> ids.
+
+    Two jitted stages instead of one fused program: `prefill` (causal
+    pass + first sampled token) and the `lax.scan` decode loop. The seam
+    is where serving telemetry lives — time-to-first-token is the fenced
+    prefill stage, tokens/sec the whole call (observe.record_decode) —
+    and it is also where a real server would emit the first token. The
+    KV caches stay on device between the stages (no host copy), at the
+    cost of one cache-sized device copy per call: the scan carry must
+    init from immutable input buffers (donation cannot remove it — XLA
+    donation is input->output aliasing and the stage outputs only the
+    tiny token array). Amortized over max_new tokens; the math is
+    op-for-op identical to the previously fused program.
+    """
+    import time as _time
+
     import jax
     import jax.numpy as jnp
     from jax import lax
+
+    from . import observe
 
     core = _decode_core(m, S0, max_new, moe_capacity_factor,
                         kv8=(kv_dtype == "int8"))
@@ -530,12 +547,14 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
             logits = jnp.where(logits < kth, -jnp.inf, logits)
         return jax.random.categorical(key, logits).astype(jnp.int32)
 
-    def decode(p, prompt, key):
+    def prefill_stage(p, prompt, key):
         # p arrives pre-cast/quantized (decode_state memo)
         logits0, caches = core.prefill(p, prompt, B)
         key, sub = jax.random.split(key)
         tok0 = sample(logits0, sub)                   # (B,)
+        return tok0, caches, key
 
+    def scan_stage(p, tok0, caches, key):
         # ---- decode: one token per scan step, O(T) attention ----
         def step(carry, i):
             tok, caches, key = carry
@@ -544,15 +563,42 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
             nxt = sample(logits, sub)
             return (nxt, caches, key), nxt
 
+        (_, _, _), toks = lax.scan(
+            step, (tok0, caches, key), jnp.arange(max_new - 1))
+        return jnp.concatenate([tok0[:, None], toks.T], axis=1)
+
+    prefill_jit = jax.jit(prefill_stage)
+    scan_jit = jax.jit(scan_stage)
+
+    def decode(p, prompt, key):
+        # the sync fences exist only to take honest TTFT/latency samples;
+        # with observability disabled the stages dispatch fully async
+        # (observe.py's "record_* are no-ops when disabled" contract)
+        obs = observe.is_enabled()
+        t0 = _time.perf_counter()
+        ttft = None
+        with observe.span("serving.prefill", batch=B, prompt_tokens=S0):
+            tok0, caches, key = prefill_jit(p, prompt, key)
+            if obs:
+                jax.block_until_ready(tok0)
+                ttft = _time.perf_counter() - t0
         if max_new > 1:
-            (_, _, _), toks = lax.scan(
-                step, (tok0, caches, key), jnp.arange(max_new - 1))
-            toks = jnp.concatenate([tok0[:, None], toks.T], axis=1)
+            with observe.span("serving.decode_scan", batch=B,
+                              new_tokens=max_new):
+                toks = scan_jit(p, tok0, caches, key)
         else:
             toks = tok0[:, None]
-        return jnp.concatenate([prompt, toks], axis=1)
+        ids = jnp.concatenate([prompt if isinstance(prompt, jax.Array)
+                               else jnp.asarray(prompt), toks], axis=1)
+        if obs:
+            jax.block_until_ready(ids)
+            observe.record_decode(
+                "greedy" if temperature == 0.0 else "sampled",
+                _time.perf_counter() - t0, new_tokens=B * max_new,
+                batch=B, ttft=ttft, prompt_tokens=B * S0)
+        return ids
 
-    return jax.jit(decode)
+    return decode
 
 
 def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
@@ -673,7 +719,25 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
             all_raw, best[:, None], axis=1)[:, 0]
         return jnp.concatenate([prompt, out], axis=1), best_score
 
-    return jax.jit(decode)
+    jitted = jax.jit(decode)
+
+    def run(p, prompt):
+        import time as _time
+
+        from . import observe
+        if not observe.is_enabled():
+            return jitted(p, prompt)  # no fence, no record: pure dispatch
+        t0 = _time.perf_counter()
+        with observe.span("serving.beam_decode", batch=B, beams=K):
+            out = jitted(p, prompt)
+            jax.block_until_ready(out)
+        # one fused program: no prefill seam, so no TTFT sample here
+        observe.record_decode("beam", _time.perf_counter() - t0,
+                              new_tokens=B * max_new, batch=B,
+                              prompt_tokens=B * S0)
+        return out
+
+    return run
 
 
 __all__ = ["build_decode", "build_beam_decode", "decode_state",

@@ -40,10 +40,18 @@ from collections import defaultdict
 
 # ---- protobuf wire reader (subset) ----------------------------------------
 
+class _Truncated(Exception):
+    """Varint/field ran past the end of the buffer (a torn/partial
+    .xplane.pb, e.g. the profiler died mid-write)."""
+
+
 def _read_varint(buf: bytes, pos: int):
     result = 0
     shift = 0
+    n = len(buf)
     while True:
+        if pos >= n:
+            raise _Truncated(pos)
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift
@@ -53,27 +61,43 @@ def _read_varint(buf: bytes, pos: int):
 
 
 def _fields(buf: bytes):
-    """Yield (field_number, wire_type, value) for one message body."""
+    """Yield (field_number, wire_type, value) for one message body.
+
+    Truncated or malformed tails (partial varint, length running past the
+    buffer, unknown wire type) END the iteration instead of raising: a
+    torn profile yields the events written so far, and a zero-length file
+    yields nothing — op_table then returns an empty table rather than
+    blowing up the caller's post-run reporting.
+    """
     pos = 0
     n = len(buf)
-    while pos < n:
-        key, pos = _read_varint(buf, pos)
-        field, wire = key >> 3, key & 7
-        if wire == 0:          # varint
-            val, pos = _read_varint(buf, pos)
-        elif wire == 1:        # 64-bit
-            val = buf[pos:pos + 8]
-            pos += 8
-        elif wire == 2:        # length-delimited
-            ln, pos = _read_varint(buf, pos)
-            val = buf[pos:pos + ln]
-            pos += ln
-        elif wire == 5:        # 32-bit
-            val = buf[pos:pos + 4]
-            pos += 4
-        else:
-            raise ValueError(f"unsupported wire type {wire}")
-        yield field, wire, val
+    try:
+        while pos < n:
+            key, pos = _read_varint(buf, pos)
+            field, wire = key >> 3, key & 7
+            if wire == 0:          # varint
+                val, pos = _read_varint(buf, pos)
+            elif wire == 1:        # 64-bit
+                if pos + 8 > n:
+                    return
+                val = buf[pos:pos + 8]
+                pos += 8
+            elif wire == 2:        # length-delimited
+                ln, pos = _read_varint(buf, pos)
+                if ln > n - pos:
+                    return         # length past the end: torn write
+                val = buf[pos:pos + ln]
+                pos += ln
+            elif wire == 5:        # 32-bit
+                if pos + 4 > n:
+                    return
+                val = buf[pos:pos + 4]
+                pos += 4
+            else:
+                return             # unknown wire type: not our schema
+            yield field, wire, val
+    except _Truncated:
+        return
 
 
 def _zigzag(v: int) -> int:
@@ -207,6 +231,7 @@ def parse_xspace(path: str):
 # ---- aggregation -----------------------------------------------------------
 
 _CATEGORY_RULES = [
+    ("span", re.compile(r"^singa\.span/")),
     ("conv", re.compile(r"^(%?)conv(?!ert)", re.I)),
     ("matmul", re.compile(r"^(%?)(dot|gemm|matmul)", re.I)),
     ("fusion", re.compile(r"^(%?)fusion", re.I)),
@@ -245,16 +270,38 @@ def op_table(logdir: str, device_only: bool = True,
     DMA/copy events that OVERLAP compute (their durations double-count
     wall-clock — excluded unless `include_async`), and 'Steps'/'XLA
     Modules' are per-step envelopes (always excluded).
+
+    Spans emitted by `observe.span()` (TraceAnnotation names prefixed
+    `singa.span/`) are surfaced as rows with category "span". They live
+    on the HOST planes (python-thread lines), so they are collected from
+    ALL planes before the device filter. Span wall time is a host-side
+    ENVELOPE around device work, so it is kept in a separate pct pool
+    and the span rows are appended AFTER the device rows: the device
+    ops' pct still sums to ~100 of device time and their ordering is
+    untouched, while each span's pct is relative to the span total.
     """
-    planes = [p for path in find_xplane_files(logdir)
-              for p in parse_xspace(path)]
-    dev_planes = [p for p in planes if "/device:" in p.name.lower()]
+    all_planes = [p for path in find_xplane_files(logdir)
+                  for p in parse_xspace(path)]
+    dev_planes = [p for p in all_planes if "/device:" in p.name.lower()]
+    planes = all_planes
     if device_only and dev_planes:
         planes = dev_planes  # real accelerator planes (TPU/GPU)
     # else: CPU-only traces put XLA op events on the /host:CPU plane —
     # fall back to every plane that has op lines so tests work on CPU.
     total_ps = defaultdict(int)
     count = defaultdict(int)
+    span_ps = defaultdict(int)
+    span_count = defaultdict(int)
+    for plane in all_planes:
+        # observe.span annotations: any plane, any line (host threads);
+        # strip the "#attr=val#" metadata suffix TraceMe appends
+        for _line_name, events in plane.lines:
+            for meta_id, dur_ps, _stats in events:
+                op = plane.event_meta.get(meta_id, "")
+                if op.startswith("singa.span/"):
+                    op = op.split("#", 1)[0]
+                    span_ps[op] += dur_ps
+                    span_count[op] += 1
     for plane in planes:
         for line_name, events in plane.lines:
             nm = line_name.lower()
@@ -265,21 +312,42 @@ def op_table(logdir: str, device_only: bool = True,
                 continue  # overlapped DMA: double-counts wall-clock
             for meta_id, dur_ps, _stats in events:
                 op = plane.event_meta.get(meta_id, f"op#{meta_id}")
+                if op.startswith("singa.span/"):
+                    continue  # span envelopes have their own pool above
                 total_ps[op] += dur_ps
                 count[op] += 1
-    grand = sum(total_ps.values()) or 1
-    rows = [
-        {
-            "op": op,
-            "category": _category(op),
-            "total_ms": ps / 1e9,
-            "count": count[op],
-            "avg_us": ps / 1e6 / max(count[op], 1),
-            "pct": 100.0 * ps / grand,
-        }
-        for op, ps in total_ps.items()
-    ]
-    rows.sort(key=lambda r: -r["total_ms"])
+
+    def make_rows(ps_map, n_map):
+        grand = sum(ps_map.values()) or 1
+        rows = [
+            {
+                "op": op,
+                "category": _category(op),
+                "total_ms": ps / 1e9,
+                "count": n_map[op],
+                "avg_us": ps / 1e6 / max(n_map[op], 1),
+                "pct": 100.0 * ps / grand,
+            }
+            for op, ps in ps_map.items()
+        ]
+        rows.sort(key=lambda r: -r["total_ms"])
+        return rows
+
+    return make_rows(total_ps, count) + make_rows(span_ps, span_count)
+
+
+def span_table(logdir: str):
+    """Just the observe.span() rows of op_table (category "span"),
+    with the `singa.span/` prefix stripped — the bridge between the
+    live `singa_span_seconds` histogram and the post-hoc trace: both
+    key on the same slash-joined span path."""
+    rows = [dict(r) for r in op_table(logdir, device_only=False)
+            if r["category"] == "span"]
+    for r in rows:
+        r["op"] = r["op"][len("singa.span/"):]
+    grand = sum(r["total_ms"] for r in rows) or 1.0
+    for r in rows:
+        r["pct"] = 100.0 * r["total_ms"] / grand
     return rows
 
 
@@ -335,9 +403,15 @@ def format_hlo_categories(rows) -> str:
 
 
 def category_table(rows):
-    """Collapse an op_table into per-category totals."""
+    """Collapse an op_table into per-category totals. Span rows are
+    dropped: a span is a host-side envelope AROUND the device ops
+    already counted in the other categories — including it would
+    double-count that time and deflate every real category's pct
+    (span wall times live in span_table / singa_span_seconds)."""
     agg = defaultdict(lambda: [0.0, 0])
     for r in rows:
+        if r["category"] == "span":
+            continue
         agg[r["category"]][0] += r["total_ms"]
         agg[r["category"]][1] += r["count"]
     grand = sum(v[0] for v in agg.values()) or 1

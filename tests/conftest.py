@@ -15,7 +15,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # pre-0.5 jax has no jax_num_cpu_devices: fall back to the XLA flag.
+    # Only set in this branch (modern jax may reject the combination);
+    # the env var is read at backend initialization, which hasn't
+    # happened yet, so it still lands in time.
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+# version shims (jax.shard_map on pre-0.6 jax) — tests call jax.shard_map
+# directly, so install before any test module imports
+import singa_tpu._compat  # noqa: E402,F401
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

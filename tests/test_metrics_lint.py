@@ -1,0 +1,53 @@
+"""Tier-1 wrapper for tools/check_metrics_names.py: metric-name drift is
+caught in the normal test pass, no separate CI job needed."""
+
+import os
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import check_metrics_names  # noqa: E402
+
+
+def test_package_metric_names_clean():
+    problems = check_metrics_names.check()
+    assert not problems, "\n".join(problems)
+
+
+def test_lint_catches_bad_name(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from singa_tpu import observe\n"
+        "observe.counter('not_singa_name').inc()\n"
+        "observe.gauge('singa_dup')\n"
+        "observe.histogram('singa_dup')\n")
+    problems = check_metrics_names.check([str(tmp_path)])
+    assert len(problems) == 2
+    assert any("not_singa_name" in p for p in problems)
+    assert any("singa_dup" in p and "histogram" in p for p in problems)
+
+
+def test_lint_cli_exit_codes(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text("import singa_tpu.observe as o\n"
+                  "o.counter('singa_fine_total')\n")
+    assert check_metrics_names.main([str(ok)]) == 0
+    bad = tmp_path / "bad.py"
+    bad.write_text("import singa_tpu.observe as o\n"
+                   "o.counter('Nope')\n")
+    assert check_metrics_names.main([str(bad)]) == 1
+
+
+def test_runtime_registry_enforces_same_contract():
+    """The registry raises at runtime on exactly what the lint flags
+    statically (dynamic names the AST walk cannot see)."""
+    from singa_tpu.observe import MetricsRegistry
+    r = MetricsRegistry()
+    with pytest.raises(ValueError):
+        r.counter("Not_Singa")
+    r.counter("singa_ok_total")
+    with pytest.raises(ValueError):
+        r.gauge("singa_ok_total")

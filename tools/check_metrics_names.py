@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Lint: every metric registered anywhere in the package obeys the naming
+contract.
+
+Walks singa_tpu/ (plus the top-level bench drivers) with `ast`, collects
+every call of the form `<registry|observe>.counter("name", ...)` /
+`.gauge(...)` / `.histogram(...)` — and bare `counter("name")` etc. from
+`from ... import counter` style — whose first argument is a string
+literal, then fails if
+
+  1. a name does not match ^singa_[a-z0-9_]+$, or
+  2. the same name is registered under two different metric types
+     (the runtime registry raises on this too; the lint catches it
+     before any code runs).
+
+Dynamic names (f-strings, e.g. bench.py's singa_bench_* gauges) cannot be
+checked statically; the runtime ValueError in observe._Metric covers
+those. Run as a script (exit 1 on violations) or via
+tests/test_metrics_lint.py in the tier-1 pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+NAME_RE = re.compile(r"^singa_[a-z0-9_]+$")
+METRIC_FUNCS = {"counter", "gauge", "histogram"}
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(_HERE)
+DEFAULT_PATHS = [
+    os.path.join(ROOT, "singa_tpu"),
+    os.path.join(ROOT, "bench.py"),
+    os.path.join(ROOT, "bench_decode.py"),
+    os.path.join(ROOT, "bench_ops.py"),
+]
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+
+
+def registrations_in(path):
+    """Yield (name, metric_type, lineno) for literal metric registrations
+    in one file. Parse errors are a lint failure upstream (tier-1 would
+    catch them anyway), so let them raise."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            fname = func.attr
+        elif isinstance(func, ast.Name):
+            fname = func.id
+        else:
+            continue
+        if fname not in METRIC_FUNCS:
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            yield first.value, fname, node.lineno
+
+
+def check(paths=None):
+    """Return a list of violation strings (empty = clean)."""
+    problems = []
+    seen = {}  # name -> (type, file, line)
+    for path in iter_py_files(paths or DEFAULT_PATHS):
+        rel = os.path.relpath(path, ROOT)
+        for name, mtype, line in registrations_in(path):
+            if not NAME_RE.match(name):
+                problems.append(
+                    f"{rel}:{line}: metric name {name!r} does not match "
+                    f"{NAME_RE.pattern}")
+                continue
+            prev = seen.get(name)
+            if prev is None:
+                seen[name] = (mtype, rel, line)
+            elif prev[0] != mtype:
+                problems.append(
+                    f"{rel}:{line}: metric {name!r} registered as {mtype} "
+                    f"but already a {prev[0]} at {prev[1]}:{prev[2]}")
+    return problems
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    problems = check(argv or None)
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(f"{len(problems)} metric-name violation(s)", file=sys.stderr)
+        return 1
+    print("metric names OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
