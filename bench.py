@@ -96,6 +96,13 @@ def main():
                         "and print a per-op device-time table (singa_tpu."
                         "xprof) to stderr — the TPU analog of the "
                         "reference's scheduler per-op profile")
+    p.add_argument("--health", action="store_true",
+                   help="after the main run, re-time the loop with the "
+                        "training-health layer (singa_tpu.health) attached "
+                        "and record the in-graph stats' per-step overhead "
+                        "vs the no-health run into the JSON "
+                        "(health_ms_per_step / health_overhead_pct), so "
+                        "regressions in the stats cost show in BENCH_*.json")
     p.add_argument("--metrics-out", default=None, metavar="FILE",
                    help="write the observe registry as Prometheus text "
                         "after the run (step histograms, compile counts, "
@@ -134,9 +141,12 @@ def main():
     if args.model == "gpt":
         seq = args.size if args.size > 32 else 512
         vocab = 8192
-        m = models.create_model("gpt", vocab_size=vocab, max_seq=seq,
-                                dim=args.gpt_dim, num_heads=args.gpt_heads,
-                                num_layers=args.gpt_layers)
+        def model_factory():
+            return models.create_model(
+                "gpt", vocab_size=vocab, max_seq=seq, dim=args.gpt_dim,
+                num_heads=args.gpt_heads, num_layers=args.gpt_layers)
+
+        m = model_factory()
         ids = rng.randint(0, vocab, (args.batch, seq)).astype(np.int32)
         tgt = np.roll(ids, -1, axis=1).astype(np.int32)
         tx = tensor.from_numpy(ids, device=dev)
@@ -147,7 +157,10 @@ def main():
         x_np = rng.standard_normal(
             (args.batch, 3, args.size, args.size)).astype(np.float32)
         y_np = rng.randint(0, 10, args.batch).astype(np.int32)
-        m = models.create_model(args.model, num_channels=3)
+        def model_factory():
+            return models.create_model(args.model, num_channels=3)
+
+        m = model_factory()
         tx = tensor.Tensor(data=x_np, device=dev, dtype=args.dtype)
         ty = tensor.from_numpy(y_np, device=dev)
         items_per_step = args.batch
@@ -202,6 +215,57 @@ def main():
     step_ms_arr = np.asarray(step_ms)
     med_ms = float(np.median(step_ms_arr))
     throughput_stepwise = items_per_step / (med_ms / 1e3)
+
+    # ---- health-stat overhead (--health) ---------------------------------
+    # A second, identically-shaped model with the in-graph numerics
+    # telemetry compiled into its step (warn policy, so nothing skips).
+    # The two executables are sampled as adjacent-in-time PAIRS with the
+    # in-pair order alternating, and the overhead is the median of the
+    # paired deltas over the median base — pairing cancels the slow load
+    # drift of a shared host that makes block-wise or single-loop
+    # comparisons swing by >10% run to run. The delta is the cost of the
+    # fused grad-norm/isfinite/update-norm reductions plus the per-step
+    # stats fetch.
+    health_ms_per_step = None
+    health_overhead_pct = None
+    if args.health:
+        import tempfile
+
+        from singa_tpu import health as health_mod
+        mh = model_factory()
+        mh.set_optimizer(opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5))
+        # spike watchdog off (inf threshold): early-training loss decline
+        # would otherwise trip a flight-recorder dump INSIDE a timed
+        # sample (file I/O in the measurement); bundles go to a temp dir,
+        # never the caller's CWD
+        mh.compile([tx], is_train=True, use_graph=True,
+                   amp="bfloat16" if args.amp else None,
+                   health=health_mod.HealthMonitor(
+                       policy="warn", spike_factor=float("inf"),
+                       out_dir=tempfile.mkdtemp(prefix="bench_health_")))
+
+        def fenced_ms(mm):
+            t1 = time.perf_counter()
+            _o, ls = mm(tx, ty)
+            np.asarray(jax.device_get(ls.data))
+            return (time.perf_counter() - t1) * 1e3
+
+        for _ in range(max(args.warmup, 1)):
+            mh(tx, ty)
+        fenced_ms(mh)
+        fenced_ms(m)  # both arms warm
+        bases, healths = [], []
+        for i in range(3 * args.step_samples):
+            if i % 2 == 0:
+                bases.append(fenced_ms(m))
+                healths.append(fenced_ms(mh))
+            else:
+                healths.append(fenced_ms(mh))
+                bases.append(fenced_ms(m))
+        deltas = np.asarray(healths) - np.asarray(bases)
+        base_ms = float(np.median(np.asarray(bases)))
+        health_ms_per_step = base_ms + float(np.median(deltas))
+        health_overhead_pct = 100.0 * float(np.median(deltas)) / base_ms
 
     # ---- self-validation against physics ---------------------------------
     ca = m.step_cost_analysis()
@@ -317,6 +381,10 @@ def main():
         "mfu_xla_counted": round(mfu_xla, 4)
         if (mfu_xla is not None and attn_flops) else None,
         "mfu_suspect": suspect,
+        "health_ms_per_step": round(health_ms_per_step, 3)
+        if health_ms_per_step is not None else None,
+        "health_overhead_pct": round(health_overhead_pct, 2)
+        if health_overhead_pct is not None else None,
         "compute_floor_ms": round(compute_floor_ms, 3)
         if compute_floor_ms else None,
         "hbm_floor_ms": round(hbm_floor_ms, 3) if hbm_floor_ms else None,

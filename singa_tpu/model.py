@@ -30,6 +30,7 @@ from jax import lax
 
 from . import _compat  # noqa: F401  (installs jax.shard_map on old jax)
 from . import autograd
+from . import health
 from . import observe
 from .layer import Layer, LayerMeta
 from .tensor import Tensor
@@ -84,6 +85,9 @@ class ModelMeta(LayerMeta):
                     "params are shape-inferred from the compile inputs "
                     "(ref model.py:156)")
             if not (self.graph_mode and self.training):
+                if getattr(self, "_health_monitor", None) is not None \
+                        and self.training:
+                    return self._eager_health_step(func, args, kwargs)
                 return func(self, *args, **kwargs)
             if self._compiled_step is None:
                 self._build_step(func, args, kwargs)
@@ -106,10 +110,21 @@ class Model(Layer, metaclass=ModelMeta):
         self._device = None
         self._compiled_step = None
         self._step_stats = {"compile_s": 0.0, "steps": 0}
+        self._health_monitor = None
+        self._health_steps = 0
 
     # ---- configuration (ref model.py:185-243) ----------------------------
     def set_optimizer(self, opt):
         self._optimizer = opt
+
+    def set_health_monitor(self, monitor):
+        """Attach (or detach, with None) a health.HealthMonitor. The
+        monitor's policy is STATIC in the compiled step (skip_step bakes
+        an in-graph conditional commit into the executable), so any
+        already-compiled step is dropped and rebuilt on the next call."""
+        self._health_monitor = monitor
+        self._compiled_step = None
+        return monitor
 
     @property
     def optimizer(self):
@@ -130,7 +145,7 @@ class Model(Layer, metaclass=ModelMeta):
     def compile(self, inputs, is_train=True, use_graph=False,
                 sequential=False, pipeline_axis=None, n_micro=1,
                 pipeline_schedule="gpipe", amp=None,
-                eval_buckets="auto"):
+                eval_buckets="auto", health=None):
         """Dummy forward with concrete inputs to init all params
         (ref model.py:156-184).
 
@@ -166,6 +181,23 @@ class Model(Layer, metaclass=ModelMeta):
             amp = "bfloat16"
         self.amp = amp
         self.eval_buckets = eval_buckets
+        if health is not None:
+            # a health.HealthMonitor instance; True means "default
+            # monitor, warn policy", False detaches. Routed through
+            # set_health_monitor so re-compiling an already-trained
+            # model drops the stale executables (the policy is baked
+            # into the compiled step).
+            from . import health as _health
+            if health is False:
+                self.set_health_monitor(None)
+            elif health is True:
+                self.set_health_monitor(_health.HealthMonitor())
+            elif isinstance(health, _health.HealthMonitor):
+                self.set_health_monitor(health)
+            else:
+                raise TypeError(
+                    f"health= expects a health.HealthMonitor, True, "
+                    f"False, or None; got {type(health).__name__}")
         prev = autograd.training
         autograd.training = False  # init pass builds no tape
         try:
@@ -245,6 +277,14 @@ class Model(Layer, metaclass=ModelMeta):
         aux_idx = [i for i, t in enumerate(state_tensors)
                    if id(t) not in param_ids]
         dev = self._device
+        monitor = self._health_monitor
+        health_on = monitor is not None
+        group_of = self._health_groups() if health_on else None
+        # skip_step bakes an in-graph conditional commit into the step:
+        # params/opt state select their PRE-step values when the agreed
+        # nonfinite flag fires (donation is input->output aliasing, so
+        # the old buffers are legal select operands)
+        skip_in_graph = health_on and monitor.policy == "skip_step"
 
         tensor_pos = [i for i, a in enumerate(example_args)
                       if isinstance(a, Tensor)]
@@ -288,9 +328,15 @@ class Model(Layer, metaclass=ModelMeta):
                 autograd.training = True
                 prev_cd = autograd.compute_dtype
                 autograd.compute_dtype = getattr(self, "amp", None)
+                col = None
+                if health_on:
+                    col = health.StepStatsCollector(group_of)
+                    health._set_collector(col)
                 try:
                     out = func(self, *call_args, **kwargs)
                 finally:
+                    if health_on:
+                        health._set_collector(None)
                     autograd.compute_dtype = prev_cd
                     if opt is not None:
                         # trace-time tag must not leak into later EAGER
@@ -315,9 +361,22 @@ class Model(Layer, metaclass=ModelMeta):
                     for i in aux_idx:
                         new_states[i] = lax.pmean(new_states[i], opt.axis)
                 new_opt = opt.state_arrays() if opt is not None else []
+                hstats = {}
+                if health_on:
+                    hstats = col.finalize(
+                        comm=opt.communicator if dist else None)
+                    if skip_in_graph:
+                        # conditional commit: the whole update — params,
+                        # aux states, opt slots, the step counter — rolls
+                        # back atomically on every shard (the flag is the
+                        # agreed cross-host verdict)
+                        new_states = health.apply_skip(
+                            hstats, state_arrs, new_states)
+                        new_opt = health.apply_skip(
+                            hstats, opt_arrs, new_opt)
                 new_rng = jax.random.split(rng, 1)[0] if dist \
                     else dev.rng_state
-                return new_states, new_opt, new_rng, outs
+                return new_states, new_opt, new_rng, outs, hstats
 
             if dist:
                 from jax.sharding import PartitionSpec as P
@@ -325,7 +384,7 @@ class Model(Layer, metaclass=ModelMeta):
                 wrapped = jax.shard_map(
                     step, mesh=mesh,
                     in_specs=(state_in, opt_in, P(), P(opt.axis)),
-                    out_specs=(state_in, opt_in, P(), P()),
+                    out_specs=(state_in, opt_in, P(), P(), P()),
                     check_vma=False)
             else:
                 wrapped = step
@@ -490,7 +549,7 @@ class Model(Layer, metaclass=ModelMeta):
                 dev.cost_analysis = self.step_cost_analysis() \
                     if self._step_stats["steps"] > 0 else {}
             t0 = time.perf_counter()
-        new_states, new_opt, new_rng, outs = fn(
+        new_states, new_opt, new_rng, outs, hstats = fn(
             state_arrs, opt_arrs, rng, input_arrs)
         if profiling:
             jax.block_until_ready(new_states)
@@ -514,9 +573,92 @@ class Model(Layer, metaclass=ModelMeta):
         if obs:
             observe.record_step(time.perf_counter() - t_obs,
                                 batch=bs, tag=tag, device=dev)
+        if self._health_monitor is not None:
+            # one small transfer: the stats pytree is a handful of
+            # scalars; fetching it is the step's only health-side sync
+            self._health_feed(hstats, self._last_input_arrs,
+                              in_graph_skip=True)
         tensors = [Tensor(data=a, device=dev, requires_grad=False)
                    for a in outs]
         return _rebuild_out(self._out_template_box["t"], tensors)
+
+    # ---- training health (singa_tpu.health) ------------------------------
+    def _health_groups(self):
+        """{id(param): layer group} — the first path component of the
+        param's get_params() name ("l1.W" -> "l1"), the granularity the
+        per-group norm/ratio stats aggregate at."""
+        return {id(t): name.split(self.sep, 1)[0]
+                for name, t in self.get_params().items()}
+
+    def _health_feed(self, hstats, input_arrs, in_graph_skip):
+        mon = self._health_monitor
+        self._health_steps += 1
+        host = jax.device_get(hstats) if hstats else {}
+        provider = None
+        if input_arrs is not None and mon.snapshot_batch:
+            provider = lambda: [np.asarray(jax.device_get(a))  # noqa: E731
+                                for a in input_arrs]
+        mon.on_step(host, step=self._health_steps,
+                    batch_provider=provider,
+                    amp=getattr(self, "amp", None) is not None,
+                    in_graph_skip=in_graph_skip)
+
+    def _eager_health_step(self, func, args, kwargs):
+        """Eager-mode health: the same collector, finalized eagerly.
+        skip_step's rollback is part of the compiled step, so eager
+        anomalies get warn/halt semantics only (in_graph_skip=False).
+        Single-process scope: finalize runs with no communicator —
+        eager mode cannot execute mesh collectives anyway (psum outside
+        a shard_mapped step has no bound axis), so eager + DistOpt at
+        world_size > 1 is out of scope here as it is for training."""
+        col = health.StepStatsCollector(self._health_groups())
+        health._set_collector(col)
+        try:
+            out = func(self, *args, **kwargs)
+        finally:
+            health._set_collector(None)
+        self._health_feed(col.finalize(),
+                          [a.data for a in args if isinstance(a, Tensor)],
+                          in_graph_skip=False)
+        return out
+
+    # ---- minimal training loop -------------------------------------------
+    def fit(self, data, epochs=1, verbose=0):
+        """Host-side training loop over `data`, an iterable of per-batch
+        argument tuples for `train_one_batch` (re-iterated each epoch, so
+        pass a list/dataset, not a one-shot generator). Returns the list
+        of per-epoch mean losses (by convention the second element of the
+        step's return, or the whole return when it is a single Tensor).
+
+        This is where the health layer meets the loop: every step feeds
+        the attached HealthMonitor (skip_step discards bad updates
+        in-graph without breaking the loop; halt raises HealthError out
+        of fit with the flight-recorder bundle already on disk)."""
+        history = []
+        for epoch in range(epochs):
+            losses = []
+            with observe.span("model.fit_epoch", epoch=epoch):
+                for batch in data:
+                    if not isinstance(batch, (tuple, list)):
+                        batch = (batch,)
+                    out = self(*batch)
+                    loss = out[1] if isinstance(out, (tuple, list)) \
+                        and len(out) > 1 else out
+                    if isinstance(loss, Tensor):
+                        # keep the device scalar; fetch once per epoch so
+                        # the loop stays async-dispatched
+                        losses.append(loss.data)
+            if not losses:
+                raise ValueError(
+                    f"fit epoch {epoch} saw no batches - `data` must be "
+                    "re-iterable across epochs (a list, not a generator)")
+            vals = [float(np.asarray(jax.device_get(a))) for a in losses]
+            mean = sum(vals) / len(vals)
+            history.append(mean)
+            if verbose:
+                print(f"epoch {epoch}: loss {mean:.6f} "
+                      f"({len(vals)} steps)")
+        return history
 
     def lower_step(self, tag=0):
         """Re-lower a compiled step variant for inspection (HLO text, cost

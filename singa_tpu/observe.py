@@ -58,6 +58,12 @@ def _fmt_labels(key) -> str:
 
 def _fmt_num(v: float) -> str:
     f = float(v)
+    if f != f:
+        return "NaN"     # canonical Prometheus spellings: a health gauge
+    if f == float("inf"):
+        return "+Inf"    # legitimately holds NaN/Inf on an anomaly step
+    if f == float("-inf"):
+        return "-Inf"
     if f == int(f) and abs(f) < 1e15:
         return str(int(f))
     return format(f, "g")

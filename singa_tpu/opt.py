@@ -24,8 +24,20 @@ import numpy as np
 import jax.numpy as jnp
 
 from . import autograd
+from . import health
 from . import observe
 from .tensor import Tensor
+
+
+def _health_start(loss):
+    """Active health collector for this step (None = health off). Feeds
+    the loss; the per-(grad, update) feeds sit in each strategy loop so
+    the stats see the POST-reduction gradient each strategy actually
+    applies — that's the effective update numerics the watchdog guards."""
+    col = health.collector()
+    if col is not None:
+        col.observe_loss(loss.data)
+    return col
 
 
 # ---- learning-rate schedulers (ref opt.py:28-68) -------------------------
@@ -152,10 +164,14 @@ class Optimizer:
         # fires once per compilation (param count + trace cost), not per
         # step — see observe.record_opt_update.
         t0 = time.perf_counter()
+        col = _health_start(loss)
         n = 0
         with observe.span("opt.apply_updates"):
             for p, g in autograd.backward(loss):
+                old = p.data if col is not None else None
                 self.apply(p, g)
+                if col is not None:
+                    col.observe(p, g.data, old, p.data)
                 n += 1
         self.step()
         observe.record_opt_update(n, time.perf_counter() - t0, "local")
@@ -483,12 +499,16 @@ class DistOpt(Optimizer):
     # -- strategy 1: plain synchronous allreduce (ref opt.py:826) ----------
     def backward_and_update(self, loss: Tensor):
         t0 = time.perf_counter()
+        col = _health_start(loss)
         n = 0
         with observe.span("opt.apply_updates"):
             for p, g in autograd.backward(loss):
                 g.data = self.communicator.all_reduce(g.data) \
                     / self.world_size
+                old = p.data if col is not None else None
                 self.opt.apply(p, g)
+                if col is not None:
+                    col.observe(p, g.data, old, p.data)
                 n += 1
         self.opt.step()
         observe.record_opt_update(n, time.perf_counter() - t0, "dense")
@@ -502,6 +522,7 @@ class DistOpt(Optimizer):
         """bf16 on TPU where the reference uses fp16 (ICI moves half the
         bytes; bf16 keeps fp32's exponent so no loss-scaling needed)."""
         t0 = time.perf_counter()
+        col = _health_start(loss)
         n = 0
         with observe.span("opt.apply_updates"):
             for p, g in autograd.backward(loss):
@@ -510,7 +531,10 @@ class DistOpt(Optimizer):
                     gd = jnp.clip(gd, -clip_value, clip_value)
                 gd = self.communicator.all_reduce_half(gd) / self.world_size
                 g.data = gd.astype(p.dtype)
+                old = p.data if col is not None else None
                 self.opt.apply(p, g)
+                if col is not None:
+                    col.observe(p, g.data, old, p.data)
                 n += 1
         self.opt.step()
         observe.record_opt_update(n, time.perf_counter() - t0, "half")
@@ -544,13 +568,17 @@ class DistOpt(Optimizer):
             sel = self._partial_counter % k
             self._partial_counter += 1
         t0 = time.perf_counter()
+        col = _health_start(loss)
         n = 0
         with observe.span("opt.apply_updates"):
             for i, (p, g) in enumerate(autograd.backward(loss)):
                 if i % k == sel:
                     g.data = self.communicator.all_reduce(g.data) \
                         / self.world_size
+                old = p.data if col is not None else None
                 self.opt.apply(p, g)
+                if col is not None:
+                    col.observe(p, g.data, old, p.data)
                 n += 1
         self.opt.step()
         observe.record_opt_update(n, time.perf_counter() - t0, "partial")
@@ -644,11 +672,13 @@ class DistOpt(Optimizer):
                 "must be pre-created: construct "
                 "DistOpt(..., sparse_residuals=True)")
         t0 = time.perf_counter()
+        col = _health_start(loss)
         n = 0
         with observe.span("opt.apply_updates"):
             for p, g in autograd.backward(loss):
                 n += 1
                 pid = id(p)
+                old = p.data if col is not None else None
                 if getattr(p, "spec", None) is not None:
                     # sharded param: its gradient is already a mesh shard
                     # — sparsifying per-shard indices across the data
@@ -660,6 +690,8 @@ class DistOpt(Optimizer):
                     g.data = self.communicator.all_reduce(g.data) \
                         / self.world_size
                     self.opt.apply(p, g)
+                    if col is not None:
+                        col.observe(p, g.data, old, p.data)
                     continue
                 if corr and pid not in self._spars_residual:
                     pending = getattr(self, "_pending_residuals", None)
@@ -684,5 +716,7 @@ class DistOpt(Optimizer):
                     self._spars_residual[pid] = residual
                 g.data = out / self.world_size
                 self.opt.apply(p, g)
+                if col is not None:
+                    col.observe(p, g.data, old, p.data)
         self.opt.step()
         observe.record_opt_update(n, time.perf_counter() - t0, "sparse")

@@ -140,6 +140,33 @@ class Communicator:
             return lax.psum_scatter(x, self.axis, scatter_dimension=0,
                                     tiled=True)
 
+    def all_reduce_max(self, x):
+        """Max over the axis. Used by the health layer for non-finite
+        COUNTS: post-reduction grads are fully replicated under the
+        dense/half strategies, so a psum would inflate the count
+        world_size-fold — pmax returns the true count there and the
+        worst shard's count for per-shard (partial/sparse) gradients,
+        agreed on every shard either way."""
+        observe.record_comm("all_reduce_max", _payload_bytes(x),
+                            self.world_size)
+        if self.world_size == 1:
+            return x
+        with jax.named_scope("singa_comm_all_reduce_max"):
+            return lax.pmax(x, self.axis)
+
+    def agree_any(self, flag):
+        """Cross-host anomaly agreement: boolean OR over the axis group,
+        via psum of the 0/1 predicate. Every shard returns the SAME
+        verdict, so a health policy (skip/halt, singa_tpu.health) fires on
+        all hosts in the same step — no shard ever commits an update the
+        others discarded. 4 bytes on the wire; identity at world_size 1."""
+        observe.record_comm("agree_any", 4, self.world_size)
+        f = jnp.asarray(flag).astype(jnp.int32)
+        if self.world_size == 1:
+            return f > 0
+        with jax.named_scope("singa_comm_agree_any"):
+            return lax.psum(f, self.axis) > 0
+
     def wait(self):
         """Stream fence (communicator.cc:169-186): nothing to do — XLA's
         dataflow ordering subsumes the reference's cross-stream events."""

@@ -552,20 +552,24 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
         logits0, caches = core.prefill(p, prompt, B)
         key, sub = jax.random.split(key)
         tok0 = sample(logits0, sub)                   # (B,)
-        return tok0, caches, key
+        # NaN-logit watch (singa_tpu.health): a poisoned checkpoint or a
+        # numerics bug shows up here first — count in-graph, one scalar
+        nf0 = jnp.sum((~jnp.isfinite(logits0)).astype(jnp.int32))
+        return tok0, caches, key, nf0
 
-    def scan_stage(p, tok0, caches, key):
+    def scan_stage(p, tok0, caches, key, nf0):
         # ---- decode: one token per scan step, O(T) attention ----
         def step(carry, i):
-            tok, caches, key = carry
+            tok, caches, key, nf = carry
             logits, caches = core.token_step(p, tok, caches, i, B)
+            nf = nf + jnp.sum((~jnp.isfinite(logits)).astype(jnp.int32))
             key, sub = jax.random.split(key)
             nxt = sample(logits, sub)
-            return (nxt, caches, key), nxt
+            return (nxt, caches, key, nf), nxt
 
-        (_, _, _), toks = lax.scan(
-            step, (tok0, caches, key), jnp.arange(max_new - 1))
-        return jnp.concatenate([tok0[:, None], toks.T], axis=1)
+        (_, _, _, nf), toks = lax.scan(
+            step, (tok0, caches, key, nf0), jnp.arange(max_new - 1))
+        return jnp.concatenate([tok0[:, None], toks.T], axis=1), nf
 
     prefill_jit = jax.jit(prefill_stage)
     scan_jit = jax.jit(scan_stage)
@@ -578,24 +582,26 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
         t0 = _time.perf_counter()
         ttft = None
         with observe.span("serving.prefill", batch=B, prompt_tokens=S0):
-            tok0, caches, key = prefill_jit(p, prompt, key)
+            tok0, caches, key, nf = prefill_jit(p, prompt, key)
             if obs:
                 jax.block_until_ready(tok0)
                 ttft = _time.perf_counter() - t0
         if max_new > 1:
             with observe.span("serving.decode_scan", batch=B,
                               new_tokens=max_new):
-                toks = scan_jit(p, tok0, caches, key)
+                toks, nf = scan_jit(p, tok0, caches, key, nf)
         else:
             toks = tok0[:, None]
         ids = jnp.concatenate([prompt if isinstance(prompt, jax.Array)
                                else jnp.asarray(prompt), toks], axis=1)
         if obs:
             jax.block_until_ready(ids)
+            kind = "greedy" if temperature == 0.0 else "sampled"
             observe.record_decode(
-                "greedy" if temperature == 0.0 else "sampled",
-                _time.perf_counter() - t0, new_tokens=B * max_new,
+                kind, _time.perf_counter() - t0, new_tokens=B * max_new,
                 batch=B, ttft=ttft, prompt_tokens=B * S0)
+            from . import health
+            health.record_nan_logits(int(jax.device_get(nf)), kind)
         return ids
 
     return decode
@@ -630,6 +636,7 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
                               caches)
         logp0 = jax.nn.log_softmax(
             logits0.astype(jnp.float32), axis=-1)     # (B,V)
+        nf = jnp.sum((~jnp.isfinite(logits0)).astype(jnp.int32))
         tokens = jnp.full((B, K, max_new), pad, jnp.int32)
         # finished-hypothesis pool (HF-style): finished beams move
         # here with a length-normalized score and stop competing by
@@ -666,12 +673,13 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
             tokens = tokens.at[:, :, 0].set(t0)
 
         def step(carry, i):
-            tokens, scores, caches, pool_tok, pool_norm, pool_raw = \
+            tokens, scores, caches, pool_tok, pool_norm, pool_raw, nf = \
                 carry
             tok = lax.dynamic_index_in_dim(
                 tokens, i, axis=2, keepdims=False)    # (B,K)
             logits, caches = core.token_step(
                 p, tok.reshape(B * K), caches, i, B * K)
+            nf = nf + jnp.sum((~jnp.isfinite(logits)).astype(jnp.int32))
             logp = jax.nn.log_softmax(
                 logits.astype(jnp.float32), axis=-1).reshape(B, K, V)
             total = scores[..., None] + logp          # (B,K,V)
@@ -699,13 +707,13 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
                    + keep_beam).reshape(B * K)        # flat rows
             caches = jax.tree.map(lambda a: a[src], caches)
             return (tokens, new_scores, caches,
-                    pool_tok, pool_norm, pool_raw), None
+                    pool_tok, pool_norm, pool_raw, nf), None
 
         carry = (tokens, alive_scores, caches,
-                 pool_tok, pool_norm, pool_raw)
+                 pool_tok, pool_norm, pool_raw, nf)
         if max_new > 1:
             carry, _ = lax.scan(step, carry, jnp.arange(max_new - 1))
-        tokens, scores, _, pool_tok, pool_norm, pool_raw = carry
+        tokens, scores, _, pool_tok, pool_norm, pool_raw, nf = carry
 
         # final selection: best of {pool, alive} by normalized score
         alive_norm = norm_len(scores, jnp.asarray(max_new))
@@ -717,7 +725,7 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
             all_tok, best[:, None, None], axis=1)[:, 0]
         best_score = jnp.take_along_axis(
             all_raw, best[:, None], axis=1)[:, 0]
-        return jnp.concatenate([prompt, out], axis=1), best_score
+        return jnp.concatenate([prompt, out], axis=1), best_score, nf
 
     jitted = jax.jit(decode)
 
@@ -726,16 +734,20 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
 
         from . import observe
         if not observe.is_enabled():
-            return jitted(p, prompt)  # no fence, no record: pure dispatch
+            # no fence, no record: pure dispatch
+            ids, score, _nf = jitted(p, prompt)
+            return ids, score
         t0 = _time.perf_counter()
         with observe.span("serving.beam_decode", batch=B, beams=K):
-            out = jitted(p, prompt)
-            jax.block_until_ready(out)
+            ids, score, nf = jitted(p, prompt)
+            jax.block_until_ready(ids)
         # one fused program: no prefill seam, so no TTFT sample here
         observe.record_decode("beam", _time.perf_counter() - t0,
                               new_tokens=B * max_new, batch=B,
                               prompt_tokens=B * S0)
-        return out
+        from . import health
+        health.record_nan_logits(int(jax.device_get(nf)), "beam")
+        return ids, score
 
     return run
 

@@ -340,11 +340,18 @@ def span_table(logdir: str):
     """Just the observe.span() rows of op_table (category "span"),
     with the `singa.span/` prefix stripped — the bridge between the
     live `singa_span_seconds` histogram and the post-hoc trace: both
-    key on the same slash-joined span path."""
+    key on the same slash-joined span path.
+
+    Each row carries a `depth` column (0 = top-level span, 1 = one
+    enclosing span, ...) derived from the slash-joined path, so nested
+    spans (health/step inside fit_epoch, opt.apply_updates inside
+    model.step) group correctly in reports: sort or indent by depth and
+    the hierarchy reads straight off the table."""
     rows = [dict(r) for r in op_table(logdir, device_only=False)
             if r["category"] == "span"]
     for r in rows:
         r["op"] = r["op"][len("singa.span/"):]
+        r["depth"] = r["op"].count("/")
     grand = sum(r["total_ms"] for r in rows) or 1.0
     for r in rows:
         r["pct"] = 100.0 * r["total_ms"] / grand

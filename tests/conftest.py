@@ -33,6 +33,19 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _metrics_isolation():
+    """Every test starts with a clean process-global MetricsRegistry
+    (observe.MetricsRegistry.reset), no EventLog attached, and the
+    instrumentation enabled — counter state accumulated by one test can
+    no longer leak into another's assertions."""
+    from singa_tpu import observe
+    observe.get_registry().reset()
+    observe.set_event_log(None)
+    observe.enable(True)
+    yield
+
+
 @pytest.fixture
 def dev():
     from singa_tpu.device import get_default_device

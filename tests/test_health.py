@@ -1,0 +1,477 @@
+"""Training-health layer (singa_tpu.health): in-graph numerics stats,
+divergence watchdog policies, and the anomaly flight recorder.
+
+ISSUE-2 acceptance surface: NaN injection in a 3-step run triggers the
+configured policy (skip_step preserves params bit-exactly, halt raises)
+with compile_count staying 1 across steps; on the 8-device mesh the
+policy fires on every shard in the same step (no divergent param state);
+the flight-recorder bundle contains the offending step's stats and
+round-trips through load_flight_bundle.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from singa_tpu import health, layer, model, observe, opt, tensor
+from singa_tpu.health import (FlightRecorder, HealthError, HealthMonitor,
+                              load_flight_bundle)
+
+
+class MLP(model.Model):
+    def __init__(self, hidden=16, classes=4):
+        super().__init__()
+        self.l1 = layer.Linear(hidden)
+        self.relu = layer.ReLU()
+        self.l2 = layer.Linear(classes)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.l2(self.relu(self.l1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self._optimizer(loss)
+        return out, loss
+
+
+@pytest.fixture
+def data(rng):
+    X = rng.randn(32, 10).astype(np.float32)
+    Y = np.argmax(X @ rng.randn(10, 4).astype(np.float32), 1).astype(np.int32)
+    return X, Y
+
+
+def _params_np(m):
+    import jax
+    return {k: np.asarray(jax.device_get(v.data)).copy()
+            for k, v in m.get_params().items()}
+
+
+def _compiled(dev, X, Y, monitor, use_graph=True, dist_mesh=None, amp=None):
+    m = MLP()
+    sgd = opt.SGD(lr=0.2, momentum=0.9)
+    m.set_optimizer(opt.DistOpt(sgd, mesh=dist_mesh)
+                    if dist_mesh is not None else sgd)
+    tx, ty = tensor.from_numpy(X, dev), tensor.from_numpy(Y, dev)
+    m.compile([tx], is_train=True, use_graph=use_graph, amp=amp,
+              health=monitor)
+    return m, tx, ty
+
+
+def _nan_batch(X, dev):
+    Xb = X.copy()
+    Xb[0, 0] = np.nan
+    return tensor.from_numpy(Xb, dev)
+
+
+# ---- watchdog policies (the ISSUE's 3-step NaN-injection runs) ------------
+
+def test_skip_step_preserves_params(dev, data, tmp_path):
+    X, Y = data
+    mon = HealthMonitor(policy="skip_step", out_dir=str(tmp_path))
+    m, tx, ty = _compiled(dev, X, Y, mon)
+    m(tx, ty)                       # step 1: healthy
+    before = _params_np(m)
+    opt_before = {k: v.copy() for k, v in m._optimizer.get_states().items()}
+    m(_nan_batch(X, dev), ty)       # step 2: NaN gradient
+    assert mon.last_action == "skip"
+    after = _params_np(m)
+    for k in before:                # update discarded, params kept exactly
+        assert np.array_equal(before[k], after[k]), k
+    # the WHOLE update rolled back: optimizer slots and step counter too
+    opt_after = m._optimizer.get_states()
+    for k in opt_before:
+        assert np.array_equal(opt_before[k], np.asarray(opt_after[k])), k
+    out, loss = m(tx, ty)           # step 3: healthy again, training resumes
+    assert mon.last_action == "ok"
+    assert math.isfinite(float(loss.numpy()))
+    assert observe.get_registry().get(
+        "singa_health_skipped_steps_total").value() == 1
+
+
+def test_halt_raises_with_bundle(dev, data, tmp_path):
+    X, Y = data
+    mon = HealthMonitor(policy="halt", out_dir=str(tmp_path))
+    m, tx, ty = _compiled(dev, X, Y, mon)
+    m(tx, ty)
+    with pytest.raises(HealthError) as ei:
+        m(_nan_batch(X, dev), ty)
+    assert ei.value.bundle_path and os.path.exists(ei.value.bundle_path)
+    assert observe.get_registry().get("singa_health_halt_total").value() == 1
+    # halt leaves the model usable for post-mortem (states assigned)
+    assert all(np.isfinite(v).all() or True for v in _params_np(m).values())
+
+
+def test_warn_policy_continues_and_counts(dev, data, tmp_path):
+    X, Y = data
+    mon = HealthMonitor(policy="warn", out_dir=str(tmp_path))
+    m, tx, ty = _compiled(dev, X, Y, mon)
+    m(tx, ty)
+    m(_nan_batch(X, dev), ty)
+    assert mon.last_action == "warn"
+    c = observe.get_registry().get("singa_health_anomaly_total")
+    assert c.value(kind="nonfinite_grad") == 1
+    # warn does NOT roll back: params are now poisoned (that's the point
+    # of skip_step existing)
+    m(tx, ty)  # still runs
+
+
+def test_recompile_with_health_drops_stale_executables(dev, data, tmp_path):
+    """compile(health=...) on an already-trained model must rebuild the
+    step with the watchdog compiled in — the stale health-less
+    executable silently disabling the policy was a real bug."""
+    X, Y = data
+    m, tx, ty = _compiled(dev, X, Y, None)
+    m(tx, ty)  # compiles the health-less step
+    before = _params_np(m)
+    mon = HealthMonitor(policy="skip_step", out_dir=str(tmp_path))
+    m.compile([tx], is_train=True, use_graph=True, health=mon)
+    m(_nan_batch(X, dev), ty)
+    assert mon.last_action == "skip"
+    after = _params_np(m)
+    for k in before:
+        assert np.array_equal(before[k], after[k]), k
+
+
+def test_dump_cooldown_suppresses_per_step_bundles(tmp_path):
+    """A permanently diverged run (every step anomalous) must not write
+    a bundle per step: first anomaly of an episode dumps, then re-dumps
+    only after the cooldown; a healthy step resets the episode."""
+    mon = HealthMonitor(policy="warn", out_dir=str(tmp_path), window=8,
+                        dump_cooldown=8)
+    for i in range(1, 7):
+        mon.on_step(_stats(loss=float("nan"), nfl=1), step=i)
+    bundles = [f for f in os.listdir(tmp_path) if f.endswith(".jsonl")]
+    assert len(bundles) == 1          # one dump for the whole episode
+    mon.on_step(_stats(), step=7)     # healthy: episode ends
+    mon.on_step(_stats(loss=float("nan"), nfl=1), step=8)
+    bundles = [f for f in os.listdir(tmp_path) if f.endswith(".jsonl")]
+    assert len(bundles) == 2          # new episode dumps again
+    for i in range(9, 17):
+        mon.on_step(_stats(loss=float("nan"), nfl=1), step=i)
+    bundles = [f for f in os.listdir(tmp_path) if f.endswith(".jsonl")]
+    assert len(bundles) == 3          # cooldown elapsed mid-episode
+
+
+def test_compile_count_stays_one_with_health(dev, data):
+    """Health stats are computed fully in-graph: 3 same-shape steps (one
+    of them anomalous) reuse ONE jitted callable per batch-size class."""
+    X, Y = data
+    mon = HealthMonitor(policy="skip_step", out_dir="/tmp")
+    m, tx, ty = _compiled(dev, X, Y, mon)
+    m(tx, ty)
+    m(_nan_batch(X, dev), ty)
+    m(tx, ty)
+    c = observe.get_registry().get("singa_model_compile_total")
+    assert c.value(batch_class="32") == 1
+    assert observe.get_registry().get("singa_model_recompile_total") is None
+
+
+# ---- in-graph stats content ------------------------------------------------
+
+def test_step_stats_metrics_populated(dev, data):
+    X, Y = data
+    mon = HealthMonitor(policy="warn", out_dir="/tmp")
+    m, tx, ty = _compiled(dev, X, Y, mon)
+    m(tx, ty)
+    reg = observe.get_registry()
+    assert math.isfinite(reg.get("singa_health_loss").value())
+    assert reg.get("singa_health_grad_norm").value() > 0
+    assert reg.get("singa_health_nonfinite_grads").value() == 0
+    # per-layer-group norms and update-to-param ratios, grouped by the
+    # first param-path component
+    for g in ("l1", "l2"):
+        assert reg.get("singa_health_param_norm").value(group=g) > 0
+        assert reg.get("singa_health_update_norm").value(group=g) > 0
+        r = reg.get("singa_health_update_ratio").value(group=g)
+        assert 0 < r < 10
+
+
+def test_amp_overflow_counter(dev, data):
+    """Non-finite grads under AMP register as loss-scale-overflow events
+    (singa_health_overflow_total) — the bf16 analog of fp16 overflow
+    machinery, with skip_step as the skip-update response."""
+    X, Y = data
+    mon = HealthMonitor(policy="skip_step", out_dir="/tmp")
+    m, tx, ty = _compiled(dev, X, Y, mon, amp="bfloat16")
+    m(tx, ty)
+    m(_nan_batch(X, dev), ty)
+    assert observe.get_registry().get(
+        "singa_health_overflow_total").value() == 1
+
+
+def test_eager_mode_health(dev, data):
+    """Health works on the eager (use_graph=False) path too: same stats,
+    warn/halt semantics (skip's rollback needs the compiled step)."""
+    X, Y = data
+    mon = HealthMonitor(policy="warn", out_dir="/tmp")
+    m, tx, ty = _compiled(dev, X, Y, mon, use_graph=False)
+    m(tx, ty)
+    assert observe.get_registry().get("singa_health_grad_norm").value() > 0
+    m(_nan_batch(X, dev), ty)
+    assert mon.last_action == "warn"
+
+
+# ---- flight recorder -------------------------------------------------------
+
+def test_flight_bundle_roundtrip(dev, data, tmp_path):
+    X, Y = data
+    mon = HealthMonitor(policy="warn", out_dir=str(tmp_path),
+                        snapshot_batch=True)
+    m, tx, ty = _compiled(dev, X, Y, mon)
+    m(tx, ty)
+    m(tx, ty)
+    m(_nan_batch(X, dev), ty)
+    path = mon.recorder.last_bundle
+    assert path and os.path.exists(path)
+    b = load_flight_bundle(path)
+    assert b["header"]["reason"].startswith("nonfinite")
+    # ring holds the anomalous step AND the healthy history before it
+    assert len(b["steps"]) == 3
+    bad = [s for s in b["steps"] if s["anomaly_kinds"]]
+    assert len(bad) == 1 and bad[0]["nonfinite_grads"] > 0
+    assert bad[0]["step"] == 3
+    good = [s for s in b["steps"] if not s["anomaly_kinds"]]
+    assert all(math.isfinite(s["loss"]) for s in good)
+    # offending batch snapshot rides along (via snapshot.py) and the NaN
+    # is right where it was injected
+    assert b["batch"] is not None
+    assert np.isnan(b["batch"]["input0"][0, 0])
+
+
+def test_flight_recorder_ring_bounded(tmp_path):
+    fr = FlightRecorder(capacity=4, out_dir=str(tmp_path))
+    for i in range(10):
+        fr.record({"step": i, "loss": float(i), "anomaly_kinds": []})
+    path = fr.dump(reason="test", step=9)
+    b = load_flight_bundle(path)
+    assert [s["step"] for s in b["steps"]] == [6, 7, 8, 9]
+    assert b["header"]["n_steps"] == 4
+
+
+def test_flight_bundle_includes_event_tail(dev, data, tmp_path):
+    X, Y = data
+    mon = HealthMonitor(policy="warn", out_dir=str(tmp_path))
+    m, tx, ty = _compiled(dev, X, Y, mon)
+    m(tx, ty)  # emits a "step" event into the registry ring
+    m(_nan_batch(X, dev), ty)
+    b = load_flight_bundle(mon.recorder.last_bundle)
+    kinds = {e.get("kind") for e in b["events"]}
+    assert "step" in kinds
+
+
+def test_bundle_is_valid_jsonl(tmp_path):
+    fr = FlightRecorder(capacity=2, out_dir=str(tmp_path))
+    fr.record({"step": 1, "loss": 0.5, "anomaly_kinds": []})
+    path = fr.dump(reason="r", step=1)
+    with open(path) as f:
+        for line in f:
+            json.loads(line)  # every line parses standalone
+
+
+# ---- host-side monitor unit behavior (no jit) ------------------------------
+
+def _stats(loss=1.0, grad_norm=1.0, nf=0, nfl=0):
+    return {"loss": loss, "grad_norm": grad_norm, "nonfinite_grads": nf,
+            "nonfinite_loss": nfl, "groups": {}}
+
+
+def test_loss_spike_detection(tmp_path):
+    mon = HealthMonitor(policy="warn", warmup_steps=5, spike_factor=10.0,
+                        ema_decay=0.9, out_dir=str(tmp_path))
+    for i in range(20):
+        assert mon.on_step(_stats(loss=1.0 + 0.01 * (i % 3)), step=i) == "ok"
+    assert mon.on_step(_stats(loss=50.0), step=21) == "warn"
+    c = observe.get_registry().get("singa_health_anomaly_total")
+    assert c.value(kind="loss_spike") == 1
+    assert os.path.exists(mon.recorder.last_bundle)
+
+
+def test_spike_under_skip_policy_downgrades_to_warn(tmp_path):
+    """A spike cannot retroactively un-commit an applied update, so
+    skip_step treats it as warn (and does not count a skipped step)."""
+    mon = HealthMonitor(policy="skip_step", warmup_steps=2,
+                        spike_factor=5.0, ema_decay=0.9,
+                        out_dir=str(tmp_path))
+    for i in range(10):
+        mon.on_step(_stats(loss=1.0 + 0.01 * i), step=i, in_graph_skip=True)
+    assert mon.on_step(_stats(loss=99.0), step=11,
+                       in_graph_skip=True) == "warn"
+    assert observe.get_registry().get(
+        "singa_health_skipped_steps_total").value() == 0
+
+
+def test_grad_norm_limit_policy(tmp_path):
+    mon = HealthMonitor(policy="halt", grad_norm_limit=10.0,
+                        out_dir=str(tmp_path))
+    mon.on_step(_stats(grad_norm=1.0), step=1)
+    with pytest.raises(HealthError):
+        mon.on_step(_stats(grad_norm=1e6), step=2)
+
+
+def test_monitor_rejects_bad_policy():
+    with pytest.raises(ValueError):
+        HealthMonitor(policy="retry")
+
+
+def test_prometheus_export_survives_nan_gauges(tmp_path):
+    """After an anomaly step the health gauges legitimately hold NaN;
+    the Prometheus exporter must emit canonical NaN/+Inf spellings, not
+    crash (regression: _fmt_num int-cast on NaN)."""
+    mon = HealthMonitor(policy="warn", out_dir=str(tmp_path))
+    mon.on_step(_stats(loss=float("nan"), grad_norm=float("inf"),
+                       nf=3, nfl=1), step=1)
+    text = observe.to_prometheus_text()
+    assert "singa_health_loss NaN" in text
+    assert "singa_health_grad_norm +Inf" in text
+
+
+def test_nonfinite_loss_alone_fires(tmp_path):
+    mon = HealthMonitor(policy="warn", out_dir=str(tmp_path))
+    assert mon.on_step(_stats(loss=float("nan"), nfl=1), step=1) == "warn"
+    c = observe.get_registry().get("singa_health_anomaly_total")
+    assert c.value(kind="nonfinite_loss") == 1
+
+
+# ---- Model.fit loop --------------------------------------------------------
+
+def test_fit_trains_and_returns_history(dev, data):
+    X, Y = data
+    m, tx, ty = _compiled(dev, X, Y, None)
+    hist = m.fit([(tx, ty)], epochs=8)
+    assert len(hist) == 8
+    assert hist[-1] < hist[0]
+
+
+def test_fit_halt_propagates(dev, data, tmp_path):
+    X, Y = data
+    mon = HealthMonitor(policy="halt", out_dir=str(tmp_path))
+    m, tx, ty = _compiled(dev, X, Y, mon)
+    batches = [(tx, ty), (_nan_batch(X, dev), ty), (tx, ty)]
+    with pytest.raises(HealthError):
+        m.fit(batches, epochs=1)
+
+
+def test_fit_rejects_one_shot_generator(dev, data):
+    X, Y = data
+    m, tx, ty = _compiled(dev, X, Y, None)
+    gen = ((tx, ty) for _ in range(2))  # exhausted after epoch 0
+    with pytest.raises(ValueError):
+        m.fit(gen, epochs=2)
+
+
+# ---- distributed agreement (8-device mesh) ---------------------------------
+
+def test_mesh_policy_fires_on_all_shards_same_step(dev, data, tmp_path):
+    """Inf lands in ONE data shard's batch slice; the agreed anomaly flag
+    must skip the update on EVERY shard in the same step — params stay
+    replicated and bit-identical to the pre-step values."""
+    import jax
+    from singa_tpu.parallel import data_parallel_mesh
+    X, Y = data
+    mesh = data_parallel_mesh(8)
+    mon = HealthMonitor(policy="skip_step", out_dir=str(tmp_path))
+    m, tx, ty = _compiled(dev, X, Y, mon, dist_mesh=mesh)
+    m(tx, ty)
+    m(tx, ty)
+    before = _params_np(m)
+    Xb = X.copy()
+    Xb[5, 0] = np.inf           # batch row 5 -> shard 1 only (32/8 = 4 rows)
+    m(tensor.from_numpy(Xb, dev), ty)
+    assert mon.last_action == "skip"
+    for k, v in m.get_params().items():
+        arr = v.data
+        assert arr.is_fully_replicated          # no divergent shard state
+        assert np.array_equal(before[k], np.asarray(jax.device_get(arr))), k
+    out, loss = m(tx, ty)       # training resumes on all shards
+    assert math.isfinite(float(loss.numpy()))
+    b = load_flight_bundle(mon.recorder.last_bundle)
+    bad = [s for s in b["steps"] if s["anomaly_kinds"]]
+    assert bad and bad[0]["nonfinite_grads"] > 0
+
+
+def test_compile_health_false_detaches(dev, data):
+    """health=False is a natural flag spelling and must mean 'off', not
+    crash on the first train call; junk values are rejected loudly."""
+    X, Y = data
+    m, tx, ty = _compiled(dev, X, Y, None)
+    m.compile([tx], is_train=True, use_graph=True, health=False)
+    assert m._health_monitor is None
+    m(tx, ty)  # trains fine with health off
+    with pytest.raises(TypeError):
+        m.compile([tx], is_train=True, use_graph=True, health="warn")
+
+
+def test_mesh_nonfinite_count_not_inflated(dev, data, tmp_path):
+    """The collector sees post-allreduce (replicated) grads under the
+    dense strategy; the cross-shard count must equal the single-device
+    count, not world_size times it (counts are pmax'd, not psum'd)."""
+    from singa_tpu.parallel import data_parallel_mesh
+    X, Y = data
+    Xb = X.copy()
+    Xb[0, 0] = np.nan
+
+    def nan_count(dist_mesh, out_dir):
+        mon = HealthMonitor(policy="warn", out_dir=out_dir)
+        m, tx, ty = _compiled(dev, X, Y, mon, dist_mesh=dist_mesh)
+        m(tx, ty)
+        m(tensor.from_numpy(Xb, dev), ty)
+        b = load_flight_bundle(mon.recorder.last_bundle)
+        return [s for s in b["steps"] if s["anomaly_kinds"]][0][
+            "nonfinite_grads"]
+
+    single = nan_count(None, str(tmp_path / "s"))
+    dist = nan_count(data_parallel_mesh(8), str(tmp_path / "d"))
+    assert single > 0
+    assert dist == single
+
+
+def test_communicator_agree_any():
+    """agree_any is a cross-shard OR: one shard's flag flips every
+    shard's verdict."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from singa_tpu.parallel import data_parallel_mesh
+    from singa_tpu.parallel.communicator import Communicator
+    mesh = data_parallel_mesh(8)
+    comm = Communicator(axis="data", mesh=mesh)
+
+    def f(flags):
+        return comm.agree_any(flags[0]).astype(jnp.int32).reshape(1)
+
+    mapped = jax.shard_map(f, mesh=mesh, in_specs=P("data"),
+                           out_specs=P("data"), check_vma=False)
+    flags = np.zeros(8, np.int32)
+    flags[3] = 1
+    out = np.asarray(mapped(jnp.asarray(flags)))
+    assert out.tolist() == [1] * 8
+    out0 = np.asarray(mapped(jnp.zeros(8, jnp.int32)))
+    assert out0.tolist() == [0] * 8
+
+
+# ---- serving NaN-logit watch ----------------------------------------------
+
+@pytest.mark.slow
+def test_decode_nan_logit_counter(dev):
+    """A poisoned head makes every decoded logit NaN; the serving path
+    counts them in-graph into singa_health_nan_logits_total."""
+    from singa_tpu import models
+    m = models.create_model("gpt", vocab_size=64, max_seq=16, dim=32,
+                            num_heads=4, num_layers=1)
+    ids = np.random.RandomState(0).randint(0, 64, (2, 4)).astype(np.int32)
+    tx = tensor.from_numpy(ids, device=dev)
+    m.compile([tx], is_train=False, use_graph=False)
+    m.generate(tx, 3)
+    assert observe.get_registry().get(
+        "singa_health_nan_logits_total") is None   # healthy: never created
+    m.head.W.data = m.head.W.data * np.nan
+    m._param_cache = None
+    m.generate(tx, 3)
+    c = observe.get_registry().get("singa_health_nan_logits_total")
+    assert c is not None and c.value(kind="greedy") > 0

@@ -41,6 +41,46 @@ def test_lint_cli_exit_codes(tmp_path):
     assert check_metrics_names.main([str(bad)]) == 1
 
 
+def test_lint_enforces_counter_total_suffix(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from singa_tpu import observe\n"
+        "observe.counter('singa_requests')\n"      # counter w/o _total
+        "observe.gauge('singa_requests_now')\n"    # gauges are exempt
+        "observe.counter('singa_requests_total')\n")
+    problems = check_metrics_names.check([str(tmp_path)])
+    assert len(problems) == 1
+    assert "_total" in problems[0] and "singa_requests" in problems[0]
+
+
+def test_lint_enforces_unique_help_strings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from singa_tpu import observe\n"
+        "observe.gauge('singa_a', 'how many things')\n"
+        "observe.gauge('singa_b', 'how many things')\n"   # copy-pasted
+        "observe.gauge('singa_a', 'how many things')\n"   # same name: fine
+        "observe.gauge('singa_c', 'different words')\n"
+        "observe.gauge('singa_d')\n"                      # empty: exempt
+        "observe.gauge('singa_e')\n")
+    problems = check_metrics_names.check([str(tmp_path)])
+    assert len(problems) == 1
+    assert "singa_b" in problems[0] and "help" in problems[0]
+
+
+def test_lint_covers_health_metric_names():
+    """The singa_health_* registrations in singa_tpu/health.py are inside
+    the default lint scan (picked up automatically, per ISSUE-2)."""
+    import os
+    names = set()
+    health_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                             "health.py")
+    for name, _t, _h, _l in check_metrics_names.registrations_in(health_py):
+        names.add(name)
+    assert any(n.startswith("singa_health_") for n in names)
+    assert "singa_health_overflow_total" in names
+
+
 def test_runtime_registry_enforces_same_contract():
     """The registry raises at runtime on exactly what the lint flags
     statically (dynamic names the AST walk cannot see)."""

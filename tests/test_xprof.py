@@ -65,3 +65,35 @@ def test_format_table(tracedir):
     rows = xprof.op_table(tracedir)
     text = xprof.format_table(rows, top=5)
     assert "total_ms" in text and "\n" in text
+
+
+@pytest.fixture(scope="module")
+def spandir(tmp_path_factory):
+    from singa_tpu import observe
+    d = str(tmp_path_factory.mktemp("spans"))
+    f = jax.jit(lambda x: (x * x).sum())
+    x = jnp.ones((64, 64), jnp.float32)
+    f(x).block_until_ready()
+    jax.profiler.start_trace(d)
+    with observe.span("fit_epoch"):
+        with observe.span("model.step"):
+            with observe.span("health"):
+                f(x).block_until_ready()
+        f(x).block_until_ready()
+    jax.profiler.stop_trace()
+    return d
+
+
+def test_span_table_depth_column(spandir):
+    """Nested spans carry a depth column (slash count of the joined
+    path), so health/step spans group under their enclosing epoch span
+    in reports."""
+    rows = xprof.span_table(spandir)
+    assert rows, "no span rows decoded from the capture"
+    depth = {r["op"]: r["depth"] for r in rows}
+    assert depth["fit_epoch"] == 0
+    assert depth["fit_epoch/model.step"] == 1
+    assert depth["fit_epoch/model.step/health"] == 2
+    # every row has the column and it equals the path nesting
+    for r in rows:
+        assert r["depth"] == r["op"].count("/")

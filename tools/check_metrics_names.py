@@ -11,7 +11,12 @@ literal, then fails if
   1. a name does not match ^singa_[a-z0-9_]+$, or
   2. the same name is registered under two different metric types
      (the runtime registry raises on this too; the lint catches it
-     before any code runs).
+     before any code runs), or
+  3. a counter's name does not end in `_total` (the Prometheus counter
+     convention — dashboards and recording rules key on it), or
+  4. the same non-empty help string is registered for two DIFFERENT
+     metric names (copy-pasted helps make /metrics output ambiguous;
+     every name must describe itself).
 
 Dynamic names (f-strings, e.g. bench.py's singa_bench_* gauges) cannot be
 checked statically; the runtime ValueError in observe._Metric covers
@@ -51,9 +56,11 @@ def iter_py_files(paths):
 
 
 def registrations_in(path):
-    """Yield (name, metric_type, lineno) for literal metric registrations
-    in one file. Parse errors are a lint failure upstream (tier-1 would
-    catch them anyway), so let them raise."""
+    """Yield (name, metric_type, help_or_None, lineno) for literal metric
+    registrations in one file. `help` is the second positional arg or the
+    `help=` keyword when it is a string literal (dynamic helps are left
+    to the runtime). Parse errors are a lint failure upstream (tier-1
+    would catch them anyway), so let them raise."""
     with open(path, encoding="utf-8") as f:
         tree = ast.parse(f.read(), filename=path)
     for node in ast.walk(tree):
@@ -71,22 +78,34 @@ def registrations_in(path):
         if not node.args:
             continue
         first = node.args[0]
-        if isinstance(first, ast.Constant) and isinstance(first.value, str):
-            yield first.value, fname, node.lineno
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        help_node = node.args[1] if len(node.args) > 1 else next(
+            (kw.value for kw in node.keywords if kw.arg == "help"), None)
+        help_text = help_node.value \
+            if (isinstance(help_node, ast.Constant)
+                and isinstance(help_node.value, str)) else None
+        yield first.value, fname, help_text, node.lineno
 
 
 def check(paths=None):
     """Return a list of violation strings (empty = clean)."""
     problems = []
-    seen = {}  # name -> (type, file, line)
+    seen = {}       # name -> (type, file, line)
+    help_seen = {}  # help text -> (name, file, line)
     for path in iter_py_files(paths or DEFAULT_PATHS):
         rel = os.path.relpath(path, ROOT)
-        for name, mtype, line in registrations_in(path):
+        for name, mtype, help_text, line in registrations_in(path):
             if not NAME_RE.match(name):
                 problems.append(
                     f"{rel}:{line}: metric name {name!r} does not match "
                     f"{NAME_RE.pattern}")
                 continue
+            if mtype == "counter" and not name.endswith("_total"):
+                problems.append(
+                    f"{rel}:{line}: counter {name!r} must end in '_total' "
+                    "(Prometheus counter convention)")
             prev = seen.get(name)
             if prev is None:
                 seen[name] = (mtype, rel, line)
@@ -94,6 +113,15 @@ def check(paths=None):
                 problems.append(
                     f"{rel}:{line}: metric {name!r} registered as {mtype} "
                     f"but already a {prev[0]} at {prev[1]}:{prev[2]}")
+            if help_text:
+                hprev = help_seen.get(help_text)
+                if hprev is None:
+                    help_seen[help_text] = (name, rel, line)
+                elif hprev[0] != name:
+                    problems.append(
+                        f"{rel}:{line}: metric {name!r} reuses the help "
+                        f"string of {hprev[0]!r} ({hprev[1]}:{hprev[2]}); "
+                        "help strings must be unique per metric")
     return problems
 
 
