@@ -22,43 +22,70 @@ import sys
 import time
 
 
-# Dense bf16 peak TFLOP/s by TPU generation (public spec sheets). Used as an
-# upper bound for sanity-checking; >100% of this is a broken harness by
-# definition, whatever the dtype.
-_PEAK_TFLOPS = [
-    ("v6", 918.0), ("trillium", 918.0),
-    ("v5p", 459.0),
-    ("v5 lite", 197.0), ("v5e", 197.0), ("v5litepod", 197.0),
-    ("v5", 459.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-]
-
-
-# HBM bandwidth GB/s by generation (public spec sheets), for the roofline
-# readout: bound = memory when bytes/BW exceeds flops/peak.
-_PEAK_HBM_GBS = [
-    ("v6", 1638.0), ("trillium", 1638.0),
-    ("v5p", 2765.0),
-    ("v5 lite", 819.0), ("v5e", 819.0), ("v5litepod", 819.0),
-    ("v5", 2765.0),
-    ("v4", 1228.0),
-    ("v3", 900.0),
-    ("v2", 700.0),
-]
-
-
-def _chip_peak(device_kind: str, table):
-    kind = device_kind.lower()
-    for key, peak in table:
-        if key in kind:
-            return peak
-    return None
+# Per-generation peaks (public spec sheets) live in singa_tpu.introspect —
+# one table feeds this harness, the MFU gauge, and the explain report.
+# >100% of the flops peak is a broken harness by definition, whatever the
+# dtype; the HBM table drives the roofline readout (bound = memory when
+# bytes/BW exceeds flops/peak).
+from singa_tpu.introspect import (  # noqa: E402
+    PEAK_TFLOPS_BF16 as _PEAK_TFLOPS,
+    PEAK_HBM_GBS as _PEAK_HBM_GBS,
+    chip_peak as _chip_peak,
+)
 
 
 def _chip_peak_tflops(device_kind: str):
     return _chip_peak(device_kind, _PEAK_TFLOPS)
+
+
+def build_bench_model(model="resnet50", batch=32, size=224, dtype="float32",
+                      gpt_dim=2048, gpt_layers=8, gpt_heads=16,
+                      gpt_vocab=8192, dev=None, seed=0):
+    """Build one bench model plus a synthetic batch on `dev`.
+
+    Shared by the timed harness below and `python -m singa_tpu.introspect`
+    (the explain report describes the exact executables the bench times).
+    Returns (model, tx, ty, items_per_step, unit, model_factory).
+    """
+    import numpy as np
+    from singa_tpu import device, models, tensor
+
+    dev = dev or device.best_device()
+    rng = np.random.RandomState(seed)
+    if model == "gpt":
+        seq = size if size > 32 else 512
+        def model_factory():
+            return models.create_model(
+                "gpt", vocab_size=gpt_vocab, max_seq=seq, dim=gpt_dim,
+                num_heads=gpt_heads, num_layers=gpt_layers)
+
+        m = model_factory()
+        ids = rng.randint(0, gpt_vocab, (batch, seq)).astype(np.int32)
+        tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+        tx = tensor.from_numpy(ids, device=dev)
+        ty = tensor.from_numpy(tgt, device=dev)
+        return m, tx, ty, batch * seq, "tokens/s", model_factory
+    if model == "mlp":
+        def model_factory():
+            return models.create_model("mlp", data_size=size,
+                                       num_classes=10)
+
+        m = model_factory()
+        x_np = rng.standard_normal((batch, size)).astype(np.float32)
+        y_np = rng.randint(0, 10, batch).astype(np.int32)
+        tx = tensor.Tensor(data=x_np, device=dev, dtype=dtype)
+        ty = tensor.from_numpy(y_np, device=dev)
+        return m, tx, ty, batch, "img/s", model_factory
+
+    def model_factory():
+        return models.create_model(model, num_channels=3)
+
+    m = model_factory()
+    x_np = rng.standard_normal((batch, 3, size, size)).astype(np.float32)
+    y_np = rng.randint(0, 10, batch).astype(np.int32)
+    tx = tensor.Tensor(data=x_np, device=dev, dtype=dtype)
+    ty = tensor.from_numpy(y_np, device=dev)
+    return m, tx, ty, batch, "img/s", model_factory
 
 
 def main():
@@ -103,6 +130,13 @@ def main():
                         "vs the no-health run into the JSON "
                         "(health_ms_per_step / health_overhead_pct), so "
                         "regressions in the stats cost show in BENCH_*.json")
+    p.add_argument("--explain", action="store_true",
+                   help="add the AOT introspection fields to the JSON "
+                        "record (singa_tpu.introspect): mfu_pct, "
+                        "compile_{trace,lower,backend}_s phase times and "
+                        "hbm_temps_bytes of the compiled step — mirrored "
+                        "into singa_bench_* gauges like every other "
+                        "field")
     p.add_argument("--metrics-out", default=None, metavar="FILE",
                    help="write the observe registry as Prometheus text "
                         "after the run (step histograms, compile counts, "
@@ -137,34 +171,11 @@ def main():
         args.warmup = min(args.warmup, 2)
         args.step_samples = min(args.step_samples, 5)
 
-    rng = np.random.RandomState(0)
-    if args.model == "gpt":
-        seq = args.size if args.size > 32 else 512
-        vocab = 8192
-        def model_factory():
-            return models.create_model(
-                "gpt", vocab_size=vocab, max_seq=seq, dim=args.gpt_dim,
-                num_heads=args.gpt_heads, num_layers=args.gpt_layers)
-
-        m = model_factory()
-        ids = rng.randint(0, vocab, (args.batch, seq)).astype(np.int32)
-        tgt = np.roll(ids, -1, axis=1).astype(np.int32)
-        tx = tensor.from_numpy(ids, device=dev)
-        ty = tensor.from_numpy(tgt, device=dev)
-        items_per_step = args.batch * seq
-        unit = "tokens/s"
-    else:
-        x_np = rng.standard_normal(
-            (args.batch, 3, args.size, args.size)).astype(np.float32)
-        y_np = rng.randint(0, 10, args.batch).astype(np.int32)
-        def model_factory():
-            return models.create_model(args.model, num_channels=3)
-
-        m = model_factory()
-        tx = tensor.Tensor(data=x_np, device=dev, dtype=args.dtype)
-        ty = tensor.from_numpy(y_np, device=dev)
-        items_per_step = args.batch
-        unit = "img/s"
+    seq = args.size if args.size > 32 else 512  # gpt: attn-flops formula
+    m, tx, ty, items_per_step, unit, model_factory = build_bench_model(
+        model=args.model, batch=args.batch, size=args.size,
+        dtype=args.dtype, gpt_dim=args.gpt_dim, gpt_layers=args.gpt_layers,
+        gpt_heads=args.gpt_heads, dev=dev)
 
     sgd = opt.SGD(lr=0.1, momentum=0.9, weight_decay=1e-5)
     m.set_optimizer(sgd)
@@ -226,6 +237,14 @@ def main():
     # comparisons swing by >10% run to run. The delta is the cost of the
     # fused grad-norm/isfinite/update-norm reductions plus the per-step
     # stats fetch.
+    # --explain must describe the executable the timed run above used;
+    # snapshot it NOW, before the --health arm compiles a second,
+    # health-instrumented step under the same "step" introspect key
+    explain_build = None
+    if args.explain:
+        from singa_tpu import introspect
+        explain_build = introspect.last_build("step") or {}
+
     health_ms_per_step = None
     health_overhead_pct = None
     if args.health:
@@ -396,6 +415,23 @@ def main():
     }
     if note:
         rec["note"] = note
+    if args.explain:
+        # the timed step compiled through the AOT stages (model.py); use
+        # the build record snapshotted before the --health arm rather
+        # than re-lowering anything
+        b = explain_build or {}
+        ph = b.get("phases") or {}
+        mem = b.get("memory") or {}
+        rec.update({
+            "mfu_pct": round(mfu * 100.0, 2) if mfu else None,
+            "compile_trace_s": round(ph["trace"], 4)
+            if "trace" in ph else None,
+            "compile_lower_s": round(ph["lower"], 4)
+            if "lower" in ph else None,
+            "compile_backend_s": round(ph["compile"], 4)
+            if "compile" in ph else None,
+            "hbm_temps_bytes": mem.get("temps"),
+        })
     # one schema: the BENCH_*.json record also lands in the registry
     # (singa_bench_* gauges) and the EventLog, next to the per-step
     # telemetry the run itself produced

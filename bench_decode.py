@@ -51,6 +51,11 @@ def main():
     p.add_argument("--reps", type=int, default=3,
                    help="timed full-decode calls (median reported)")
     p.add_argument("--trace", default=None, metavar="DIR")
+    p.add_argument("--explain", action="store_true",
+                   help="add AOT introspection fields (singa_tpu."
+                        "introspect) for the prefill/decode executables: "
+                        "compile-phase times, HBM temp bytes, and the "
+                        "recompile-blame history of this run")
     args = p.parse_args()
 
     import numpy as np
@@ -214,6 +219,23 @@ def main():
             if med - call_overhead - prefill_s > 5e-3 else None),
         "out_shape": list(out.shape),
     }
+    if args.explain:
+        from singa_tpu import introspect
+        for key, prefix in (("serving.prefill", "prefill"),
+                            ("serving.decode_scan", "decode")):
+            b = introspect.last_build(key) or {}
+            ph = b.get("phases") or {}
+            mem = b.get("memory") or {}
+            rec[f"{prefix}_compile_trace_s"] = \
+                round(ph["trace"], 4) if "trace" in ph else None
+            rec[f"{prefix}_compile_lower_s"] = \
+                round(ph["lower"], 4) if "lower" in ph else None
+            rec[f"{prefix}_compile_backend_s"] = \
+                round(ph["compile"], 4) if "compile" in ph else None
+            rec[f"{prefix}_hbm_temps_bytes"] = mem.get("temps")
+        rec["recompiles"] = [
+            {"key": b["key"], "reason": b["reason"], "detail": b["detail"]}
+            for b in introspect.blame_history()]
     print(json.dumps(rec))
     return 0
 

@@ -23,7 +23,7 @@ _LAZY_MODULES = (
     "tensor", "device", "autograd", "layer", "model", "opt",
     "initializer", "sonnx", "data", "image_tool", "snapshot",
     "parallel", "utils", "ops", "models", "io", "channel", "native",
-    "observe", "xprof", "health", "serving",
+    "observe", "xprof", "health", "serving", "introspect",
 )
 
 

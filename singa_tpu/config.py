@@ -30,6 +30,13 @@ CUDNN_VERSION = 0  # parity constant; no cuDNN on TPU
 #: reference's lack of a fake communicator (SURVEY.md §4 "lesson").
 HOST_DEVICE_COUNT = int(os.environ.get("SINGA_TPU_HOST_DEVICES", "8"))
 
+#: Peak-flops override (TFLOP/s) for the MFU gauge and explain report
+#: (singa_tpu.introspect). None = use the per-generation table keyed on
+#: jax.Device.device_kind; set SINGA_TPU_PEAK_TFLOPS (or call
+#: introspect.set_peak_tflops) for custom parts or derated clocks.
+PEAK_TFLOPS = (float(os.environ["SINGA_TPU_PEAK_TFLOPS"])
+               if os.environ.get("SINGA_TPU_PEAK_TFLOPS") else None)
+
 
 def use_tpu() -> bool:
     """True when at least one TPU chip is attached. Initializes the JAX

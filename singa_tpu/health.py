@@ -235,10 +235,21 @@ def apply_skip(stats, old_arrays, new_arrays):
     increment rolls back too, like a loss-scaler's overflow skip);
     otherwise take the updated ones. Runs inside the jitted step, so the
     skip lands on all shards in the same step with zero host round-trip.
+
+    `new_arrays` may be LONGER than `old_arrays`: strategies with lazily
+    created optimizer state (sparse error-feedback residuals) grow slots
+    during the first traced step. Those slots have no pre-step buffer to
+    select — their pre-step value is their creation-time init (zeros) —
+    so on skip they roll back to zeros and on healthy steps they commit;
+    zip-truncating them instead would drop the tail from the step output
+    and reset the residuals every step.
     """
     import jax.numpy as jnp
     bad = stats["anomaly"] > 0
-    return [jnp.where(bad, o, n) for o, n in zip(old_arrays, new_arrays)]
+    out = [jnp.where(bad, o, n) for o, n in zip(old_arrays, new_arrays)]
+    out.extend(jnp.where(bad, jnp.zeros_like(n), n)
+               for n in new_arrays[len(old_arrays):])
+    return out
 
 
 # ---- flight recorder -------------------------------------------------------
@@ -276,10 +287,19 @@ class FlightRecorder:
             with Snapshot(snap_prefix, mode_write=True) as s:
                 for i, a in enumerate(batch_arrays):
                     s.write(f"input{i}", np.asarray(a))
+        try:
+            # pin the exact executables that produced the anomalous step:
+            # introspect's manifest carries a fingerprint per AOT build
+            # (+ the HLO-text path when capture_hlo was on)
+            from . import introspect
+            execs = introspect.executable_manifest()[-8:] or None
+        except Exception:
+            execs = None
         header = {"kind": "flight_header", "ts": round(time.time(), 6),
                   "reason": reason, "step": int(step),
                   "n_steps": len(self.ring), "n_events": len(tail),
-                  "batch_snapshot": snap_prefix}
+                  "batch_snapshot": snap_prefix,
+                  "executables": execs}
         with open(path, "w", encoding="utf-8") as f:
             f.write(json.dumps(header, separators=(",", ":"),
                                default=str) + "\n")

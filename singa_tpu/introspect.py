@@ -1,0 +1,788 @@
+"""Compile & memory introspection: recompile blame, AOT cost/memory
+telemetry, and the `explain` report.
+
+On TPUs the two dominant invisible costs are XLA compilation and HBM.
+PR 1 *counts* recompiles (`singa_model_recompile_total`) without saying
+why one happened, and nothing reported flops/step or the HBM breakdown —
+the "fast as the hardware allows" goal was unmeasurable. This module is
+the build-time half of observability, in three parts:
+
+1. **Recompile blame.** Every AOT build records the executable's abstract
+   call signature (leaf shapes/dtypes, step tag, static args, donation
+   set). When a later build for the same key arrives, the new signature
+   is diffed against the nearest prior one and a structured reason is
+   emitted — `singa_recompile_total{reason=...}` with a FIXED
+   low-cardinality enum (`RECOMPILE_REASONS`) plus a detail string
+   ("arg `arg0` batch 32->48 crossed bucket 32->64") into the EventLog.
+
+2. **AOT cost/memory telemetry.** `build_compiled` routes a jitted
+   callable through the explicit `trace -> lower -> compile` stages,
+   timing each phase into `singa_compile_phase_seconds{phase=...}`, and
+   harvests `compiled.cost_analysis()` / `memory_analysis()` into
+   `singa_xla_flops_per_step`, `singa_xla_bytes_accessed` and the
+   `singa_hbm_{arguments,outputs,temps,generated_code}_bytes` gauges.
+   The step build also populates `Device.cost_analysis` (un-deadening
+   `PrintTimeProfiling` verbosity>=2) and registers a per-step callback
+   that derives `singa_mfu_pct` from the platform peak-flops table
+   (override: `set_peak_tflops` / `SINGA_TPU_PEAK_TFLOPS` /
+   `config.PEAK_TFLOPS`). All of this happens at build/retrace time —
+   the cached step path dispatches the same executable bytes `jax.jit`
+   would have cached, with zero added per-step work.
+
+3. **`explain` report.** `python -m singa_tpu.introspect` (reusing
+   bench.py's model builders) prints params, GFLOPs/step, the HBM
+   breakdown, compile-phase times, recompile history, and — given an
+   xplane dir — the top-K ops by device time (`xprof.top_ops`).
+   `capture_hlo(dir)` additionally dumps each executable's HLO text
+   (manifest + fingerprint); FlightRecorder bundles reference the
+   manifest so an anomaly dump pins the exact executable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+from . import config, observe
+
+# ---- enums (the lint in tools/check_metrics_names.py greps these) ---------
+
+#: Low-cardinality blame reasons for `singa_recompile_total{reason=...}`.
+#: batch_bucket: only a leading (batch) dim changed — the detail string
+#:   names the power-of-two batch-size class crossed (PR 1's framing).
+#: shape: a non-batch dim changed. dtype: a leaf dtype flipped.
+#: new_step_tag: a different static step tag (DistOpt partial rotation).
+#: static_args / arg_count / donation: the non-array signature changed.
+#: new_function: an identical signature rebuilt from a fresh callable
+#:   (e.g. a re-built serving decode fn for the same shapes).
+#: unknown: none of the tracked fields differ — should not appear in
+#:   practice; its presence is itself a signal the blame logic is blind.
+RECOMPILE_REASONS = ("batch_bucket", "shape", "dtype", "new_step_tag",
+                     "static_args", "arg_count", "donation",
+                     "new_function", "unknown")
+REASON_BATCH_BUCKET = "batch_bucket"
+REASON_SHAPE = "shape"
+REASON_DTYPE = "dtype"
+REASON_NEW_STEP_TAG = "new_step_tag"
+REASON_STATIC_ARGS = "static_args"
+REASON_ARG_COUNT = "arg_count"
+REASON_DONATION = "donation"
+REASON_NEW_FUNCTION = "new_function"
+REASON_UNKNOWN = "unknown"
+
+#: Build phases for `singa_compile_phase_seconds{phase=...}`: trace (the
+#: python step function -> jaxpr), lower (jaxpr -> StableHLO), compile
+#: (the XLA backend build — on TPU by far the dominant term).
+COMPILE_PHASES = ("trace", "lower", "compile")
+PHASE_TRACE = "trace"
+PHASE_LOWER = "lower"
+PHASE_COMPILE = "compile"
+
+#: Executable keys (the `key=` label on the gauges/histograms above).
+EXEC_KEYS = ("step", "eval", "serving.prefill", "serving.decode_scan",
+             "serving.beam")
+
+# ---- per-platform peaks (public spec sheets; shared with bench.py) --------
+
+#: Dense bf16 peak TFLOP/s by TPU generation.
+PEAK_TFLOPS_BF16 = [
+    ("v6", 918.0), ("trillium", 918.0),
+    ("v5p", 459.0),
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5litepod", 197.0),
+    ("v5", 459.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+
+#: HBM bandwidth GB/s by generation (roofline readouts).
+PEAK_HBM_GBS = [
+    ("v6", 1638.0), ("trillium", 1638.0),
+    ("v5p", 2765.0),
+    ("v5 lite", 819.0), ("v5e", 819.0), ("v5litepod", 819.0),
+    ("v5", 2765.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+]
+
+
+def chip_peak(device_kind: str, table):
+    kind = (device_kind or "").lower()
+    for key, peak in table:
+        if key in kind:
+            return peak
+    return None
+
+
+_peak_override: "float | None" = None
+
+
+def set_peak_tflops(v: "float | None"):
+    """Override the platform peak used by the MFU gauge (None = table)."""
+    global _peak_override
+    _peak_override = float(v) if v else None
+    return _peak_override
+
+
+def peak_tflops(device_kind: "str | None" = None) -> "float | None":
+    """Peak TFLOP/s for MFU: explicit override > SINGA_TPU_PEAK_TFLOPS /
+    config.PEAK_TFLOPS > the per-generation table for `device_kind`."""
+    if _peak_override is not None:
+        return _peak_override
+    cfg = getattr(config, "PEAK_TFLOPS", None)
+    if cfg:
+        return float(cfg)
+    kind = device_kind if device_kind is not None else _step_device_kind
+    return chip_peak(kind or "", PEAK_TFLOPS_BF16)
+
+
+# ---- state -----------------------------------------------------------------
+
+MAX_HISTORY = 64
+
+_history: dict = {}    # key -> [signature dicts]
+_builds: dict = {}     # key -> [build records]
+_blames: list = []     # chronological blame records
+_manifest: list = []   # executable manifest ({key, fingerprint, hlo_path})
+_hlo_dir: "str | None" = None
+_step_flops = 0.0
+_step_device_kind = ""
+
+
+def reset():
+    """Clear all introspection state (tests: the conftest metric-isolation
+    fixture calls this next to MetricsRegistry.reset)."""
+    global _hlo_dir, _step_flops, _step_device_kind, _peak_override
+    _history.clear()
+    _builds.clear()
+    del _blames[:]
+    del _manifest[:]
+    _hlo_dir = None
+    _step_flops = 0.0
+    _step_device_kind = ""
+    _peak_override = None
+    observe.set_step_callback(None)
+
+
+# ---- abstract call signatures ---------------------------------------------
+
+def _aval(a):
+    shape = getattr(a, "shape", None)
+    dt = getattr(a, "dtype", None)
+    return (tuple(shape) if shape is not None else (),
+            str(dt) if dt is not None else type(a).__name__)
+
+
+def signature(args, names=None, tag=None, static=None, donated=(),
+              batch_hint=None):
+    """Abstract call signature of a positional-arg tuple: one
+    (name, shape, dtype) entry per array leaf (containers expand to
+    `name0`, `name1`, ...), plus the non-array dimensions a retrace can
+    key on — step tag, static-arg repr, donation set, and the true batch
+    size (`batch_hint`) when the traced leading dim is a padded bucket."""
+    import jax
+    leaves = []
+    seq = args if isinstance(args, (tuple, list)) else (args,)
+    for i, a in enumerate(seq):
+        nm = names[i] if names and i < len(names) else f"a{i}"
+        if isinstance(a, (tuple, list, dict)):
+            flat, _ = jax.tree_util.tree_flatten(a)
+            for j, leaf in enumerate(flat):
+                leaves.append((f"{nm}{j}",) + _aval(leaf))
+        else:
+            leaves.append((nm,) + _aval(a))
+    return {"tag": tag, "static": static, "donated": tuple(donated),
+            "leaves": leaves,
+            "batch_hint": int(batch_hint) if batch_hint else None}
+
+
+def _bucket(n) -> int:
+    """Power-of-two batch-size class containing n (PR 1's batch_class)."""
+    n = int(n)
+    return n if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def blame(prev: dict, cur: dict):
+    """Diff two signatures into (reason, detail). `reason` is always a
+    member of RECOMPILE_REASONS; `detail` is the human-readable one-liner
+    that lands in the EventLog record."""
+    if prev.get("tag") != cur.get("tag"):
+        return (REASON_NEW_STEP_TAG,
+                f"step tag {prev.get('tag')}->{cur.get('tag')}")
+    if prev.get("static") != cur.get("static"):
+        return (REASON_STATIC_ARGS,
+                f"static args {prev.get('static')}->{cur.get('static')}")
+    if prev.get("donated") != cur.get("donated"):
+        return (REASON_DONATION,
+                f"donated argnums {prev.get('donated')}"
+                f"->{cur.get('donated')}")
+    pl = {n: (s, d) for n, s, d in prev["leaves"]}
+    cl = {n: (s, d) for n, s, d in cur["leaves"]}
+    if set(pl) != set(cl):
+        added = sorted(set(cl) - set(pl))[:4]
+        gone = sorted(set(pl) - set(cl))[:4]
+        return (REASON_ARG_COUNT,
+                f"{len(pl)}->{len(cl)} array args"
+                + (f" (+{','.join(added)})" if added else "")
+                + (f" (-{','.join(gone)})" if gone else ""))
+    for n, cs, cd in cur["leaves"]:
+        ps, pd = pl[n]
+        if pd != cd:
+            return REASON_DTYPE, f"arg `{n}` dtype {pd}->{cd}"
+    for n, cs, cd in cur["leaves"]:
+        ps, _pd = pl[n]
+        if ps == cs:
+            continue
+        if ps and cs and len(ps) == len(cs) and ps[1:] == cs[1:]:
+            ho = prev.get("batch_hint") or ps[0]
+            hn = cur.get("batch_hint") or cs[0]
+            bo, bn = _bucket(ho), _bucket(hn)
+            if bo != bn:
+                return (REASON_BATCH_BUCKET,
+                        f"arg `{n}` batch {ho}->{hn} "
+                        f"crossed bucket {bo}->{bn}")
+            return (REASON_BATCH_BUCKET,
+                    f"arg `{n}` batch {ho}->{hn} within bucket {bn}")
+        return REASON_SHAPE, f"arg `{n}` shape {ps}->{cs}"
+    return (REASON_NEW_FUNCTION,
+            "identical signature rebuilt from a fresh callable")
+
+
+def _nearest(history, sig):
+    """The prior signature with the fewest differences from `sig`, so the
+    blame names what actually changed rather than diffing against an
+    arbitrary ancestor (e.g. a long-gone step tag)."""
+    best, best_score = None, None
+    for prev in reversed(history):
+        score = 0
+        if prev.get("tag") != sig.get("tag"):
+            score += 100
+        if prev.get("static") != sig.get("static"):
+            score += 100
+        pl = {n: (s, d) for n, s, d in prev["leaves"]}
+        cl = {n: (s, d) for n, s, d in sig["leaves"]}
+        score += 10 * len(set(pl) ^ set(cl))
+        score += sum(1 for n in set(pl) & set(cl) if pl[n] != cl[n])
+        if best_score is None or score < best_score:
+            best, best_score = prev, score
+            if score == 0:
+                break
+    return best
+
+
+# ---- metric plumbing (enum-guarded: see tools/check_metrics_names.py) -----
+
+def _count_recompile(reason, key):
+    if reason not in RECOMPILE_REASONS:
+        reason = REASON_UNKNOWN
+    if observe.is_enabled():
+        observe.counter(
+            "singa_recompile_total",
+            "retraces after the first compile, by structured blame reason"
+        ).inc(reason=reason, key=key)
+
+
+def _observe_phase(phase, key, seconds):
+    assert phase in COMPILE_PHASES, phase
+    if observe.is_enabled():
+        observe.histogram(
+            "singa_compile_phase_seconds",
+            "AOT build wall seconds per phase (trace|lower|compile)"
+        ).observe(seconds, phase=phase, key=key)
+
+
+def _set_hbm_gauges(mem, key):
+    # spelled out (no loop over a name table) so the static metric-name
+    # lint sees every registration
+    if not observe.is_enabled():
+        return
+    if "arguments" in mem:
+        observe.gauge("singa_hbm_arguments_bytes",
+                      "executable argument-buffer bytes"
+                      ).set(float(mem["arguments"]), key=key)
+    if "outputs" in mem:
+        observe.gauge("singa_hbm_outputs_bytes",
+                      "executable output-buffer bytes"
+                      ).set(float(mem["outputs"]), key=key)
+    if "temps" in mem:
+        observe.gauge("singa_hbm_temps_bytes",
+                      "executable temporary-buffer bytes"
+                      ).set(float(mem["temps"]), key=key)
+    if "generated_code" in mem:
+        observe.gauge("singa_hbm_generated_code_bytes",
+                      "executable generated-code bytes"
+                      ).set(float(mem["generated_code"]), key=key)
+
+
+def note_step_flops(flops):
+    """Record the flops of the step executable actually being dispatched
+    (model.py calls this on variant switch), so MFU is computed with the
+    running variant's flops rather than the most recently BUILT one —
+    a partial-batch build must not skew later full-batch readings."""
+    global _step_flops
+    _step_flops = float(flops or 0.0)
+
+
+def _mfu_callback(seconds):
+    """Fed each step's wall seconds by observe.record_step (un-fenced
+    dispatch time) and record_step_fenced (honest device latency, when
+    verbosity profiling is on). Un-fenced dispatch on an async backend
+    can return in microseconds while the device still computes; in
+    steady state it converges to the true step time (the loop is
+    device-throughput-bound), but a sample implying more than the
+    hardware peak is physically impossible and is DROPPED rather than
+    poisoning the gauge — the same mfu_suspect guard bench.py applies."""
+    peak = peak_tflops(_step_device_kind)
+    if not peak or not _step_flops or seconds <= 0:
+        return
+    mfu = _step_flops / seconds / 1e12 / peak * 100.0
+    if mfu > 100.0 and _peak_override is None:
+        return  # async-dispatch artifact, not physics
+    observe.gauge(
+        "singa_mfu_pct",
+        "model flops utilization of the last step, percent of the "
+        "platform bf16 peak (flops/step / step_seconds / peak)"
+    ).set(mfu)
+
+
+# ---- harvesting ------------------------------------------------------------
+
+def _harvest_cost(compiled) -> dict:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def _harvest_memory(compiled, args) -> dict:
+    mem = {}
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is not None:
+        for field, name in (("argument_size_in_bytes", "arguments"),
+                            ("output_size_in_bytes", "outputs"),
+                            ("temp_size_in_bytes", "temps"),
+                            ("generated_code_size_in_bytes",
+                             "generated_code")):
+            v = getattr(ma, field, None)
+            if v is not None:
+                mem[name] = int(v)
+    if not mem.get("arguments"):
+        # backends without memory stats: the argument bytes at least are
+        # always derivable from the abstract inputs
+        import jax
+        flat, _ = jax.tree_util.tree_flatten(args)
+        mem["arguments"] = int(sum(
+            int(getattr(a, "nbytes", 0) or 0) for a in flat))
+    return mem
+
+
+def _write_hlo(compiled, key, fingerprint):
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return None
+    try:
+        os.makedirs(_hlo_dir, exist_ok=True)
+        safe = key.replace(".", "_").replace("/", "_")
+        sha = hashlib.sha256(text.encode()).hexdigest()[:16]
+        path = os.path.join(_hlo_dir, f"{safe}_{sha}.hlo.txt")
+        if not os.path.exists(path):
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(text)
+        with open(os.path.join(_hlo_dir, "manifest.jsonl"), "a",
+                  encoding="utf-8") as f:
+            f.write(json.dumps(
+                {"key": key, "fingerprint": fingerprint, "hlo_sha": sha,
+                 "path": path, "ts": round(time.time(), 6)}) + "\n")
+        return path
+    except OSError:
+        return None
+
+
+def capture_hlo(dir_path: "str | None"):
+    """Enable (path) or disable (None) per-executable HLO-text capture.
+    Each later build writes `<key>_<sha>.hlo.txt` plus a `manifest.jsonl`
+    line under the directory; the in-memory `executable_manifest()` (and
+    through it every FlightRecorder bundle header) carries the paths."""
+    global _hlo_dir
+    _hlo_dir = str(dir_path) if dir_path else None
+    return _hlo_dir
+
+
+def executable_manifest():
+    """Every AOT-built executable this process has seen: {key,
+    fingerprint, hlo_path (when capture_hlo was on), ts}."""
+    return [dict(e) for e in _manifest]
+
+
+def last_build(key: str) -> "dict | None":
+    """The most recent build record for `key` (phases, cost, memory,
+    blame) — bench.py --explain reads this."""
+    recs = _builds.get(key)
+    return dict(recs[-1]) if recs else None
+
+
+def blame_history():
+    """Chronological recompile-blame records ({key, reason, detail, ...})."""
+    return [dict(b) for b in _blames]
+
+
+# ---- the AOT build ---------------------------------------------------------
+
+def build_compiled(fn, args, key, sig=None, device=None):
+    """Build `fn` (a jax.jit-wrapped callable) for `args` through the
+    explicit trace -> lower -> compile stages.
+
+    Times each phase into `singa_compile_phase_seconds`, harvests cost /
+    memory analysis into the `singa_xla_*` / `singa_hbm_*` gauges,
+    registers the signature for recompile blame, and returns
+    (compiled_executable, build_record). Returns (None, None) when AOT
+    staging fails for any reason — the caller falls back to the plain jit
+    call, so telemetry can never break dispatch.
+    """
+    if sig is None:
+        sig = signature(args)
+    t0 = time.perf_counter()
+    try:
+        if hasattr(fn, "trace"):
+            traced = fn.trace(*args)
+            t1 = time.perf_counter()
+            lowered = traced.lower()
+        else:
+            # pre-0.4.30 jax: no Traced stage; trace+lower are one call
+            t1 = t0
+            lowered = fn.lower(*args)
+        t2 = time.perf_counter()
+        compiled = lowered.compile()
+        t3 = time.perf_counter()
+    except Exception:
+        return None, None
+    phases = {"trace": t1 - t0, "lower": t2 - t1, "compile": t3 - t2}
+    _observe_phase(PHASE_TRACE, key, phases["trace"])
+    _observe_phase(PHASE_LOWER, key, phases["lower"])
+    _observe_phase(PHASE_COMPILE, key, phases["compile"])
+    cost = _harvest_cost(compiled)
+    mem = _harvest_memory(compiled, args)
+    if observe.is_enabled():
+        observe.gauge("singa_xla_flops_per_step",
+                      "XLA cost-analysis flops of the compiled executable"
+                      ).set(float(cost.get("flops", 0.0) or 0.0), key=key)
+        observe.gauge("singa_xla_bytes_accessed",
+                      "XLA cost-analysis bytes accessed per execution"
+                      ).set(float(cost.get("bytes accessed", 0.0) or 0.0),
+                            key=key)
+        _set_hbm_gauges(mem, key)
+    fingerprint = hashlib.sha256(
+        (key + "|" + json.dumps(
+            {"tag": sig.get("tag"), "static": sig.get("static"),
+             "donated": list(sig.get("donated") or ()),
+             "leaves": [[n, list(s), d] for n, s, d in sig["leaves"]]},
+            sort_keys=True, default=str)).encode()).hexdigest()[:16]
+    hlo_path = _write_hlo(compiled, key, fingerprint) if _hlo_dir else None
+    rec = {"key": key, "fingerprint": fingerprint, "phases": phases,
+           "cost": cost, "memory": mem, "hlo_path": hlo_path,
+           "ts": round(time.time(), 6)}
+    _register_build(key, sig, rec, device=device)
+    return compiled, rec
+
+
+def _register_build(key, sig, rec, device=None):
+    hist = _history.setdefault(key, [])
+    recompile = bool(hist)
+    reason = detail = None
+    if recompile:
+        reason, detail = blame(_nearest(hist, sig), sig)
+        _count_recompile(reason, key)
+        _blames.append({"key": key, "reason": reason, "detail": detail,
+                        "fingerprint": rec["fingerprint"],
+                        "ts": rec["ts"]})
+        del _blames[:-4 * MAX_HISTORY]
+    hist.append(sig)
+    del hist[:-MAX_HISTORY]
+    rec.update({"recompile": recompile, "reason": reason, "detail": detail})
+    _builds.setdefault(key, []).append(rec)
+    del _builds[key][:-MAX_HISTORY]
+    _manifest.append({"key": key, "fingerprint": rec["fingerprint"],
+                      "hlo_path": rec["hlo_path"], "ts": rec["ts"]})
+    del _manifest[:-4 * MAX_HISTORY]
+    if observe.is_enabled():
+        observe.get_registry().emit({
+            "kind": "recompile" if recompile else "compile",
+            "key": key, "reason": reason, "detail": detail,
+            "fingerprint": rec["fingerprint"],
+            "phases": {k: round(v, 6) for k, v in rec["phases"].items()},
+            "flops": rec["cost"].get("flops"),
+        })
+    if key == "step":
+        global _step_flops, _step_device_kind
+        _step_flops = float(rec["cost"].get("flops", 0.0) or 0.0)
+        if device is not None:
+            _step_device_kind = getattr(
+                device.jax_device, "device_kind", "") or ""
+            if rec["cost"]:
+                # refresh on EVERY step build (not just the first): after
+                # a retrace, PrintTimeProfiling must report the current
+                # variant's flops, and an empty {} seeded by the model's
+                # profiling fallback must not pin the field forever
+                device.cost_analysis = dict(rec["cost"])
+        if _step_flops > 0:
+            observe.set_step_callback(_mfu_callback)
+
+
+_AOT_MISS = object()  # "no cache entry" (a stored None = negative-cached)
+
+
+class AotExecutor:
+    """Wrap a jitted callable so every distinct abstract signature is
+    built through `build_compiled` (phase timing, cost/memory harvest,
+    recompile blame) and later calls dispatch the cached executable.
+    Falls back to the plain jit call when staging or dispatch fails —
+    jit then (re)traces exactly as it always did; a failed signature is
+    negative-cached so the fallback never re-pays staging per call."""
+
+    __slots__ = ("fn", "key", "names", "_execs")
+
+    def __init__(self, fn, key, names=None):
+        self.fn = fn
+        self.key = key
+        self.names = names
+        self._execs = {}
+
+    def _sig_key(self, args):
+        import jax
+        flat, _ = jax.tree_util.tree_flatten(args)
+        return tuple(_aval(a) for a in flat)
+
+    def __call__(self, *args):
+        k = self._sig_key(args)
+        ex = self._execs.get(k, _AOT_MISS)
+        if ex is _AOT_MISS:
+            sig = signature(args, names=self.names)
+            ex, _rec = build_compiled(self.fn, args, self.key, sig)
+            self._execs[k] = ex  # None negative-caches failed staging
+        if ex is None:
+            return self.fn(*args)
+        try:
+            return ex(*args)
+        except Exception:
+            self._execs[k] = None
+            return self.fn(*args)
+
+
+# ---- the explain report ----------------------------------------------------
+
+def explain(model=None, device=None, xplane=None, top=10) -> dict:
+    """Gather everything this module knows into one report dict:
+    per-key build records, recompile history, the executable manifest,
+    and (given a model/device) params, GFLOPs/step, the HBM breakdown,
+    mean step time, achieved TFLOP/s and MFU; with `xplane`, the top-K
+    ops by measured device time."""
+    import numpy as np
+    rep = {
+        "builds": {k: [dict(r) for r in v] for k, v in _builds.items()},
+        "recompiles": blame_history(),
+        "executables": executable_manifest(),
+    }
+    if model is not None:
+        try:
+            rep["params"] = int(sum(
+                int(np.prod(t.shape)) if t.shape else 1
+                for t in model.get_params().values()))
+        except Exception:
+            pass
+    step = last_build("step")
+    flops = 0.0
+    if step:
+        flops = float(step["cost"].get("flops", 0.0) or 0.0)
+        rep["gflops_per_step"] = flops / 1e9
+        rep["bytes_accessed_per_step"] = float(
+            step["cost"].get("bytes accessed", 0.0) or 0.0)
+        rep["hbm"] = dict(step.get("memory") or {})
+        rep["compile_phases_s"] = {
+            k: round(v, 6) for k, v in (step.get("phases") or {}).items()}
+        rep["fingerprint"] = step.get("fingerprint")
+    if device is not None and device.step_times:
+        mean_s = sum(device.step_times) / len(device.step_times)
+        rep["step_ms_mean"] = mean_s * 1e3
+        if flops and mean_s > 0:
+            ach = flops / mean_s / 1e12
+            rep["achieved_tflops"] = ach
+            peak = peak_tflops(
+                getattr(device.jax_device, "device_kind", ""))
+            if peak:
+                rep["peak_tflops"] = peak
+                rep["mfu_pct"] = ach / peak * 100.0
+    if xplane:
+        from . import xprof
+        rep["top_ops"] = [
+            {"op": r["op"], "category": r["category"],
+             "total_ms": round(r["total_ms"], 3),
+             "pct": round(r["pct"], 1)}
+            for r in xprof.top_ops(xplane, top)]
+    return rep
+
+
+def _mb(b):
+    return f"{(b or 0) / 1e6:.2f} MB"
+
+
+def format_explain(rep: dict) -> str:
+    lines = ["== singa_tpu introspect: compile & memory explain =="]
+    if "params" in rep:
+        lines.append(f"params: {rep['params'] / 1e6:.3f} M")
+    if "gflops_per_step" in rep:
+        lines.append(f"step executable [{rep.get('fingerprint', '?')}]: "
+                     f"{rep['gflops_per_step']:.4f} GFLOP/step, "
+                     f"{_mb(rep.get('bytes_accessed_per_step'))} accessed")
+    ph = rep.get("compile_phases_s")
+    if ph:
+        lines.append("  compile phases: " + "  ".join(
+            f"{p} {ph.get(p, 0.0):.3f}s" for p in COMPILE_PHASES))
+    hbm = rep.get("hbm")
+    if hbm:
+        lines.append("  HBM: " + " | ".join(
+            f"{k} {_mb(v)}" for k, v in sorted(hbm.items())))
+    if "step_ms_mean" in rep:
+        tail = ""
+        if "achieved_tflops" in rep:
+            tail = f" -> {rep['achieved_tflops']:.4f} TFLOP/s achieved"
+            if "mfu_pct" in rep:
+                tail += (f" (MFU {rep['mfu_pct']:.2f}% of "
+                         f"{rep['peak_tflops']:g} peak)")
+        lines.append(f"  step time: {rep['step_ms_mean']:.3f} ms mean"
+                     + tail)
+    for key, recs in sorted(rep.get("builds", {}).items()):
+        if key == "step":
+            continue
+        r = recs[-1]
+        fl = float(r["cost"].get("flops", 0.0) or 0.0)
+        lines.append(f"{key} executable [{r['fingerprint']}]: "
+                     f"{fl / 1e9:.4f} GFLOP, compile "
+                     f"{r['phases'].get('compile', 0.0):.3f}s")
+    blames = rep.get("recompiles", [])
+    lines.append(f"recompile history ({len(blames)}):")
+    for b in blames:
+        lines.append(f"  [{b['key']}] {b['reason']}: {b['detail']}")
+    execs = rep.get("executables", [])
+    if execs:
+        lines.append(f"executables ({len(execs)}):")
+        for e in execs:
+            lines.append(f"  {e['key']}@{e['fingerprint']}"
+                         + (f"  hlo: {e['hlo_path']}" if e.get("hlo_path")
+                            else ""))
+    tops = rep.get("top_ops")
+    if tops:
+        lines.append(f"top {len(tops)} ops by device time (xplane):")
+        for r in tops:
+            lines.append(f"  {r['op'][:60]:<60} {r['total_ms']:>8.3f} ms "
+                         f"{r['pct']:>5.1f}%")
+    return "\n".join(lines)
+
+
+# ---- CLI: python -m singa_tpu.introspect ----------------------------------
+
+_CLI_PRESETS = {
+    # reuse bench.py's builders (build_bench_model) so the explain report
+    # describes the exact executables the bench times
+    "tiny": dict(model="mlp", batch=8, size=16),
+    "mlp": dict(model="mlp", batch=32, size=64),
+    "cnn": dict(model="cnn", batch=4, size=28),
+    "resnet18": dict(model="resnet18", batch=4, size=32),
+    "gpt": dict(model="gpt", batch=2, size=64,
+                gpt_dim=128, gpt_layers=2, gpt_heads=4),
+}
+
+
+def _build_cli_model(cfg: str):
+    import sys
+    try:
+        import bench
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import bench
+    return bench.build_bench_model(**_CLI_PRESETS[cfg])
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m singa_tpu.introspect",
+        description="Compile & memory explain report: build a bench "
+                    "model, run a few steps through the AOT-staged path, "
+                    "and print GFLOPs/step, the HBM breakdown, "
+                    "compile-phase times and the recompile history.")
+    ap.add_argument("--config", default="tiny",
+                    choices=sorted(_CLI_PRESETS))
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--no-retrace", dest="retrace", action="store_false",
+                    default=True,
+                    help="skip the 3/4-batch re-step that demonstrates "
+                         "recompile blame")
+    ap.add_argument("--xplane", default=None, metavar="DIR",
+                    help="xplane trace dir: append the top-K ops by "
+                         "measured device time (xprof.top_ops)")
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--hlo-dir", default=None, metavar="DIR",
+                    help="capture each executable's HLO text + manifest")
+    ap.add_argument("--peak-tflops", type=float, default=None,
+                    help="override the platform peak for the MFU line")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+    import jax
+    from . import opt as opt_mod, tensor
+    if args.peak_tflops:
+        set_peak_tflops(args.peak_tflops)
+    if args.hlo_dir:
+        capture_hlo(args.hlo_dir)
+    m, tx, ty, _items, _unit, _factory = _build_cli_model(args.config)
+    dev = tx.device
+    m.set_optimizer(opt_mod.SGD(lr=0.1, momentum=0.9))
+    m.compile([tx], is_train=True, use_graph=True)
+    dev.SetVerbosity(1)
+    dev.SetSkipIteration(0)
+    for _ in range(max(args.steps, 1)):
+        m(tx, ty)
+    b = int(tx.shape[0])
+    if args.retrace and b >= 4:
+        nb = (3 * b) // 4
+        x2 = np.asarray(jax.device_get(tx.data))[:nb]
+        y2 = np.asarray(jax.device_get(ty.data))[:nb]
+        m(tensor.Tensor(data=x2, device=dev),
+          tensor.from_numpy(y2, device=dev))
+    rep = explain(model=m, device=dev, xplane=args.xplane, top=args.top)
+    if args.json:
+        print(json.dumps(rep, default=str))
+    else:
+        print(format_explain(rep))
+    return 0
+
+
+__all__ = [
+    "RECOMPILE_REASONS", "COMPILE_PHASES", "EXEC_KEYS",
+    "PEAK_TFLOPS_BF16", "PEAK_HBM_GBS", "chip_peak",
+    "set_peak_tflops", "peak_tflops",
+    "signature", "blame", "build_compiled", "AotExecutor",
+    "note_step_flops",
+    "capture_hlo", "executable_manifest", "last_build", "blame_history",
+    "explain", "format_explain", "reset", "main",
+]
+
+
+if __name__ == "__main__":
+    import sys as _sys
+    # run through the canonical package module so CLI state (hlo capture,
+    # peak override) and the model's build records live in ONE instance
+    from singa_tpu import introspect as _canonical
+    _sys.exit(_canonical.main())

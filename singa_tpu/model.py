@@ -31,9 +31,13 @@ from jax import lax
 from . import _compat  # noqa: F401  (installs jax.shard_map on old jax)
 from . import autograd
 from . import health
+from . import introspect
 from . import observe
 from .layer import Layer, LayerMeta
 from .tensor import Tensor
+
+
+_AOT_MISS = introspect._AOT_MISS  # shared "no cache entry yet" sentinel
 
 
 def _flatten_out(out):
@@ -109,6 +113,8 @@ class Model(Layer, metaclass=ModelMeta):
         self._optimizer = None
         self._device = None
         self._compiled_step = None
+        self._step_execs = {}   # AOT executables per abstract signature
+        self._eval_execs = {}
         self._step_stats = {"compile_s": 0.0, "steps": 0}
         self._health_monitor = None
         self._health_steps = 0
@@ -140,7 +146,9 @@ class Model(Layer, metaclass=ModelMeta):
         self.sequential = sequential
         if isinstance(self._compiled_step, dict):
             self._compiled_step = {}   # drop stale-flag executables
+            self._step_execs = {}
         self._compiled_eval = None
+        self._eval_execs = {}
 
     def compile(self, inputs, is_train=True, use_graph=False,
                 sequential=False, pipeline_axis=None, n_micro=1,
@@ -456,6 +464,7 @@ class Model(Layer, metaclass=ModelMeta):
         self._out_template_box = out_template_box
         self._step_builder = make_step
         self._compiled_step = {}   # step-tag -> jitted executable
+        self._step_execs = {}      # (tag, abstract sig) -> AOT executable
         self._step_sigs = set()    # (tag, input shapes) variants seen
         self._step_stats["compile_s"] = time.perf_counter() - t0
         observe.record_step_build(self._step_stats["compile_s"])
@@ -528,9 +537,54 @@ class Model(Layer, metaclass=ModelMeta):
             fn = self._compiled_step[tag] = self._step_builder(tag)
         obs = observe.is_enabled()
         bs = None
+        if input_arrs and getattr(input_arrs[0], "ndim", 0):
+            bs = input_arrs[0].shape[0]
+        step_fn = fn
+        exec_key = None
+        if not self.sequential:
+            # AOT executable per abstract signature: the explicit
+            # trace -> lower -> compile staging happens on a cache miss
+            # ONLY, so compile-phase timing, cost/memory harvesting and
+            # recompile blame all land at build/retrace time; the cached
+            # path below dispatches the same executable bytes jit would
+            # have cached, with zero added per-step work. len(opt_arrs)
+            # is in the key because the sparse strategies GROW their
+            # optimizer state (new residual slots) between steps.
+            exec_key = (tag,
+                        tuple((tuple(a.shape), str(a.dtype))
+                              for a in input_arrs),
+                        len(opt_arrs))
+            entry = self._step_execs.get(exec_key, _AOT_MISS)
+            if entry is _AOT_MISS:
+                asig = introspect.signature(
+                    (state_arrs, opt_arrs, rng, input_arrs),
+                    names=("state", "opt", "rng", "arg"), tag=tag,
+                    static=repr(sorted(
+                        (i, repr(v))
+                        for i, v in self._static_args.items())),
+                    donated=(0, 1), batch_hint=bs)
+                aot, rec = introspect.build_compiled(
+                    fn, (state_arrs, opt_arrs, rng, input_arrs),
+                    "step", asig, device=dev)
+                # a failed build negative-caches as None so the cached
+                # path never re-pays a staging attempt per step
+                entry = self._step_execs[exec_key] = None if aot is None \
+                    else (aot, float((rec or {}).get("cost", {})
+                                     .get("flops", 0) or 0))
+            if entry is not None:
+                step_fn, aot_flops = entry
+                # the MFU gauge must use the DISPATCHED variant's flops,
+                # not the most recently built one (a partial-batch build
+                # would otherwise skew later full-batch readings)
+                introspect.note_step_flops(aot_flops)
+            else:
+                # negative-cached: this variant dispatches via plain jit
+                # and has no harvested flops — zero disables the MFU
+                # gauge rather than feeding it a stale variant's count
+                introspect.note_step_flops(0)
+        else:
+            introspect.note_step_flops(0)  # sequential: no AOT variant
         if obs:
-            if input_arrs and getattr(input_arrs[0], "ndim", 0):
-                bs = input_arrs[0].shape[0]
             # (tag, input-shape) signature: jit retraces exactly when it
             # changes, so first-seen == a compile (first ever) or a
             # recompile (new batch-size class / step tag)
@@ -549,8 +603,20 @@ class Model(Layer, metaclass=ModelMeta):
                 dev.cost_analysis = self.step_cost_analysis() \
                     if self._step_stats["steps"] > 0 else {}
             t0 = time.perf_counter()
-        new_states, new_opt, new_rng, outs, hstats = fn(
-            state_arrs, opt_arrs, rng, input_arrs)
+        try:
+            new_states, new_opt, new_rng, outs, hstats = step_fn(
+                state_arrs, opt_arrs, rng, input_arrs)
+        except Exception:
+            if step_fn is fn:
+                raise
+            # the AOT executable rejected the call (e.g. an optimizer
+            # slot changed shape in place, invisible to exec_key):
+            # negative-cache the signature so jit owns it from now on —
+            # correctness over telemetry, and no rebuild-per-step churn
+            self._step_execs[exec_key] = None
+            introspect.note_step_flops(0)  # this step is jit-dispatched
+            new_states, new_opt, new_rng, outs, hstats = fn(
+                state_arrs, opt_arrs, rng, input_arrs)
         if profiling:
             jax.block_until_ready(new_states)
             fenced = time.perf_counter() - t0
@@ -717,6 +783,30 @@ class Model(Layer, metaclass=ModelMeta):
 
     # ---- jitted inference (graph mode for eval; the reference replays its
     # buffered graph for eval too, model.py:94-100) ------------------------
+    def _eval_invoke(self, concrete, arrs, nb=None):
+        """Eval forward through the AOT-staged executable cache: one
+        executable per abstract input signature, built via
+        introspect.build_compiled (compile-phase timing + recompile
+        blame; `nb` is the PRE-padding batch so a bucket crossing blames
+        the true sizes). Falls back to the plain jit call when staging
+        or dispatch fails."""
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
+        aot = self._eval_execs.get(key, _AOT_MISS)
+        if aot is _AOT_MISS:
+            asig = introspect.signature(
+                (concrete, arrs), names=("state", "arg"), batch_hint=nb)
+            aot, _rec = introspect.build_compiled(
+                self._compiled_eval, (concrete, arrs), "eval", asig)
+            # None negative-caches a failed build: jit owns this shape
+            self._eval_execs[key] = aot
+        if aot is None:
+            return self._compiled_eval(concrete, arrs)
+        try:
+            return aot(concrete, arrs)
+        except Exception:
+            self._eval_execs[key] = None
+            return self._compiled_eval(concrete, arrs)
+
     def _eval_step(self, args):
         if getattr(self, "_compiled_eval", None) is None:
             states = self.get_states()
@@ -746,6 +836,7 @@ class Model(Layer, metaclass=ModelMeta):
 
             self._eval_tensors = eval_tensors
             self._compiled_eval = jax.jit(efwd)
+            self._eval_execs = {}
         concrete = [t.data for t in self._eval_tensors]
         # batch-shape bucketing: pad the batch dim up to the next power of
         # two so varying eval sizes (e.g. the last partial batch) reuse
@@ -780,7 +871,7 @@ class Model(Layer, metaclass=ModelMeta):
                 with jax.disable_jit():
                     outs = self._compiled_eval(concrete, arrs)
             else:
-                outs = self._compiled_eval(concrete, arrs)
+                outs = self._eval_invoke(concrete, arrs, nb)
         finally:
             # tracing assigns tracers into the state Tensors; put the real
             # arrays back so later eager/train calls see concrete buffers
@@ -815,8 +906,8 @@ class Model(Layer, metaclass=ModelMeta):
             if shaped and nb > 1:
                 h = nb // 2
                 try:
-                    houts = self._compiled_eval(
-                        concrete, [a[:h] for a in arrs])
+                    houts = self._eval_invoke(
+                        concrete, [a[:h] for a in arrs], h)
                     ok = all(
                         np.allclose(np.asarray(jax.device_get(ho)),
                                     np.asarray(jax.device_get(o))[:h],

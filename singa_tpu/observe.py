@@ -377,6 +377,16 @@ class MetricsRegistry:
 _default = MetricsRegistry()
 _enabled = True
 _tls = threading.local()
+_step_cb = None
+
+
+def set_step_callback(cb):
+    """Register (or clear with None) a hook fed each record_step's
+    dispatch wall seconds. singa_tpu.introspect uses it to derive the
+    `singa_mfu_pct` gauge from the AOT-harvested flops without adding
+    any work to the step path when no executable has been introspected."""
+    global _step_cb
+    _step_cb = cb
 
 
 def get_registry() -> MetricsRegistry:
@@ -571,6 +581,11 @@ def record_step(seconds: float, batch=None, tag=0, device=None):
     c.inc()
     if device is not None:
         record_hbm(device)
+    if _step_cb is not None:
+        try:
+            _step_cb(seconds)
+        except Exception:
+            pass  # a derived-metric hook must never break the step
     _default.emit({"kind": "step", "step": int(c.value()),
                    "seconds": round(seconds, 9),
                    "batch": batch, "tag": tag})
@@ -583,6 +598,13 @@ def record_step_fenced(seconds: float):
         return
     histogram("singa_step_fenced_seconds",
               "train step fenced wall seconds").observe(seconds)
+    if _step_cb is not None:
+        # fenced latency is the honest MFU denominator; feed it too (the
+        # callback drops physically impossible un-fenced samples itself)
+        try:
+            _step_cb(seconds)
+        except Exception:
+            pass
 
 
 def record_opt_update(n_params: int, seconds: float, strategy: str):
@@ -665,6 +687,7 @@ __all__ = [
     "span", "current_span", "get_registry", "enable", "is_enabled",
     "counter", "gauge", "histogram", "set_event_log", "get_event_log",
     "to_prometheus_text", "dump", "DEFAULT_BUCKETS", "SPAN_TRACE_PREFIX",
+    "set_step_callback",
     "record_step", "record_step_build", "record_step_fenced",
     "record_compile", "record_hbm", "record_opt_update", "record_comm",
     "record_decode", "record_bench",

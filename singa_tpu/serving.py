@@ -571,8 +571,18 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
             step, (tok0, caches, key, nf0), jnp.arange(max_new - 1))
         return jnp.concatenate([tok0[:, None], toks.T], axis=1), nf
 
-    prefill_jit = jax.jit(prefill_stage)
-    scan_jit = jax.jit(scan_stage)
+    # AOT-staged dispatch (singa_tpu.introspect): each distinct abstract
+    # signature is built through explicit trace/lower/compile stages, so
+    # serving compiles land in singa_compile_phase_seconds and a rebuilt
+    # decode fn (new batch/prompt/max_new) produces a recompile-blame
+    # record instead of a silent jit retrace
+    from . import introspect
+    prefill_jit = introspect.AotExecutor(
+        jax.jit(prefill_stage), "serving.prefill",
+        names=("params", "prompt", "key"))
+    scan_jit = introspect.AotExecutor(
+        jax.jit(scan_stage), "serving.decode_scan",
+        names=("params", "tok0", "caches", "key", "nf"))
 
     def decode(p, prompt, key):
         # the sync fences exist only to take honest TTFT/latency samples;
@@ -727,7 +737,9 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
             all_raw, best[:, None], axis=1)[:, 0]
         return jnp.concatenate([prompt, out], axis=1), best_score, nf
 
-    jitted = jax.jit(decode)
+    from . import introspect
+    jitted = introspect.AotExecutor(
+        jax.jit(decode), "serving.beam", names=("params", "prompt"))
 
     def run(p, prompt):
         import time as _time

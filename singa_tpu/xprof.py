@@ -336,6 +336,23 @@ def op_table(logdir: str, device_only: bool = True,
     return make_rows(total_ps, count) + make_rows(span_ps, span_count)
 
 
+def top_ops(path_or_table, k: int = 10):
+    """Top-k ops by total device time: the explain report's "where did
+    the step actually go" section. Accepts a trace logdir (runs
+    `op_table` on it) or an already-built op_table row list. Span
+    envelope rows are excluded — a span is host wall time AROUND the
+    device ops already in the ranking."""
+    rows = op_table(path_or_table) if isinstance(path_or_table, str) \
+        else [dict(r) for r in path_or_table]
+    # drop span envelopes and python-frame TraceMe rows ("$file.py:NN fn",
+    # present on CPU-only traces where host planes stand in for device
+    # planes) — neither is an op the device executed
+    rows = [r for r in rows if r.get("category") != "span"
+            and not r.get("op", "").startswith("$")]
+    rows.sort(key=lambda r: -r.get("total_ms", 0.0))
+    return rows[:int(k)]
+
+
 def span_table(logdir: str):
     """Just the observe.span() rows of op_table (category "span"),
     with the `singa.span/` prefix stripped — the bridge between the

@@ -39,10 +39,11 @@ def _metrics_isolation():
     (observe.MetricsRegistry.reset), no EventLog attached, and the
     instrumentation enabled — counter state accumulated by one test can
     no longer leak into another's assertions."""
-    from singa_tpu import observe
+    from singa_tpu import introspect, observe
     observe.get_registry().reset()
     observe.set_event_log(None)
     observe.enable(True)
+    introspect.reset()  # signature history / manifest / peak override
     yield
 
 

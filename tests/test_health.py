@@ -475,3 +475,21 @@ def test_decode_nan_logit_counter(dev):
     m.generate(tx, 3)
     c = observe.get_registry().get("singa_health_nan_logits_total")
     assert c is not None and c.value(kind="greedy") > 0
+
+
+def test_apply_skip_grown_opt_state():
+    """Slots created during the step (sparse error-feedback residuals)
+    must survive apply_skip: committed on healthy steps, rolled back to
+    their creation-time init (zeros) on anomaly — never zip-truncated
+    out of the step output."""
+    import jax.numpy as jnp
+    from singa_tpu import health
+    old = [jnp.ones(3)]
+    new = [jnp.full(3, 2.0), jnp.full(2, 5.0)]  # second slot grew in-step
+    out = health.apply_skip({"anomaly": jnp.int32(1)}, old, new)
+    assert len(out) == 2
+    assert np.allclose(np.asarray(out[0]), 1.0)  # rolled back
+    assert np.allclose(np.asarray(out[1]), 0.0)  # new slot -> its init
+    out = health.apply_skip({"anomaly": jnp.int32(0)}, old, new)
+    assert np.allclose(np.asarray(out[0]), 2.0)
+    assert np.allclose(np.asarray(out[1]), 5.0)

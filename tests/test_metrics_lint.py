@@ -91,3 +91,59 @@ def test_runtime_registry_enforces_same_contract():
     r.counter("singa_ok_total")
     with pytest.raises(ValueError):
         r.gauge("singa_ok_total")
+
+
+def test_lint_enum_label_values(tmp_path):
+    """ISSUE-3 satellite: reason=/phase= label values on record calls
+    must come from a declared enum tuple — literals must be members,
+    dynamic values only inside enum-guarded functions."""
+    f = tmp_path / "labels.py"
+    f.write_text(
+        "from singa_tpu import observe\n"
+        "RECOMPILE_REASONS = ('batch_bucket', 'dtype')\n"
+        "REASON_DTYPE = 'dtype'\n"
+        "REASON_ROGUE = 'rogue'\n"
+        # literal member: fine
+        "observe.counter('singa_r_total', 'a').inc(reason='dtype')\n"
+        # module constant that is a member: fine
+        "observe.counter('singa_r_total', 'a').inc(reason=REASON_DTYPE)\n"
+        # literal NON-member: violation
+        "observe.counter('singa_r_total', 'a').inc(reason='mystery')\n"
+        # constant non-member: violation
+        "observe.counter('singa_r_total', 'a').inc(reason=REASON_ROGUE)\n"
+        # dynamic value, no enum guard in the function: violation
+        "def unguarded(r):\n"
+        "    observe.counter('singa_r_total', 'a').inc(reason=r)\n"
+        # dynamic value behind a membership guard: fine
+        "def guarded(r):\n"
+        "    assert r in RECOMPILE_REASONS\n"
+        "    observe.counter('singa_r_total', 'a').inc(reason=r)\n"
+        # other label kwargs are not enum-checked
+        "observe.counter('singa_k_total', 'b').inc(kind='whatever')\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 3, problems
+    assert any("'mystery'" in p for p in problems)
+    assert any("REASON_ROGUE" in p for p in problems)
+    assert any("dynamic" in p for p in problems)
+
+
+def test_lint_phase_label_without_enum(tmp_path):
+    """A module recording phase= labels with no declared enum at all is
+    flagged on every use."""
+    f = tmp_path / "nophase.py"
+    f.write_text(
+        "from singa_tpu import observe\n"
+        "observe.histogram('singa_p_seconds', 'p').observe(1.0, "
+        "phase='trace')\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 1 and "phase=" in problems[0]
+
+
+def test_lint_introspect_enum_usage_clean():
+    """introspect.py's own reason=/phase= recording passes the enum
+    rule (it is part of the default scan, so test_package_metric_names
+    _clean covers it too — this pins the file specifically)."""
+    intro = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                         "introspect.py")
+    problems = check_metrics_names.check([intro])
+    assert problems == []

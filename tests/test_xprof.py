@@ -97,3 +97,20 @@ def test_span_table_depth_column(spandir):
     # every row has the column and it equals the path nesting
     for r in rows:
         assert r["depth"] == r["op"].count("/")
+
+
+def test_top_ops(tracedir):
+    """ISSUE-3 satellite: top_ops ranks device ops (spans excluded) and
+    accepts either a logdir or an existing op_table."""
+    rows = xprof.op_table(tracedir)
+    top = xprof.top_ops(tracedir, 5)
+    assert top and len(top) <= 5
+    assert top == xprof.top_ops(rows, 5)
+    assert all(r["category"] != "span" for r in top)
+    # python-frame TraceMe rows ("$file.py:NN fn") are filtered out
+    assert all(not r["op"].startswith("$") for r in top)
+    totals = [r["total_ms"] for r in top]
+    assert totals == sorted(totals, reverse=True)
+    kept = [r for r in rows if r["category"] != "span"
+            and not r["op"].startswith("$")]
+    assert top[0]["op"] == kept[0]["op"]

@@ -8,10 +8,14 @@
 #   4-5  exact GPT-2-small architecture (d768 L12 H12 V50257), bf16+int8
 #   6    long-prompt prefill receipt (4096-token prompt, flash prefill)
 #   7    16k-prompt single-stream prefill receipt
+# Extra args after OUT pass through to every bench_decode.py run, e.g.:
+#   tools/bench_decode_suite.sh BENCHDEC_r06.json --explain
 set -eo pipefail
 OUT="${1:-BENCHDEC_r05.json}"
+shift || true
+EXTRA=("$@")
 : > "$OUT"
-run() { python bench_decode.py "$@" | tail -1 >> "$OUT"; }
+run() { python bench_decode.py "$@" "${EXTRA[@]}" | tail -1 >> "$OUT"; }
 
 run --dim 1024 --layers 8 --heads 16 --vocab 8192  --batch 8 --prompt 128 --new 512 --dtype bfloat16
 run --dim 1024 --layers 8 --heads 16 --vocab 8192  --batch 8 --prompt 128 --new 512 --dtype int8

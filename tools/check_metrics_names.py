@@ -16,7 +16,16 @@ literal, then fails if
      convention — dashboards and recording rules key on it), or
   4. the same non-empty help string is registered for two DIFFERENT
      metric names (copy-pasted helps make /metrics output ambiguous;
-     every name must describe itself).
+     every name must describe itself), or
+  5. a `reason=` / `phase=` label value on a metric record call
+     (.inc/.set/.observe/.dec) does not come from a declared enum: these
+     labels are CONTRACTUALLY low-cardinality (introspect.py's
+     RECOMPILE_REASONS / COMPILE_PHASES), so a string literal must be a
+     member of a module-level ALL-CAPS tuple of string literals, a NAME
+     must be a module-level constant whose value is a member, and a
+     dynamic expression is allowed only inside a function that references
+     the enum tuple (i.e. guards membership against it) — anything else
+     could mint unbounded label values.
 
 Dynamic names (f-strings, e.g. bench.py's singa_bench_* gauges) cannot be
 checked statically; the runtime ValueError in observe._Metric covers
@@ -55,14 +64,19 @@ def iter_py_files(paths):
                         yield os.path.join(dirpath, f)
 
 
-def registrations_in(path):
+def _parse(path):
+    with open(path, encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def registrations_in(path, tree=None):
     """Yield (name, metric_type, help_or_None, lineno) for literal metric
     registrations in one file. `help` is the second positional arg or the
     `help=` keyword when it is a string literal (dynamic helps are left
     to the runtime). Parse errors are a lint failure upstream (tier-1
     would catch them anyway), so let them raise."""
-    with open(path, encoding="utf-8") as f:
-        tree = ast.parse(f.read(), filename=path)
+    if tree is None:
+        tree = _parse(path)
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
@@ -89,6 +103,84 @@ def registrations_in(path):
         yield first.value, fname, help_text, node.lineno
 
 
+# Enum-guarded label kwargs: values must be provably low-cardinality.
+ENUM_LABEL_KWARGS = ("reason", "phase")
+RECORD_FUNCS = {"inc", "set", "observe", "dec"}
+
+
+def _module_enum_info(tree):
+    """(enums, consts): module-level ALL-CAPS `NAME = ("a", "b", ...)`
+    tuples of string literals, and ALL-CAPS `NAME = "literal"` string
+    constants."""
+    enums = {}
+    consts = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if not name.isupper():
+            continue
+        v = node.value
+        if isinstance(v, ast.Tuple) and v.elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in v.elts):
+            enums[name] = tuple(e.value for e in v.elts)
+        elif isinstance(v, ast.Constant) and isinstance(v.value, str):
+            consts[name] = v.value
+    return enums, consts
+
+
+def label_enum_problems(tree):
+    """Yield (lineno, message) for reason=/phase= label values on metric
+    record calls that cannot be traced to a declared enum tuple (rule 5
+    in the module docstring)."""
+    enums, consts = _module_enum_info(tree)
+    allowed = {v for vals in enums.values() for v in vals}
+    out = []
+
+    def fn_guards(fn):
+        return any(isinstance(n, ast.Name) and n.id in enums
+                   for n in ast.walk(fn))
+
+    def visit(node, guarded):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            guarded = guarded or fn_guards(node)
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in RECORD_FUNCS):
+            for kw in node.keywords:
+                if kw.arg not in ENUM_LABEL_KWARGS:
+                    continue
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    if v.value not in allowed:
+                        out.append((
+                            v.lineno,
+                            f"{kw.arg}= label value {v.value!r} is not a "
+                            "member of any declared enum tuple (e.g. "
+                            "RECOMPILE_REASONS / COMPILE_PHASES)"))
+                elif isinstance(v, ast.Name) and v.id in consts:
+                    if consts[v.id] not in allowed:
+                        out.append((
+                            v.lineno,
+                            f"{kw.arg}= label constant {v.id} = "
+                            f"{consts[v.id]!r} is not a member of any "
+                            "declared enum tuple"))
+                elif not guarded:
+                    out.append((
+                        v.lineno,
+                        f"{kw.arg}= label value is dynamic and the "
+                        "enclosing function does not reference a "
+                        "declared enum tuple (guard membership against "
+                        "it, e.g. `assert x in COMPILE_PHASES`)"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, guarded)
+
+    visit(tree, False)
+    return out
+
+
 def check(paths=None):
     """Return a list of violation strings (empty = clean)."""
     problems = []
@@ -96,7 +188,10 @@ def check(paths=None):
     help_seen = {}  # help text -> (name, file, line)
     for path in iter_py_files(paths or DEFAULT_PATHS):
         rel = os.path.relpath(path, ROOT)
-        for name, mtype, help_text, line in registrations_in(path):
+        tree = _parse(path)
+        for line, msg in label_enum_problems(tree):
+            problems.append(f"{rel}:{line}: {msg}")
+        for name, mtype, help_text, line in registrations_in(path, tree):
             if not NAME_RE.match(name):
                 problems.append(
                     f"{rel}:{line}: metric name {name!r} does not match "
