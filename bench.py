@@ -137,6 +137,17 @@ def main():
                         "hbm_temps_bytes of the compiled step — mirrored "
                         "into singa_bench_* gauges like every other "
                         "field")
+    p.add_argument("--goodput", action="store_true",
+                   help="install the goodput tracker (singa_tpu.goodput) "
+                        "for the whole run and emit the wall-time bucket "
+                        "breakdown (goodput_<bucket>_s) + goodput_ratio "
+                        "into the JSON record and the singa_bench_* "
+                        "mirror")
+    p.add_argument("--diag-port", type=int, default=None, metavar="PORT",
+                   help="serve the live diagnostics HTTP endpoints "
+                        "(/metrics /healthz /statusz /flightz /profilez) "
+                        "on PORT (0 = ephemeral) while the bench runs; "
+                        "implies --goodput")
     p.add_argument("--metrics-out", default=None, metavar="FILE",
                    help="write the observe registry as Prometheus text "
                         "after the run (step histograms, compile counts, "
@@ -162,6 +173,13 @@ def main():
     if args.events_out:
         observe.set_event_log(args.events_out)
 
+    goodput_tracker = None
+    if args.goodput or args.diag_port is not None:
+        from singa_tpu import goodput as goodput_mod
+        # installed before the model exists so warmup compiles land in
+        # the `compile` bucket
+        goodput_tracker = goodput_mod.install()
+
     dev = device.best_device()
     on_cpu = dev.is_host()
     if on_cpu:
@@ -181,6 +199,13 @@ def main():
     m.set_optimizer(sgd)
     m.compile([tx], is_train=True, use_graph=True,
               amp="bfloat16" if args.amp else None)
+
+    if args.diag_port is not None:
+        srv = observe.start_diag_server(port=args.diag_port, model=m,
+                                        device=dev)
+        print(f"# diag server: {srv.url} "
+              "(/metrics /healthz /statusz /flightz /profilez)",
+              file=sys.stderr)
 
     # Always run >=1 untimed step: compiles the graph and guarantees
     # out/loss exist for the fence below even with --warmup 0.
@@ -415,6 +440,18 @@ def main():
     }
     if note:
         rec["note"] = note
+    if goodput_tracker is not None:
+        # one FINAL snapshot: commits the held last step + flushes the
+        # unattributed residual, so the bucket fields (and the counters
+        # --metrics-out exports below) sum to the run's wall clock
+        # (each lands in singa_bench_goodput_* via record_bench)
+        snap = goodput_tracker.snapshot(final=True)
+        rec["goodput_ratio"] = round(snap["goodput_ratio"], 4)
+        rec["goodput_window_ratio"] = round(
+            snap["window_goodput_ratio"], 4)
+        rec["goodput_wall_s"] = round(snap["wall_s"], 3)
+        for bucket_name, seconds in snap["buckets"].items():
+            rec[f"goodput_{bucket_name}_s"] = round(seconds, 4)
     if args.explain:
         # the timed step compiled through the AOT stages (model.py); use
         # the build record snapshotted before the --health arm rather

@@ -5,17 +5,58 @@ prefetch into a bounded queue). On TPU the host-side pipeline matters more
 than on GPU — the chip stalls if the host can't feed it — so there is also
 `NumpyBatchIter` for in-memory arrays with background prefetch, used by the
 examples. A C-accelerated record reader lives in singa_tpu.io (native/).
+
+Both iterators are stall-instrumented end to end (the `data_wait` goodput
+bucket's ground truth):
+  - consumer-blocked time: `singa_data_consumer_blocked_seconds{iter=...}`
+    plus an `observe.span("data.wait")` around the blocking wait, so the
+    goodput tracker attributes it even outside `Model.fit` (nested fit
+    spans net out — no double counting),
+  - producer batch-build time: `singa_data_producer_batch_seconds` —
+    `ImageBatchIter`'s worker is a separate *process*, so its build time
+    rides the queue payload and is recorded consumer-side,
+  - queue depth: `singa_data_queue_depth` / `singa_data_prefetch_depth`.
+    One series per iterator KIND (`iter=image|numpy`, the lint's
+    low-cardinality contract), so with several live iterators of the
+    same kind the gauges reflect the most recent writer — read the
+    blocked-time histograms (cumulative) when that matters.
 """
 
 from __future__ import annotations
 
 import os
+import queue as _queue
 import random
 import threading
 import time
 from multiprocessing import Event, Process, Queue
 
 import numpy as np
+
+from . import observe
+
+
+def _record_consumer_wait(kind: str, seconds: float, depth=None):
+    if not observe.is_enabled():
+        return
+    observe.histogram(
+        "singa_data_consumer_blocked_seconds",
+        "wall seconds the training loop spent blocked on the next batch"
+    ).observe(seconds, iter=kind)
+    if depth is not None:
+        observe.gauge(
+            "singa_data_queue_depth",
+            "prefetched batches ready in the iterator queue"
+        ).set(float(depth), iter=kind)
+
+
+def _record_producer_batch(kind: str, seconds: float):
+    if not observe.is_enabled():
+        return
+    observe.histogram(
+        "singa_data_producer_batch_seconds",
+        "wall seconds the producer spent building one batch"
+    ).observe(seconds, iter=kind)
 
 
 class ImageBatchIter:
@@ -39,6 +80,13 @@ class ImageBatchIter:
         self.p = None
         with open(img_list_file, 'r') as fd:
             self.num_samples = len(fd.readlines())
+        if self.num_samples < batch_size:
+            # the worker's epoch loop could never assemble a single
+            # batch: it would spin re-shuffling forever while __next__
+            # blocks on an eternally-empty queue
+            raise ValueError(
+                f"batch_size {batch_size} exceeds the {self.num_samples} "
+                f"sample(s) in {img_list_file}")
 
     def start(self):
         self.p = Process(target=self.run, daemon=True)
@@ -46,9 +94,46 @@ class ImageBatchIter:
 
     def __next__(self):
         assert self.p is not None, 'call start before next'
-        while self.queue.empty():
-            time.sleep(0.01)
-        return self.queue.get()
+        if self.stop_flag.is_set():
+            # end() was called: the queue may still hold a stale batch
+            # (its drain races the worker's in-flight put) — don't
+            # serve it, the iteration is over
+            raise StopIteration
+        # blocking get (no 10ms poll spin): wake as soon as a batch
+        # lands, and notice a dead worker instead of hanging forever
+        t0 = time.perf_counter()
+        with observe.span("data.wait"):
+            while True:
+                try:
+                    item = self.queue.get(timeout=0.2)
+                    break
+                except _queue.Empty:
+                    if not self.p.is_alive():
+                        # the worker's feeder thread may still be
+                        # flushing its last batch into the pipe: one
+                        # final drain before declaring the data lost
+                        try:
+                            item = self.queue.get(timeout=0.2)
+                            break
+                        except _queue.Empty:
+                            if self.stop_flag.is_set():
+                                # deliberate shutdown (end()), not a
+                                # crash: the iteration is simply over
+                                raise StopIteration from None
+                            raise RuntimeError(
+                                f"ImageBatchIter worker process died "
+                                f"(exitcode {self.p.exitcode}) with the "
+                                "queue empty — check the image list / "
+                                "transform; see the worker's stderr for "
+                                "its traceback") from None
+        try:
+            depth = self.queue.qsize()
+        except NotImplementedError:  # macOS multiprocessing queues
+            depth = None
+        _record_consumer_wait("image", time.perf_counter() - t0, depth)
+        x, y, produce_s = item
+        _record_producer_batch("image", produce_s)
+        return x, y
 
     next = __next__
 
@@ -77,6 +162,7 @@ class ImageBatchIter:
             i = 0
             while i + self.batch_size <= len(samples) \
                     and not self.stop_flag.is_set():
+                t0 = time.perf_counter()
                 xs, ys = [], []
                 for path, meta in samples[i:i + self.batch_size]:
                     full = os.path.join(self.image_folder, path) \
@@ -92,25 +178,30 @@ class ImageBatchIter:
                     y = np.asarray([int(v) for v in ys], np.int32)
                 except ValueError:
                     y = ys  # non-integer meta: hand back raw strings
-                self.queue.put((x, y))
+                # build time rides the payload: the worker is another
+                # process, so it cannot feed this process's registry
+                self.queue.put((x, y, time.perf_counter() - t0))
                 i += self.batch_size
 
 
 class NumpyBatchIter:
-    """Shuffled mini-batches over in-memory arrays with a one-deep
-    background prefetch thread (enough to hide host-side augmentation
-    behind device steps)."""
+    """Shuffled mini-batches over in-memory arrays with a bounded
+    background prefetch thread (default depth 2 — enough to hide
+    host-side augmentation behind device steps; raise `prefetch` when
+    the transform is spiky)."""
 
     def __init__(self, x, y, batch_size, transform=None, shuffle=True,
-                 seed=0, drop_last=True):
+                 seed=0, drop_last=True, prefetch=2):
         assert len(x) == len(y)
         self.x, self.y = x, y
         self.bs = batch_size
         self.transform = transform
         self.shuffle = shuffle
         self.rng = np.random.RandomState(seed)
+        self.prefetch = max(1, int(prefetch))
         n = len(x) // batch_size if drop_last else -(-len(x) // batch_size)
         self.num_batches = n
+        self._producer_thread = None  # last epoch's producer (tests/join)
 
     def __len__(self):
         return self.num_batches
@@ -129,29 +220,59 @@ class NumpyBatchIter:
         nxt = {}
         lock = threading.Condition()
         stop = [False]  # set when the consumer abandons the iterator early
+        if observe.is_enabled():
+            observe.gauge(
+                "singa_data_prefetch_depth",
+                "configured prefetch depth of the iterator queue"
+            ).set(float(self.prefetch), iter="numpy")
 
         def producer():
             for b in range(self.num_batches):
+                if stop[0]:  # abandoned: don't build batches nobody wants
+                    return
+                t0 = time.perf_counter()
                 batch = self._make(order, b)
+                _record_producer_batch("numpy", time.perf_counter() - t0)
                 with lock:
-                    while (b in nxt or len(nxt) >= 2) and not stop[0]:
+                    while (b in nxt or len(nxt) >= self.prefetch) \
+                            and not stop[0]:
                         lock.wait()
                     if stop[0]:
                         return
                     nxt[b] = batch
                     lock.notify_all()
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = self._producer_thread = threading.Thread(
+            target=producer, daemon=True)
         t.start()
         try:
             for b in range(self.num_batches):
-                with lock:
-                    while b not in nxt:
-                        lock.wait()
-                    batch = nxt.pop(b)
-                    lock.notify_all()
+                t0 = time.perf_counter()
+                with observe.span("data.wait"):
+                    with lock:
+                        while b not in nxt:
+                            # same dead-producer guard as ImageBatchIter:
+                            # a transform that raises kills the thread
+                            # without notifying, and an untimed wait
+                            # would park the training loop forever
+                            if not t.is_alive():
+                                raise RuntimeError(
+                                    "NumpyBatchIter producer thread died "
+                                    f"before batch {b} — the transform "
+                                    "raised; see its traceback on stderr")
+                            lock.wait(timeout=0.2)
+                        batch = nxt.pop(b)
+                        depth = len(nxt)
+                        lock.notify_all()
+                _record_consumer_wait(
+                    "numpy", time.perf_counter() - t0, depth)
                 yield batch
         finally:
             with lock:
                 stop[0] = True
                 lock.notify_all()
+            # reap the producer: an abandoned iterator must not leave a
+            # thread parked on the condition until interpreter exit. A
+            # producer mid-transform can't be interrupted — bounded
+            # join, and the daemon thread finishes its batch on its own
+            t.join(timeout=1.0)

@@ -1,0 +1,331 @@
+"""Live diagnostics HTTP server: the telemetry, reachable mid-run.
+
+Everything the observability layers produce so far lands in pull-less
+artifacts — Prometheus text written at bench exit, JSONL event logs,
+flight bundles on disk. This module serves the SAME state over HTTP
+while the job runs, from a stdlib `ThreadingHTTPServer` (daemon threads,
+ephemeral port by default) so a browser or scraper can answer "where did
+the wall-clock go" without touching the training process:
+
+  /          endpoint index
+  /metrics   Prometheus text exposition (observe.to_prometheus_text —
+             the goodput tracker's residual is flushed first, so
+             singa_time_seconds_total sums track the run clock)
+  /healthz   the HealthMonitor's verdict as JSON (HTTP 503 once the
+             halt policy has fired)
+  /statusz   one text page: explain report (introspect) + goodput
+             breakdown + recompile blame history + health line
+  /flightz   flight-bundle index; ?name=<bundle> streams one bundle's
+             JSONL (round-trips through health.load_flight_bundle)
+  /profilez  on-demand xplane capture: ?steps=N waits for N more train
+             steps (or ?seconds=S), stops the trace, returns the top
+             ops as JSON
+
+Start it with `observe.start_diag_server(port=0)` (port 0 = ephemeral;
+default port comes from `SINGA_TPU_DIAG_PORT`). Starting the server
+installs the goodput tracker — the server IS the operational surface
+the buckets exist for. `stop_diag_server()` shuts it down; the test
+conftest does this in an autouse teardown so suites never leak
+ports/threads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from . import goodput, observe
+
+_BUNDLE_RE = re.compile(r"^flight_[A-Za-z0-9_.-]+\.jsonl$")
+
+# /profilez capture dirs retained per server: the response points the
+# operator at trace_dir, so the newest few must survive the request,
+# but a scraper polling the endpoint must not grow tmp without bound
+_MAX_TRACE_DIRS = 4
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # served by daemon threads; never write to stderr per request
+    def log_message(self, fmt, *args):
+        pass
+
+    @property
+    def diag(self) -> "DiagServer":
+        return self.server.diag  # type: ignore[attr-defined]
+
+    def _send(self, body, status=200, ctype="text/plain; charset=utf-8"):
+        if isinstance(body, str):
+            body = body.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, obj, status=200):
+        self._send(json.dumps(obj, indent=1, default=str), status=status,
+                   ctype="application/json")
+
+    def do_GET(self):  # noqa: N802 (http.server contract)
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        try:
+            route = {
+                "/": self._index, "/index": self._index,
+                "/metrics": self._metrics,
+                "/healthz": self._healthz,
+                "/statusz": self._statusz,
+                "/flightz": self._flightz,
+                "/profilez": self._profilez,
+            }.get(url.path.rstrip("/") or "/")
+            if route is None:
+                self._send(f"404: no endpoint {url.path}\n", status=404)
+                return
+            route(q)
+        except Exception as e:  # surface, don't kill the handler thread
+            try:
+                self._send(f"500: {type(e).__name__}: {e}\n", status=500)
+            except Exception:
+                pass
+
+    # ---- endpoints -------------------------------------------------------
+    def _index(self, q):
+        self._send(
+            "singa_tpu diag server\n"
+            "  /metrics   Prometheus text\n"
+            "  /healthz   HealthMonitor verdict (JSON)\n"
+            "  /statusz   explain + goodput + recompile blame (text)\n"
+            "  /flightz   flight-bundle index; ?name=<bundle> fetches\n"
+            "  /profilez  ?steps=N[&seconds=S] on-demand xplane capture\n")
+
+    def _metrics(self, q):
+        gp = goodput.get_tracker()
+        if gp is not None:
+            gp.snapshot()  # flush pending step + residual into `other`
+        self._send(observe.to_prometheus_text(),
+                   ctype="text/plain; version=0.0.4; charset=utf-8")
+
+    def _monitor(self):
+        if self.diag.monitor is not None:
+            return self.diag.monitor
+        from . import health
+        return health.active_monitor()
+
+    def _healthz(self, q):
+        mon = self._monitor()
+        if mon is None:
+            self._send_json({"status": "unmonitored",
+                             "detail": "no HealthMonitor attached"})
+            return
+        v = mon.verdict()
+        self._send_json(v, status=503 if v.get("status") == "halt" else 200)
+
+    def _statusz(self, q):
+        from . import introspect
+        parts = [f"== singa_tpu /statusz ==  pid {os.getpid()}  "
+                 f"uptime {time.monotonic() - self.diag.started_mono:.1f}s"]
+        try:
+            rep = introspect.explain(model=self.diag.model,
+                                     device=self.diag.device)
+            parts.append(introspect.format_explain(rep))
+        except Exception as e:
+            parts.append(f"(explain unavailable: {e})")
+        parts.append(goodput.goodput_report())
+        mon = self._monitor()
+        if mon is None:
+            parts.append("== health ==\nno HealthMonitor attached")
+        else:
+            v = mon.verdict()
+            parts.append("== health ==\n" + json.dumps(v, default=str))
+        self._send("\n\n".join(parts) + "\n")
+
+    def _flight_dir(self):
+        mon = self._monitor()
+        if mon is not None:
+            return mon.recorder.out_dir
+        return self.diag.flight_dir
+
+    def _flightz(self, q):
+        d = self._flight_dir()
+        name = (q.get("name") or [None])[0]
+        if name is None:
+            bundles = []
+            if d and os.path.isdir(d):
+                bundles = sorted(f for f in os.listdir(d)
+                                 if _BUNDLE_RE.match(f))
+            self._send_json({"dir": d, "bundles": bundles})
+            return
+        # basename-only, pattern-pinned: no path traversal out of the dir
+        if not _BUNDLE_RE.match(name) or not d:
+            self._send(f"400: bad bundle name {name!r}\n", status=400)
+            return
+        path = os.path.join(d, name)
+        if not os.path.isfile(path):
+            self._send(f"404: no bundle {name}\n", status=404)
+            return
+        with open(path, "rb") as f:
+            self._send(f.read(), ctype="application/x-ndjson")
+
+    def _profilez(self, q):
+        import tempfile
+
+        try:
+            steps = int((q.get("steps") or ["1"])[0])
+            # capped: the profiler is process-global, so an unbounded
+            # capture would lock out every later StartTrace
+            max_s = min(float((q.get("seconds") or ["30"])[0]), 600.0)
+        except ValueError:
+            self._send("400: steps/seconds must be numeric\n", status=400)
+            return
+        from .device import get_default_device
+        dev = self.diag.device or get_default_device()
+        out = tempfile.mkdtemp(prefix="singa_profilez_")
+        try:
+            dev.StartTrace(out)
+        except RuntimeError as e:  # another capture owns the profiler
+            import shutil
+            shutil.rmtree(out, ignore_errors=True)  # nothing was written
+            self._send_json({"error": str(e)}, status=409)
+            return
+        c = observe.get_registry().get("singa_steps_total")
+        start = c.value() if c is not None else 0.0
+        t0 = time.monotonic()
+        captured = 0
+        try:
+            # also aborts on server stop: this daemon handler thread is
+            # NOT joined by shutdown, and it holds the process-global
+            # profiler — it must not outlive the server
+            while time.monotonic() - t0 < max_s \
+                    and not self.diag.stopping:
+                c = observe.get_registry().get("singa_steps_total")
+                captured = int((c.value() if c is not None else 0.0) - start)
+                if captured >= steps:
+                    break
+                time.sleep(0.01)
+        finally:
+            dev.StopTrace()
+        rows = []
+        try:
+            from . import xprof
+            rows = [{"op": r["op"], "category": r["category"],
+                     "total_ms": round(r["total_ms"], 3),
+                     "pct": round(r["pct"], 1)}
+                    for r in xprof.op_table(out)[:20]]
+        except Exception:
+            pass
+        self.diag.retain_trace_dir(out)
+        self._send_json({"trace_dir": out, "steps_requested": steps,
+                         "steps_captured": captured,
+                         # the seconds cap (or a server stop) expired
+                         # before N steps passed: the trace covers a
+                         # shorter window than asked for
+                         "truncated": captured < steps,
+                         "wall_s": round(time.monotonic() - t0, 3),
+                         "top_ops": rows})
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class DiagServer:
+    """The running server: `.port`, `.url`, `.stop()`. Context over the
+    process-global telemetry; `model`/`device`/`monitor` enrich
+    /statusz, /healthz, /flightz and /profilez when provided."""
+
+    def __init__(self, port=0, host="127.0.0.1", model=None, device=None,
+                 monitor=None, flight_dir="."):
+        self.model = model
+        self.device = device
+        self.monitor = monitor
+        self.flight_dir = flight_dir
+        self.stopping = False  # aborts in-flight /profilez captures
+        self._trace_dirs: "list[str]" = []  # completed captures, oldest first
+        self._trace_lock = threading.Lock()
+        self.started_mono = time.monotonic()
+        self._httpd = _Server((host, int(port)), _Handler)
+        self._httpd.diag = self  # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name=f"singa-diag-{self.port}", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def retain_trace_dir(self, path: str):
+        """Record a finished /profilez capture dir, deleting the oldest
+        beyond _MAX_TRACE_DIRS so repeated captures stay bounded."""
+        import shutil
+        with self._trace_lock:
+            self._trace_dirs.append(path)
+            stale = self._trace_dirs[:-_MAX_TRACE_DIRS]
+            del self._trace_dirs[:-_MAX_TRACE_DIRS]
+        for d in stale:
+            shutil.rmtree(d, ignore_errors=True)
+
+    def stop(self):
+        self.stopping = True  # daemon handler threads are not joined
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+_server: "DiagServer | None" = None
+_lock = threading.Lock()
+
+
+def start_diag_server(port=None, host="127.0.0.1", model=None, device=None,
+                      monitor=None, flight_dir=None) -> DiagServer:
+    """Start (or return) the process diag server. `port=None` reads
+    `SINGA_TPU_DIAG_PORT` (default 0 = OS-assigned ephemeral port).
+    Installs the goodput tracker: a live /statusz without the wall-time
+    ledger would be half an answer. When a server is already running,
+    explicitly passed context (model/device/monitor/flight_dir) is
+    applied to it — a library can start the server early and the
+    training script enrich it later — but the listening port cannot
+    change; stop_diag_server() first to rebind."""
+    global _server
+    with _lock:
+        if _server is not None:
+            for attr, val in (("model", model), ("device", device),
+                              ("monitor", monitor),
+                              ("flight_dir", flight_dir)):
+                if val is not None:
+                    setattr(_server, attr, val)
+            return _server
+        if port is None:
+            port = int(os.environ.get("SINGA_TPU_DIAG_PORT", "0"))
+        goodput.install()
+        _server = DiagServer(port=port, host=host, model=model,
+                             device=device, monitor=monitor,
+                             flight_dir="." if flight_dir is None
+                             else flight_dir)
+        return _server
+
+
+def get_diag_server() -> "DiagServer | None":
+    return _server
+
+
+def stop_diag_server():
+    """Shut the server down (idempotent; leaves goodput tracking to its
+    own lifecycle — conftest tears both down explicitly)."""
+    global _server
+    with _lock:
+        if _server is not None:
+            _server.stop()
+            _server = None
+
+
+__all__ = ["DiagServer", "start_diag_server", "stop_diag_server",
+           "get_diag_server"]

@@ -80,6 +80,23 @@ class HealthError(RuntimeError):
 
 _collector = None
 
+# The monitor the diag server's /healthz reports on: last one attached
+# through Model.set_health_monitor (or set explicitly). Process-wide on
+# purpose — the diagnostics surface answers for THE training job.
+_active_monitor = None
+
+
+def set_active_monitor(monitor):
+    """Register (or clear, with None) the process's reporting monitor."""
+    global _active_monitor
+    _active_monitor = monitor
+    return monitor
+
+
+def active_monitor():
+    """The monitor /healthz reports on, or None."""
+    return _active_monitor
+
 
 def collector():
     """The active StepStatsCollector, or None when health is off."""
@@ -429,6 +446,19 @@ class HealthMonitor:
                 "(loss-scale-overflow analog)"),
         }
 
+    def verdict(self) -> dict:
+        """One JSON-able health summary (the diag server's /healthz
+        body): the last action taken, the policy, and the most recent
+        step's recorded stats."""
+        last = self.recorder.ring[-1] if self.recorder.ring else None
+        return {
+            "status": self.last_action or "idle",
+            "policy": self.policy,
+            "healthy_steps": self._healthy_steps,
+            "last_step": last,
+            "last_bundle": self.recorder.last_bundle,
+        }
+
     def _spike_score(self, loss: float) -> float:
         import math
         if not math.isfinite(loss):
@@ -553,5 +583,5 @@ def record_nan_logits(n: int, kind: str):
 __all__ = [
     "POLICIES", "HealthError", "StepStatsCollector", "collector",
     "apply_skip", "FlightRecorder", "load_flight_bundle", "HealthMonitor",
-    "record_nan_logits",
+    "record_nan_logits", "set_active_monitor", "active_monitor",
 ]

@@ -451,21 +451,24 @@ def build_compiled(fn, args, key, sig=None, device=None):
     """
     if sig is None:
         sig = signature(args)
-    t0 = time.perf_counter()
-    try:
-        if hasattr(fn, "trace"):
-            traced = fn.trace(*args)
-            t1 = time.perf_counter()
-            lowered = traced.lower()
-        else:
-            # pre-0.4.30 jax: no Traced stage; trace+lower are one call
-            t1 = t0
-            lowered = fn.lower(*args)
-        t2 = time.perf_counter()
-        compiled = lowered.compile()
-        t3 = time.perf_counter()
-    except Exception:
-        return None, None
+    # span -> the goodput `compile` bucket (and nets out of any mapped
+    # enclosing span, e.g. a first-call model.eval)
+    with observe.span("introspect.build", key=key):
+        t0 = time.perf_counter()
+        try:
+            if hasattr(fn, "trace"):
+                traced = fn.trace(*args)
+                t1 = time.perf_counter()
+                lowered = traced.lower()
+            else:
+                # pre-0.4.30 jax: no Traced stage; trace+lower in one call
+                t1 = t0
+                lowered = fn.lower(*args)
+            t2 = time.perf_counter()
+            compiled = lowered.compile()
+            t3 = time.perf_counter()
+        except Exception:
+            return None, None
     phases = {"trace": t1 - t0, "lower": t2 - t1, "compile": t3 - t2}
     _observe_phase(PHASE_TRACE, key, phases["trace"])
     _observe_phase(PHASE_LOWER, key, phases["lower"])
@@ -569,13 +572,20 @@ class AotExecutor:
             sig = signature(args, names=self.names)
             ex, _rec = build_compiled(self.fn, args, self.key, sig)
             self._execs[k] = ex  # None negative-caches failed staging
+            if ex is None:
+                # fresh staging failure: this jit call compiles cold —
+                # the mapped span books it to the goodput `compile`
+                # bucket instead of the enclosing serving/step span
+                with observe.span("model.jit_fallback"):
+                    return self.fn(*args)
         if ex is None:
             return self.fn(*args)
         try:
             return ex(*args)
         except Exception:
             self._execs[k] = None
-            return self.fn(*args)
+            with observe.span("model.jit_fallback"):
+                return self.fn(*args)
 
 
 # ---- the explain report ----------------------------------------------------

@@ -30,6 +30,7 @@ from jax import lax
 
 from . import _compat  # noqa: F401  (installs jax.shard_map on old jax)
 from . import autograd
+from . import goodput
 from . import health
 from . import introspect
 from . import observe
@@ -128,8 +129,15 @@ class Model(Layer, metaclass=ModelMeta):
         monitor's policy is STATIC in the compiled step (skip_step bakes
         an in-graph conditional commit into the executable), so any
         already-compiled step is dropped and rebuilt on the next call."""
+        prev = self._health_monitor
         self._health_monitor = monitor
         self._compiled_step = None
+        if monitor is not None:
+            health.set_active_monitor(monitor)  # /healthz finds it here
+        elif prev is not None and health.active_monitor() is prev:
+            # detaching clears the process registration only when it is
+            # ours — another model's live monitor keeps serving /healthz
+            health.set_active_monitor(None)
         return monitor
 
     @property
@@ -239,13 +247,22 @@ class Model(Layer, metaclass=ModelMeta):
                 return self.train_one_batch(*args, **kwargs)
             if self.graph_mode and self._device is not None and not kwargs \
                     and all(isinstance(a, Tensor) for a in args):
-                return self._eval_step(args)
+                # span -> the goodput `eval` bucket (a first-call AOT
+                # build nests an introspect.build span, netted out)
+                with observe.span("model.eval"):
+                    return self._eval_step(args)
             return self.forward(*args, **kwargs)
         finally:
             autograd.compute_dtype = prev_cd
 
     # ---- the jitted step -------------------------------------------------
     def _build_step(self, func, example_args, kwargs):
+        # span -> the goodput `compile` bucket (trace prep; the XLA
+        # backend build itself lands under introspect.build)
+        with observe.span("model.build"):
+            self._build_step_impl(func, example_args, kwargs)
+
+    def _build_step_impl(self, func, example_args, kwargs):
         from .opt import DistOpt  # local import to avoid cycle
 
         t0 = time.perf_counter()
@@ -541,6 +558,7 @@ class Model(Layer, metaclass=ModelMeta):
             bs = input_arrs[0].shape[0]
         step_fn = fn
         exec_key = None
+        cold_jit = False  # this dispatch pays a fresh jit trace+compile
         if not self.sequential:
             # AOT executable per abstract signature: the explicit
             # trace -> lower -> compile staging happens on a cache miss
@@ -571,6 +589,9 @@ class Model(Layer, metaclass=ModelMeta):
                 entry = self._step_execs[exec_key] = None if aot is None \
                     else (aot, float((rec or {}).get("cost", {})
                                      .get("flops", 0) or 0))
+                # staging just failed: the jit dispatch below compiles
+                # cold — goodput must book that as compile, not step
+                cold_jit = aot is None
             if entry is not None:
                 step_fn, aot_flops = entry
                 # the MFU gauge must use the DISPATCHED variant's flops,
@@ -603,25 +624,44 @@ class Model(Layer, metaclass=ModelMeta):
                 dev.cost_analysis = self.step_cost_analysis() \
                     if self._step_stats["steps"] > 0 else {}
             t0 = time.perf_counter()
-        try:
-            new_states, new_opt, new_rng, outs, hstats = step_fn(
-                state_arrs, opt_arrs, rng, input_arrs)
-        except Exception:
-            if step_fn is fn:
-                raise
-            # the AOT executable rejected the call (e.g. an optimizer
-            # slot changed shape in place, invisible to exec_key):
-            # negative-cache the signature so jit owns it from now on —
-            # correctness over telemetry, and no rebuild-per-step churn
-            self._step_execs[exec_key] = None
-            introspect.note_step_flops(0)  # this step is jit-dispatched
-            new_states, new_opt, new_rng, outs, hstats = fn(
-                state_arrs, opt_arrs, rng, input_arrs)
-        if profiling:
-            jax.block_until_ready(new_states)
-            fenced = time.perf_counter() - t0
-            dev.step_times.append(fenced)
-            observe.record_step_fenced(fenced)
+        # span -> the goodput `step` bucket (held pending until the
+        # health verdict below, so a discarded update reclassifies to
+        # `health_skip`); covers dispatch and, when profiling, the fence
+        with observe.span("model.step"):
+            try:
+                if cold_jit:
+                    # nested mapped span: the fresh trace+compile nets
+                    # out of `step` and lands in the `compile` bucket
+                    with observe.span("model.jit_fallback"):
+                        new_states, new_opt, new_rng, outs, hstats = \
+                            step_fn(state_arrs, opt_arrs, rng, input_arrs)
+                else:
+                    new_states, new_opt, new_rng, outs, hstats = step_fn(
+                        state_arrs, opt_arrs, rng, input_arrs)
+            except Exception:
+                if step_fn is fn:
+                    raise
+                # the AOT executable rejected the call (e.g. an optimizer
+                # slot changed shape in place, invisible to exec_key):
+                # negative-cache the signature so jit owns it from now on —
+                # correctness over telemetry, and no rebuild-per-step churn
+                self._step_execs[exec_key] = None
+                introspect.note_step_flops(0)  # this step: jit-dispatched
+                with observe.span("model.jit_fallback"):
+                    new_states, new_opt, new_rng, outs, hstats = fn(
+                        state_arrs, opt_arrs, rng, input_arrs)
+            if profiling:
+                jax.block_until_ready(new_states)
+                fenced = time.perf_counter() - t0
+                dev.step_times.append(fenced)
+                observe.record_step_fenced(fenced)
+            if self._health_monitor is not None and hstats:
+                # fetch the stats INSIDE the span: on an async backend
+                # this is the step's sync point, so the span records the
+                # device step's real wall time (not just dispatch) —
+                # without a monitor or profiling, only dispatch time is
+                # attributable and the remainder lands in `other`
+                hstats = jax.device_get(hstats)
         for t, a in zip(self._state_tensors, new_states):
             t.data = a
         if opt is not None and new_opt:
@@ -640,10 +680,14 @@ class Model(Layer, metaclass=ModelMeta):
             observe.record_step(time.perf_counter() - t_obs,
                                 batch=bs, tag=tag, device=dev)
         if self._health_monitor is not None:
-            # one small transfer: the stats pytree is a handful of
-            # scalars; fetching it is the step's only health-side sync
-            self._health_feed(hstats, self._last_input_arrs,
-                              in_graph_skip=True)
+            # stats were fetched (and the step thereby fenced) inside
+            # the model.step span above; this feed is host-side only
+            action = self._health_feed(hstats, self._last_input_arrs,
+                                       in_graph_skip=True, fetched=True)
+            if action == "skip":
+                # the update was discarded in-graph: this step's wall
+                # time produced nothing — move it out of `step`
+                goodput.mark_step_skipped()
         tensors = [Tensor(data=a, device=dev, requires_grad=False)
                    for a in outs]
         return _rebuild_out(self._out_template_box["t"], tensors)
@@ -656,18 +700,23 @@ class Model(Layer, metaclass=ModelMeta):
         return {id(t): name.split(self.sep, 1)[0]
                 for name, t in self.get_params().items()}
 
-    def _health_feed(self, hstats, input_arrs, in_graph_skip):
+    def _health_feed(self, hstats, input_arrs, in_graph_skip,
+                     fetched=False):
         mon = self._health_monitor
         self._health_steps += 1
-        host = jax.device_get(hstats) if hstats else {}
+        # _invoke_step fetches the stats inside the model.step span (the
+        # fetch IS the step fence); don't traverse the tree a second time
+        host = hstats if fetched else (
+            jax.device_get(hstats) if hstats else {})
+        host = host or {}
         provider = None
         if input_arrs is not None and mon.snapshot_batch:
             provider = lambda: [np.asarray(jax.device_get(a))  # noqa: E731
                                 for a in input_arrs]
-        mon.on_step(host, step=self._health_steps,
-                    batch_provider=provider,
-                    amp=getattr(self, "amp", None) is not None,
-                    in_graph_skip=in_graph_skip)
+        return mon.on_step(host, step=self._health_steps,
+                           batch_provider=provider,
+                           amp=getattr(self, "amp", None) is not None,
+                           in_graph_skip=in_graph_skip)
 
     def _eager_health_step(self, func, args, kwargs):
         """Eager-mode health: the same collector, finalized eagerly.
@@ -701,10 +750,19 @@ class Model(Layer, metaclass=ModelMeta):
         in-graph without breaking the loop; halt raises HealthError out
         of fit with the flight-recorder bundle already on disk)."""
         history = []
+        _end = object()
         for epoch in range(epochs):
             losses = []
             with observe.span("model.fit_epoch", epoch=epoch):
-                for batch in data:
+                it = iter(data)
+                while True:
+                    # fetch wait measured per batch: the host-side
+                    # pipeline stall signal (goodput `data_wait`; an
+                    # iterator's own data.wait span nests and nets out)
+                    with observe.span("data.wait"):
+                        batch = next(it, _end)
+                    if batch is _end:
+                        break
                     if not isinstance(batch, (tuple, list)):
                         batch = (batch,)
                     out = self(*batch)
@@ -799,13 +857,19 @@ class Model(Layer, metaclass=ModelMeta):
                 self._compiled_eval, (concrete, arrs), "eval", asig)
             # None negative-caches a failed build: jit owns this shape
             self._eval_execs[key] = aot
+            if aot is None:
+                # fresh staging failure: the jit call compiles cold —
+                # goodput books it as compile, not eval
+                with observe.span("model.jit_fallback"):
+                    return self._compiled_eval(concrete, arrs)
         if aot is None:
             return self._compiled_eval(concrete, arrs)
         try:
             return aot(concrete, arrs)
         except Exception:
             self._eval_execs[key] = None
-            return self._compiled_eval(concrete, arrs)
+            with observe.span("model.jit_fallback"):
+                return self._compiled_eval(concrete, arrs)
 
     def _eval_step(self, args):
         if getattr(self, "_compiled_eval", None) is None:
@@ -937,11 +1001,15 @@ class Model(Layer, metaclass=ModelMeta):
                     v.numpy() if isinstance(v, Tensor) else v)
         attrs = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
                  for k, v in states.items()}
-        npz_buf = io.BytesIO()
-        np.savez(npz_buf, **states)
-        with zipfile.ZipFile(fpath, "w") as zf:
-            zf.writestr("tensor_dict.npz", npz_buf.getvalue())
-            zf.writestr("states_attr.json", json.dumps(attrs))
+        # span -> the goodput `checkpoint` bucket, same as the orbax path
+        with observe.span("checkpoint.save"):
+            npz_buf = io.BytesIO()
+            np.savez(npz_buf, **states)
+            with zipfile.ZipFile(fpath, "w") as zf:
+                zf.writestr("tensor_dict.npz", npz_buf.getvalue())
+                zf.writestr("states_attr.json", json.dumps(attrs))
+        observe.record_checkpoint_bytes(
+            sum(int(v.nbytes) for v in states.values()))
 
     # ---- full training checkpoints (orbax) -------------------------------
     # save_states/load_states keep the reference's zip(npz+json) layout
@@ -988,8 +1056,13 @@ class Model(Layer, metaclass=ModelMeta):
         }
         ck = ocp.StandardCheckpointer()
         path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
-        ck.save(path, tree, force=overwrite)
-        ck.wait_until_finished()
+        # span -> the goodput `checkpoint` bucket
+        with observe.span("checkpoint.save"):
+            ck.save(path, tree, force=overwrite)
+            ck.wait_until_finished()
+        observe.record_checkpoint_bytes(sum(
+            int(getattr(a, "nbytes", 0) or 0)
+            for a in jax.tree_util.tree_leaves(tree)))
         return path
 
     def _restore_template(self, path):
@@ -1056,8 +1129,9 @@ class Model(Layer, metaclass=ModelMeta):
         import jax
         import orbax.checkpoint as ocp
         ck = ocp.StandardCheckpointer()
-        tree = ck.restore(os.path.abspath(path),
-                          self._restore_template(path))
+        with observe.span("checkpoint.load"):
+            tree = ck.restore(os.path.abspath(path),
+                              self._restore_template(path))
         # direct buffer assignment: the restored arrays already carry the
         # live shardings (template), so no host round-trip — required on
         # multi-host, where np.asarray of a global array would throw
@@ -1083,13 +1157,17 @@ class Model(Layer, metaclass=ModelMeta):
         return self
 
     def load_states(self, fpath: str) -> dict:
-        with zipfile.ZipFile(fpath, "r") as zf:
-            with zf.open("tensor_dict.npz") as f:
-                loaded = dict(np.load(io.BytesIO(f.read())))
-        aux = {k[len("aux."):]: v for k, v in loaded.items()
-               if k.startswith("aux.")}
-        model_states = {k: v for k, v in loaded.items()
-                        if not k.startswith("aux.")}
-        self.set_states(model_states)
-        self._compiled_step = None  # drop stale executable state binding
+        # span -> the goodput `checkpoint` bucket; covers set_states too
+        # (the host->device transfer is part of the restore, as on the
+        # orbax path)
+        with observe.span("checkpoint.load"):
+            with zipfile.ZipFile(fpath, "r") as zf:
+                with zf.open("tensor_dict.npz") as f:
+                    loaded = dict(np.load(io.BytesIO(f.read())))
+            aux = {k[len("aux."):]: v for k, v in loaded.items()
+                   if k.startswith("aux.")}
+            model_states = {k: v for k, v in loaded.items()
+                            if not k.startswith("aux.")}
+            self.set_states(model_states)
+            self._compiled_step = None  # drop stale executable binding
         return aux

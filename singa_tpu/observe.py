@@ -378,6 +378,38 @@ _default = MetricsRegistry()
 _enabled = True
 _tls = threading.local()
 _step_cb = None
+_span_listeners: list = []  # (exit_cb, enter_cb | None) pairs
+
+
+def add_span_listener(cb, on_enter=None):
+    """Register `cb(path, seconds, attrs)` to be called when any
+    `span()` region exits (path is the slash-joined span path, seconds
+    its wall time), and optionally `on_enter(path)` when one opens.
+    Listeners fire in registration order, children before parents
+    (spans exit LIFO), and exceptions are swallowed — a broken listener
+    must never break the instrumented code path. singa_tpu.goodput uses
+    this to classify run wall time into goodput/badput buckets without
+    re-instrumenting the span sites (the enter hook lets it reserve
+    in-flight spans so a mid-span scrape doesn't misbook them)."""
+    _span_listeners.append((cb, on_enter))
+    return cb
+
+
+def remove_span_listener(cb):
+    """Unregister a span listener added with add_span_listener (no-op
+    if it was never registered). Equality, not identity: bound methods
+    compare equal across attribute accesses but are distinct objects."""
+    _span_listeners[:] = [p for p in _span_listeners if p[0] != cb]
+
+
+def start_diag_server(port=None, **kwargs):
+    """Start the live diagnostics HTTP server (singa_tpu.diag): /metrics,
+    /healthz, /statusz, /flightz, /profilez on an ephemeral port by
+    default (port=0), or `SINGA_TPU_DIAG_PORT` when `port` is None.
+    Returns the running DiagServer. Lazy import: the server is stdlib
+    only, but observe must stay import-light."""
+    from . import diag
+    return diag.start_diag_server(port=port, **kwargs)
 
 
 def set_step_callback(cb):
@@ -490,6 +522,12 @@ class span:
             self._ann.__enter__()
         except Exception:
             self._ann = None  # no jax / no profiler: hist-only span
+        for _cb, enter_cb in tuple(_span_listeners):
+            if enter_cb is not None:
+                try:
+                    enter_cb(self.path)
+                except Exception:
+                    pass
         self._t0 = time.perf_counter()
         return self
 
@@ -508,6 +546,11 @@ class span:
                 "singa_span_seconds",
                 "wall seconds per span() region (label: slash-joined "
                 "span path)").observe(dt, span=self.path)
+        for cb, _enter_cb in tuple(_span_listeners):
+            try:
+                cb(self.path, dt, self.attrs)
+            except Exception:
+                pass  # a listener must never break the spanned code
         return False
 
 
@@ -667,6 +710,15 @@ def record_decode(kind: str, seconds: float, new_tokens: int, batch: int,
                    "tokens_per_sec": round(tps, 3)})
 
 
+def record_checkpoint_bytes(nbytes: int):
+    """Bytes of the checkpoint/snapshot flush that just completed
+    (model.save_checkpoint's orbax tree, Snapshot.flush's store)."""
+    if not _enabled:
+        return
+    gauge("singa_checkpoint_bytes_written",
+          "bytes in the last checkpoint/snapshot flush").set(float(nbytes))
+
+
 def record_bench(rec: dict):
     """Mirror a bench.py result record into the registry (gauges named
     singa_bench_<field>) and the EventLog, so BENCH_*.json artifacts and
@@ -687,8 +739,9 @@ __all__ = [
     "span", "current_span", "get_registry", "enable", "is_enabled",
     "counter", "gauge", "histogram", "set_event_log", "get_event_log",
     "to_prometheus_text", "dump", "DEFAULT_BUCKETS", "SPAN_TRACE_PREFIX",
-    "set_step_callback",
+    "set_step_callback", "add_span_listener", "remove_span_listener",
+    "start_diag_server",
     "record_step", "record_step_build", "record_step_fenced",
     "record_compile", "record_hbm", "record_opt_update", "record_comm",
-    "record_decode", "record_bench",
+    "record_decode", "record_bench", "record_checkpoint_bytes",
 ]

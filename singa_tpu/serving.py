@@ -587,31 +587,38 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
     def decode(p, prompt, key):
         # the sync fences exist only to take honest TTFT/latency samples;
         # with observability disabled the stages dispatch fully async
-        # (observe.py's "record_* are no-ops when disabled" contract)
+        # (observe.py's "record_* are no-ops when disabled" contract).
+        # The outer serving.decode span covers the WHOLE call — including
+        # the host-side seams between stages — so the goodput tracker
+        # books full serving wall time as productive; the nested stage
+        # spans net out of it.
         obs = observe.is_enabled()
-        t0 = _time.perf_counter()
-        ttft = None
-        with observe.span("serving.prefill", batch=B, prompt_tokens=S0):
-            tok0, caches, key, nf = prefill_jit(p, prompt, key)
+        with observe.span("serving.decode", batch=B, new_tokens=max_new):
+            t0 = _time.perf_counter()
+            ttft = None
+            with observe.span("serving.prefill", batch=B,
+                              prompt_tokens=S0):
+                tok0, caches, key, nf = prefill_jit(p, prompt, key)
+                if obs:
+                    jax.block_until_ready(tok0)
+                    ttft = _time.perf_counter() - t0
+            if max_new > 1:
+                with observe.span("serving.decode_scan", batch=B,
+                                  new_tokens=max_new):
+                    toks, nf = scan_jit(p, tok0, caches, key, nf)
+            else:
+                toks = tok0[:, None]
+            ids = jnp.concatenate([prompt if isinstance(prompt, jax.Array)
+                                   else jnp.asarray(prompt), toks], axis=1)
             if obs:
-                jax.block_until_ready(tok0)
-                ttft = _time.perf_counter() - t0
-        if max_new > 1:
-            with observe.span("serving.decode_scan", batch=B,
-                              new_tokens=max_new):
-                toks, nf = scan_jit(p, tok0, caches, key, nf)
-        else:
-            toks = tok0[:, None]
-        ids = jnp.concatenate([prompt if isinstance(prompt, jax.Array)
-                               else jnp.asarray(prompt), toks], axis=1)
-        if obs:
-            jax.block_until_ready(ids)
-            kind = "greedy" if temperature == 0.0 else "sampled"
-            observe.record_decode(
-                kind, _time.perf_counter() - t0, new_tokens=B * max_new,
-                batch=B, ttft=ttft, prompt_tokens=B * S0)
-            from . import health
-            health.record_nan_logits(int(jax.device_get(nf)), kind)
+                jax.block_until_ready(ids)
+                kind = "greedy" if temperature == 0.0 else "sampled"
+                observe.record_decode(
+                    kind, _time.perf_counter() - t0,
+                    new_tokens=B * max_new,
+                    batch=B, ttft=ttft, prompt_tokens=B * S0)
+                from . import health
+                health.record_nan_logits(int(jax.device_get(nf)), kind)
         return ids
 
     return decode

@@ -21,7 +21,7 @@ import os
 
 import numpy as np
 
-from . import native
+from . import native, observe
 from .tensor import Tensor, from_numpy
 
 
@@ -58,27 +58,33 @@ class Snapshot:
     def flush(self):
         if not self.mode_write:
             return
-        # an explicit extension pins the backend; only extensionless
-        # prefixes auto-select (native preferred)
-        lb = None if self.fpath.endswith(".npz") else native.snapshot_lib()
-        if self.fpath.endswith(".bin") and lb is None:
-            raise OSError("explicit .bin path requested but no C++ "
-                          "toolchain is available")
-        if lb is not None:
-            self._flush_native(lb)
-            stale = self._prefix() + ".npz"
-        else:
-            np.savez(self._prefix() + ".npz", **self._store)
-            stale = self._prefix() + ".bin"
-        # a leftover other-format file from an earlier flush of the same
-        # extensionless prefix would shadow this one on read — remove it
-        if not self.fpath.endswith((".npz", ".bin")) \
-                and os.path.exists(stale):
-            os.remove(stale)
-        meta = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
-                for k, v in self._store.items()}
-        with open(self._prefix() + ".meta", "w") as f:
-            json.dump(meta, f, indent=1)
+        # span -> the goodput `checkpoint` bucket
+        with observe.span("snapshot.flush"):
+            # an explicit extension pins the backend; only extensionless
+            # prefixes auto-select (native preferred)
+            lb = None if self.fpath.endswith(".npz") \
+                else native.snapshot_lib()
+            if self.fpath.endswith(".bin") and lb is None:
+                raise OSError("explicit .bin path requested but no C++ "
+                              "toolchain is available")
+            if lb is not None:
+                self._flush_native(lb)
+                stale = self._prefix() + ".npz"
+            else:
+                np.savez(self._prefix() + ".npz", **self._store)
+                stale = self._prefix() + ".bin"
+            # a leftover other-format file from an earlier flush of the
+            # same extensionless prefix would shadow this one on read —
+            # remove it
+            if not self.fpath.endswith((".npz", ".bin")) \
+                    and os.path.exists(stale):
+                os.remove(stale)
+            meta = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                    for k, v in self._store.items()}
+            with open(self._prefix() + ".meta", "w") as f:
+                json.dump(meta, f, indent=1)
+        observe.record_checkpoint_bytes(
+            sum(int(v.nbytes) for v in self._store.values()))
 
     def _flush_native(self, lb):
         path = self._prefix() + ".bin"
@@ -103,6 +109,11 @@ class Snapshot:
     # -- read side ---------------------------------------------------------
 
     def _load(self):
+        # span -> the goodput `checkpoint` bucket
+        with observe.span("snapshot.load"):
+            self._load_impl()
+
+    def _load_impl(self):
         prefix = self._prefix()
         # explicit extension pins the backend on read too (mirrors flush)
         bin_path = None if self.fpath.endswith(".npz") else prefix + ".bin"

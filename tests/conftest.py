@@ -38,13 +38,20 @@ def _metrics_isolation():
     """Every test starts with a clean process-global MetricsRegistry
     (observe.MetricsRegistry.reset), no EventLog attached, and the
     instrumentation enabled — counter state accumulated by one test can
-    no longer leak into another's assertions."""
-    from singa_tpu import introspect, observe
+    no longer leak into another's assertions. Teardown also stops any
+    diag server and uninstalls the goodput tracker, so tests never leak
+    HTTP ports, server threads, or span listeners."""
+    from singa_tpu import diag, goodput, health, introspect, observe
+    diag.stop_diag_server()
+    goodput.uninstall()
+    health.set_active_monitor(None)
     observe.get_registry().reset()
     observe.set_event_log(None)
     observe.enable(True)
     introspect.reset()  # signature history / manifest / peak override
     yield
+    diag.stop_diag_server()
+    goodput.uninstall()
 
 
 @pytest.fixture

@@ -27,6 +27,128 @@ def test_numpy_batch_iter_transform():
     assert (xb == 2).all()
 
 
+def test_numpy_batch_iter_prefetch_arg_and_metrics():
+    """ISSUE-4 satellite: the prefetch depth is a constructor arg (was a
+    hardcoded 2) exported as a gauge, and the consumer/producer stall
+    histograms fill in."""
+    from singa_tpu import observe
+    x = np.arange(64, dtype=np.float32).reshape(64, 1)
+    y = np.arange(64, dtype=np.int32)
+    it = data.NumpyBatchIter(x, y, 8, shuffle=False, prefetch=4)
+    assert it.prefetch == 4
+    n = sum(1 for _ in it)
+    assert n == 8
+    reg = observe.get_registry()
+    assert reg.get("singa_data_prefetch_depth").value(iter="numpy") == 4
+    assert reg.get("singa_data_consumer_blocked_seconds").count(
+        iter="numpy") == 8
+    assert reg.get("singa_data_producer_batch_seconds").count(
+        iter="numpy") == 8
+    assert reg.get("singa_data_queue_depth").value(iter="numpy") >= 0
+
+
+def test_numpy_batch_iter_joins_producer_on_abandonment():
+    """An early-abandoned iterator reaps its producer thread instead of
+    leaving it parked on the condition variable."""
+    x = np.zeros((128, 1), np.float32)
+    y = np.zeros(128, np.int32)
+    it = data.NumpyBatchIter(x, y, 8, shuffle=False)
+    g = iter(it)
+    next(g)
+    g.close()  # consumer walks away mid-epoch
+    assert it._producer_thread is not None
+    assert not it._producer_thread.is_alive()
+
+
+def test_numpy_batch_iter_raises_on_dead_producer():
+    """Same dead-producer guard as ImageBatchIter: a transform that
+    raises kills the producer thread, and the consumer must get a
+    RuntimeError instead of parking on the condition forever."""
+    import pytest
+    x = np.zeros((64, 1), np.float32)
+    y = np.zeros(64, np.int32)
+
+    def boom(_batch):
+        raise ValueError("bad transform")
+
+    it = data.NumpyBatchIter(x, y, 8, transform=boom, shuffle=False)
+    with pytest.raises(RuntimeError, match="producer thread died"):
+        next(iter(it))
+
+
+def _ident_images(_path):
+    # module-level: the worker is a separate process
+    return [np.full((4, 4, 3), 7, np.uint8)]
+
+
+def test_image_batch_iter_blocking_get(tmp_path):
+    """The fixed __next__ blocks on the queue (no 10ms poll spin) and
+    still yields batches; producer build time rides the payload into
+    the consumer-side histogram."""
+    from singa_tpu import observe
+    lst = tmp_path / "list.txt"
+    lst.write_text("a.png 0\nb.png 1\nc.png 2\nd.png 3\n")
+    it = data.ImageBatchIter(str(lst), 2, _ident_images, shuffle=False)
+    it.start()
+    try:
+        x, yb = next(it)
+        assert x.shape == (2, 3, 4, 4) and (x == 7).all()
+        np.testing.assert_array_equal(yb, np.array([0, 1], np.int32))
+        x, yb = next(it)
+        assert x.shape == (2, 3, 4, 4)
+        reg = observe.get_registry()
+        assert reg.get("singa_data_consumer_blocked_seconds").count(
+            iter="image") == 2
+        assert reg.get("singa_data_producer_batch_seconds").count(
+            iter="image") == 2
+    finally:
+        it.end()
+
+
+def test_image_batch_iter_raises_on_dead_worker(tmp_path):
+    """ISSUE-4 satellite regression: a crashed worker process turns into
+    a RuntimeError from __next__ instead of an infinite spin/hang."""
+    import pytest
+    lst = tmp_path / "bad.txt"
+    lst.write_text("line_without_delimiter\n")  # worker dies parsing
+    it = data.ImageBatchIter(str(lst), 1, _ident_images, delimiter="\t")
+    it.start()
+    try:
+        with pytest.raises(RuntimeError, match="worker process died"):
+            next(it)
+    finally:
+        it.end()
+
+
+def test_image_batch_iter_rejects_oversized_batch(tmp_path):
+    """batch_size > sample count: the worker's epoch loop could never
+    assemble a batch — it would re-shuffle forever (hot spin) while
+    __next__ blocks on an always-empty queue. Must fail eagerly at
+    construction, not hang at next()."""
+    import pytest
+    lst = tmp_path / "tiny.txt"
+    lst.write_text("a.png 0\nb.png 1\nc.png 2\n")
+    with pytest.raises(ValueError, match="batch_size 4 exceeds"):
+        data.ImageBatchIter(str(lst), 4, _ident_images)
+
+
+def test_image_batch_iter_stopiteration_after_end(tmp_path):
+    """next() after a deliberate end() is a normal StopIteration, not
+    the dead-worker RuntimeError blaming the transform."""
+    import pytest
+    import time
+    lst = tmp_path / "list.txt"
+    lst.write_text("a.png 0\nb.png 1\nc.png 2\nd.png 3\ne.png 4\nf.png 5\n")
+    it = data.ImageBatchIter(str(lst), 2, _ident_images, shuffle=False,
+                             capacity=2)
+    it.start()
+    next(it)
+    time.sleep(0.05)  # let the worker block in its next queue.put
+    it.end()  # the drain races that in-flight put: a stale batch may land
+    with pytest.raises(StopIteration):
+        next(it)
+
+
 def test_snapshot_roundtrip(tmp_path):
     p = str(tmp_path / "snap")
     with snapshot.Snapshot(p, True) as s:

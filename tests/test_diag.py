@@ -1,0 +1,352 @@
+"""Live diagnostics server (singa_tpu.diag): every endpoint served on an
+ephemeral port inside tier-1 — golden /statusz sections, /metrics
+exposing every goodput bucket and parsing as Prometheus text, /flightz
+round-tripping a flight bundle, /healthz verdicts, /profilez capture,
+and the no-leak lifecycle (idempotent stop; conftest teardown)."""
+
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from singa_tpu import (diag, goodput, health, layer, model, observe, opt,
+                       tensor)
+from singa_tpu.goodput import GOODPUT_BUCKETS
+from singa_tpu.health import HealthMonitor, load_flight_bundle
+
+
+class MLP(model.Model):
+    def __init__(self):
+        super().__init__()
+        self.l1 = layer.Linear(16)
+        self.relu = layer.ReLU()
+        self.l2 = layer.Linear(4)
+        self.loss_fn = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.l2(self.relu(self.l1(x)))
+
+    def train_one_batch(self, x, y):
+        out = self.forward(x)
+        loss = self.loss_fn(out, y)
+        self._optimizer(loss)
+        return out, loss
+
+
+@pytest.fixture
+def served(dev, rng, tmp_path):
+    """A 3-step trained model with a HealthMonitor and a dumped flight
+    bundle, behind a running diag server on an ephemeral port."""
+    X = rng.randn(32, 10).astype(np.float32)
+    Y = rng.randint(0, 4, 32).astype(np.int32)
+    m = MLP()
+    m.set_optimizer(opt.SGD(lr=0.1))
+    tx, ty = tensor.from_numpy(X, dev), tensor.from_numpy(Y, dev)
+    mon = HealthMonitor(out_dir=str(tmp_path))
+    m.compile([tx], is_train=True, use_graph=True, health=mon)
+    srv = observe.start_diag_server(port=0, model=m, device=dev)
+    for _ in range(3):
+        m(tx, ty)
+    mon.recorder.dump(reason="manual", step=3)
+    yield srv, m, tx, ty, mon
+    diag.stop_diag_server()
+
+
+def _get(srv, path, timeout=60.0):
+    try:
+        r = urllib.request.urlopen(srv.url + path, timeout=timeout)
+        return r.status, r.headers, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read().decode()
+
+
+_SAMPLE_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$")
+
+
+def test_server_binds_ephemeral_port_and_is_singleton(served):
+    srv = served[0]
+    assert srv.port > 0
+    assert srv.url.endswith(str(srv.port))
+    # second start returns the running instance, no second port
+    assert observe.start_diag_server(port=0) is srv
+    assert diag.get_diag_server() is srv
+
+
+def test_index_and_404(served):
+    srv = served[0]
+    st, _h, body = _get(srv, "/")
+    assert st == 200 and "/statusz" in body
+    st, _h, body = _get(srv, "/definitely_not_an_endpoint")
+    assert st == 404
+
+
+def test_metrics_endpoint(served):
+    srv = served[0]
+    st, headers, body = _get(srv, "/metrics")
+    assert st == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    # every enum bucket is exposed (acceptance criterion)
+    for b in GOODPUT_BUCKETS:
+        assert f'singa_time_seconds_total{{bucket="{b}"}}' in body, b
+    # the run's own telemetry rode along and every line parses
+    assert "singa_steps_total 3" in body
+    for line in body.splitlines():
+        if line.startswith("#"):
+            continue
+        assert _SAMPLE_RE.match(line), line
+    # scraping flushed the residual: buckets sum tracks the run clock
+    vals = {b: float(re.search(
+        rf'singa_time_seconds_total{{bucket="{b}"}} ([^ \n]+)', body)
+        .group(1)) for b in GOODPUT_BUCKETS}
+    snap = goodput.get_tracker().snapshot()
+    assert abs(sum(vals.values()) - snap["wall_s"]) \
+        <= 0.1 * snap["wall_s"] + 0.05
+
+
+def test_statusz_golden_sections(served):
+    srv = served[0]
+    st, _h, body = _get(srv, "/statusz")
+    assert st == 200
+    assert "== singa_tpu /statusz ==" in body
+    # explain report (introspect): the compiled step + blame history
+    assert "compile & memory explain" in body
+    assert "step executable" in body
+    assert "recompile history" in body
+    # goodput breakdown with every bucket row
+    assert "== goodput ==" in body
+    for b in GOODPUT_BUCKETS:
+        assert b in body
+    # the 3-step run was productive: a nonzero step line
+    m = re.search(r"step\s+([0-9.]+) s", body)
+    assert m and float(m.group(1)) > 0.0, body
+    assert "== health ==" in body
+
+
+def test_healthz_verdict(served):
+    srv, _m, _tx, _ty, mon = served
+    st, _h, body = _get(srv, "/healthz")
+    assert st == 200
+    v = json.loads(body)
+    assert v["status"] == "ok"          # 3 healthy steps
+    assert v["policy"] == "warn"
+    assert v["healthy_steps"] == 3
+    assert v["last_step"]["step"] == 3
+
+
+def test_healthz_unmonitored():
+    srv = observe.start_diag_server(port=0)
+    try:
+        st, _h, body = _get(srv, "/healthz")
+        assert st == 200
+        assert json.loads(body)["status"] == "unmonitored"
+    finally:
+        diag.stop_diag_server()
+
+
+def test_flightz_roundtrips_a_bundle(served, tmp_path):
+    srv = served[0]
+    st, _h, body = _get(srv, "/flightz")
+    assert st == 200
+    idx = json.loads(body)
+    assert idx["bundles"] == ["flight_step3.jsonl"]
+    st, headers, body = _get(srv, "/flightz?name=flight_step3.jsonl")
+    assert st == 200
+    assert headers["Content-Type"].startswith("application/x-ndjson")
+    fetched = tmp_path / "fetched.jsonl"
+    fetched.write_text(body)
+    b = load_flight_bundle(str(fetched))
+    assert b["header"]["reason"] == "manual"
+    assert b["header"]["step"] == 3
+    assert len(b["steps"]) == 3  # the ring carried all three steps
+
+
+def test_flightz_rejects_bad_names(served):
+    srv = served[0]
+    st, _h, _b = _get(srv, "/flightz?name=../../etc/passwd")
+    assert st == 400
+    st, _h, _b = _get(srv, "/flightz?name=flight_step99.jsonl")
+    assert st == 404
+
+
+def test_profilez_capture(served):
+    """On-demand xplane capture: steps already satisfied -> immediate
+    stop; the response carries the trace dir + parsed top ops. (The
+    first jax.profiler.start_trace in a process is slow — one-time
+    init — hence the generous client timeout.)"""
+    srv = served[0]
+    st, _h, body = _get(srv, "/profilez?steps=0&seconds=0.2", timeout=120)
+    assert st == 200
+    rep = json.loads(body)
+    assert rep["trace_dir"]
+    assert rep["steps_requested"] == 0
+    assert rep["steps_captured"] >= 0
+    assert rep["truncated"] is False
+    assert isinstance(rep["top_ops"], list)
+
+
+def test_profilez_flags_truncation(served):
+    """The seconds cap expiring before N steps pass must be visible in
+    the response (PROFILE.md tells operators to check it): the trace
+    covers a shorter window than requested."""
+    srv = served[0]
+    # nobody is stepping: 5 requested steps can never arrive in 0.2s
+    st, _h, body = _get(srv, "/profilez?steps=5&seconds=0.2", timeout=120)
+    assert st == 200
+    rep = json.loads(body)
+    assert rep["steps_requested"] == 5
+    assert rep["steps_captured"] < 5
+    assert rep["truncated"] is True
+
+
+def test_profilez_rejects_bad_params(served):
+    srv = served[0]
+    st, _h, _b = _get(srv, "/profilez?steps=abc")
+    assert st == 400
+    st, _h, _b = _get(srv, "/profilez?steps=0&seconds=soon")
+    assert st == 400
+
+
+def test_profilez_counts_steps(served):
+    """?steps=N returns once N more train steps have been observed."""
+    srv, m, tx, ty, _mon = served
+    import threading
+
+    def stepper():
+        time.sleep(0.1)
+        for _ in range(2):
+            m(tx, ty)
+
+    t = threading.Thread(target=stepper)
+    t.start()
+    try:
+        st, _h, body = _get(srv, "/profilez?steps=2&seconds=30",
+                            timeout=120)
+    finally:
+        t.join()
+    assert st == 200
+    assert json.loads(body)["steps_captured"] >= 2
+
+
+def test_start_enriches_running_server_context():
+    """A library can start the server early (no model); the training
+    script's later start_diag_server(model=...) applies the context to
+    the running instance instead of silently dropping it."""
+    srv = observe.start_diag_server(port=0)
+    try:
+        assert srv.model is None
+        sentinel_model, sentinel_dev = object(), object()
+        again = observe.start_diag_server(port=0, model=sentinel_model,
+                                          device=sentinel_dev,
+                                          flight_dir="/tmp/flights")
+        assert again is srv
+        assert srv.model is sentinel_model
+        assert srv.device is sentinel_dev
+        assert srv.flight_dir == "/tmp/flights"
+        # a context-free re-start does not wipe the enrichment
+        observe.start_diag_server(port=0)
+        assert srv.model is sentinel_model
+    finally:
+        diag.stop_diag_server()
+
+
+def test_profilez_contended_cleans_up_trace_dir(served):
+    """The 409 path (another capture owns the profiler) must not leave
+    an orphan singa_profilez_* temp dir per polled request."""
+    import glob
+    import os
+    import tempfile
+
+    class BusyDevice:
+        def StartTrace(self, d):
+            raise RuntimeError("profiler already capturing")
+
+    srv = served[0]
+    srv.device = BusyDevice()
+    pattern = os.path.join(tempfile.gettempdir(), "singa_profilez_*")
+    before = set(glob.glob(pattern))
+    st, _h, body = _get(srv, "/profilez?steps=0&seconds=0.1")
+    assert st == 409
+    assert "profiler already capturing" in json.loads(body)["error"]
+    assert set(glob.glob(pattern)) == before
+
+
+def test_profilez_retains_bounded_trace_dirs(served):
+    """Repeated captures must not grow tmp without bound: only the
+    newest _MAX_TRACE_DIRS capture dirs survive, older ones are
+    deleted."""
+    import os
+
+    srv = served[0]
+    dirs = []
+    for _ in range(diag._MAX_TRACE_DIRS + 2):
+        st, _h, body = _get(srv, "/profilez?steps=0&seconds=0.1",
+                            timeout=120)
+        assert st == 200
+        dirs.append(json.loads(body)["trace_dir"])
+    kept = dirs[-diag._MAX_TRACE_DIRS:]
+    for d in dirs:
+        assert os.path.isdir(d) == (d in kept)
+
+
+def test_profilez_capture_aborts_on_server_stop():
+    """A long ?seconds= capture holds the process-global profiler from a
+    daemon handler thread that shutdown never joins — stopping the
+    server must abort the poll loop and release the profiler."""
+    import threading
+
+    class StubDev:
+        def __init__(self):
+            self.stopped = False
+
+        def StartTrace(self, d):
+            pass
+
+        def StopTrace(self):
+            self.stopped = True
+
+    stub = StubDev()
+    srv = observe.start_diag_server(port=0, device=stub)
+    res = {}
+
+    def req():
+        res["st"] = _get(srv, "/profilez?steps=999999&seconds=9999",
+                         timeout=30)[0]
+
+    t = threading.Thread(target=req, daemon=True)
+    t.start()
+    time.sleep(0.3)  # the capture loop is polling singa_steps_total
+    assert not stub.stopped
+    diag.stop_diag_server()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert stub.stopped  # profiler released, not held for 9999s
+
+
+def test_stop_is_idempotent_and_restartable():
+    srv = observe.start_diag_server(port=0)
+    port1 = srv.port
+    diag.stop_diag_server()
+    diag.stop_diag_server()  # second stop: no-op
+    assert diag.get_diag_server() is None
+    srv2 = observe.start_diag_server(port=0)
+    try:
+        st, _h, _b = _get(srv2, "/metrics")
+        assert st == 200
+        assert (srv2.port, port1) != (0, 0)
+    finally:
+        diag.stop_diag_server()
+
+
+def test_start_installs_goodput_tracker():
+    assert goodput.get_tracker() is None  # conftest isolation
+    srv = observe.start_diag_server(port=0)
+    try:
+        assert goodput.get_tracker() is not None
+        st, _h, body = _get(srv, "/statusz")
+        assert "== goodput ==" in body
+    finally:
+        diag.stop_diag_server()

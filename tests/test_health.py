@@ -493,3 +493,16 @@ def test_apply_skip_grown_opt_state():
     out = health.apply_skip({"anomaly": jnp.int32(0)}, old, new)
     assert np.allclose(np.asarray(out[0]), 2.0)
     assert np.allclose(np.asarray(out[1]), 5.0)
+
+
+def test_detach_only_clears_own_active_monitor():
+    """set_health_monitor(None) on one model must not unregister a
+    DIFFERENT model's live monitor from the /healthz surface."""
+    a, b = MLP(), MLP()
+    mon = HealthMonitor(out_dir="/tmp")
+    a.set_health_monitor(mon)
+    assert health.active_monitor() is mon
+    b.set_health_monitor(None)  # b never owned the registration
+    assert health.active_monitor() is mon
+    a.set_health_monitor(None)  # the owner detaching does clear it
+    assert health.active_monitor() is None

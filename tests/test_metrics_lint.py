@@ -139,6 +139,41 @@ def test_lint_phase_label_without_enum(tmp_path):
     assert len(problems) == 1 and "phase=" in problems[0]
 
 
+def test_lint_bucket_label_values(tmp_path):
+    """ISSUE-4 satellite: rule 5 covers the goodput `bucket=` label with
+    the same declared-tuple proof as reason=/phase=."""
+    f = tmp_path / "buckets.py"
+    f.write_text(
+        "from singa_tpu import observe\n"
+        "GOODPUT_BUCKETS = ('step', 'data_wait')\n"
+        "BUCKET_STEP = 'step'\n"
+        # literal member: fine
+        "observe.counter('singa_b_total', 'a').inc(1.0, bucket='step')\n"
+        # module constant member: fine
+        "observe.counter('singa_b_total', 'a').inc(1.0, "
+        "bucket=BUCKET_STEP)\n"
+        # literal NON-member: violation
+        "observe.counter('singa_b_total', 'a').inc(1.0, bucket='lunch')\n"
+        # dynamic, unguarded: violation
+        "def unguarded(b):\n"
+        "    observe.counter('singa_b_total', 'a').inc(1.0, bucket=b)\n"
+        # dynamic behind a membership guard: fine
+        "def guarded(b):\n"
+        "    assert b in GOODPUT_BUCKETS\n"
+        "    observe.counter('singa_b_total', 'a').inc(1.0, bucket=b)\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 2, problems
+    assert any("'lunch'" in p for p in problems)
+    assert any("dynamic" in p for p in problems)
+
+
+def test_lint_goodput_enum_usage_clean():
+    """goodput.py's own bucket= recording passes the enum rule (also
+    covered by the default-scan test; this pins the file)."""
+    gp = os.path.join(check_metrics_names.ROOT, "singa_tpu", "goodput.py")
+    assert check_metrics_names.check([gp]) == []
+
+
 def test_lint_introspect_enum_usage_clean():
     """introspect.py's own reason=/phase= recording passes the enum
     rule (it is part of the default scan, so test_package_metric_names

@@ -264,10 +264,14 @@ def test_train_step_telemetry(dev, rng, reg, tmp_path):
     assert h.count() == 3 and h.sum() > 0
     assert reg.get("singa_steps_total").value() == 3
     assert reg.get("singa_step_donated_bytes").value() > 0
-    # optimizer instrumentation fired at trace time: 4 params, once
+    # optimizer instrumentation fired at trace time: 4 params, once —
+    # nested under the AOT staging span since the goodput layer (the
+    # trace runs inside introspect.build_compiled)
     assert reg.get("singa_opt_updates_total").value(strategy="local") == 4
     assert reg.get("singa_span_seconds").count(
-        span="opt.apply_updates") == 1
+        span="introspect.build/opt.apply_updates") == 1
+    # and the per-step dispatch span fired once per step
+    assert reg.get("singa_span_seconds").count(span="model.step") == 3
 
     n = _assert_valid_prometheus(observe.to_prometheus_text())
     assert n >= 3

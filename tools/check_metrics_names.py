@@ -17,10 +17,11 @@ literal, then fails if
   4. the same non-empty help string is registered for two DIFFERENT
      metric names (copy-pasted helps make /metrics output ambiguous;
      every name must describe itself), or
-  5. a `reason=` / `phase=` label value on a metric record call
+  5. a `reason=` / `phase=` / `bucket=` label value on a metric record call
      (.inc/.set/.observe/.dec) does not come from a declared enum: these
      labels are CONTRACTUALLY low-cardinality (introspect.py's
-     RECOMPILE_REASONS / COMPILE_PHASES), so a string literal must be a
+     RECOMPILE_REASONS / COMPILE_PHASES, goodput.py's GOODPUT_BUCKETS),
+     so a string literal must be a
      member of a module-level ALL-CAPS tuple of string literals, a NAME
      must be a module-level constant whose value is a member, and a
      dynamic expression is allowed only inside a function that references
@@ -103,8 +104,10 @@ def registrations_in(path, tree=None):
         yield first.value, fname, help_text, node.lineno
 
 
-# Enum-guarded label kwargs: values must be provably low-cardinality.
-ENUM_LABEL_KWARGS = ("reason", "phase")
+# Enum-guarded label kwargs: values must be provably low-cardinality
+# (reason/phase: introspect.py's RECOMPILE_REASONS / COMPILE_PHASES;
+# bucket: goodput.py's GOODPUT_BUCKETS).
+ENUM_LABEL_KWARGS = ("reason", "phase", "bucket")
 RECORD_FUNCS = {"inc", "set", "observe", "dec"}
 
 
