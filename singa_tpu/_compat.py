@@ -13,6 +13,13 @@ Shims:
 - `jax.lax.axis_size`: absent on old jax; `lax.psum(1, name)` is the
   classic spelling and constant-folds to a static Python int inside
   mapped contexts (verified on 0.4.37), so the shim is exact.
+- `has_async_checkpointer` / `make_async_checkpointer` /
+  `standard_save_args`: the orbax async-save surface
+  (`AsyncCheckpointer` + `StandardCheckpointHandler` +
+  `args.StandardSave`) behind one probe — `has_` is a side-effect-free
+  attribute check, `make_` constructs (returning None on an orbax too
+  old to have it) — singa_tpu.overlap falls back to the blocking
+  `StandardCheckpointer` write in that case.
 """
 
 from __future__ import annotations
@@ -44,6 +51,43 @@ def _install_axis_size():
         return lax.psum(1, axis_name)
 
     lax.axis_size = axis_size
+
+
+def has_async_checkpointer() -> bool:
+    """True when this orbax HAS the async-save surface. A pure attribute
+    probe: constructing an `AsyncCheckpointer` spins up orbax's
+    process-wide resident thread pools, which an availability question
+    (asked by every /statusz scrape) must not pay for."""
+    try:
+        import orbax.checkpoint as ocp
+        return (hasattr(ocp, "AsyncCheckpointer")
+                and hasattr(ocp, "StandardCheckpointHandler")
+                and hasattr(getattr(ocp, "args", None), "StandardSave"))
+    except Exception:
+        return False
+
+
+def make_async_checkpointer():
+    """An orbax `AsyncCheckpointer` over the standard pytree handler, or
+    None when this orbax release cannot async-save (missing class, or
+    construction fails) — the caller then uses the sync write path.
+    Imports orbax lazily: checkpointing is the only consumer."""
+    try:
+        import orbax.checkpoint as ocp
+        return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    except Exception:
+        return None
+
+
+def standard_save_args(tree):
+    """The `args=` wrapper an AsyncCheckpointer.save expects for a plain
+    pytree (`ocp.args.StandardSave`), or None when this orbax predates
+    the args API (sync fallback)."""
+    try:
+        import orbax.checkpoint as ocp
+        return ocp.args.StandardSave(tree)
+    except Exception:
+        return None
 
 
 _install_shard_map()

@@ -39,6 +39,11 @@ from . import observe
 def _record_consumer_wait(kind: str, seconds: float, depth=None):
     if not observe.is_enabled():
         return
+    if observe.spans_suppressed():
+        # this "consumer" is a background thread (the overlap
+        # prefetcher driving us under suppress_spans): its queue waits
+        # are overlapped with training, not training-loop stall time
+        return
     observe.histogram(
         "singa_data_consumer_blocked_seconds",
         "wall seconds the training loop spent blocked on the next batch"
@@ -89,6 +94,20 @@ class ImageBatchIter:
                 f"sample(s) in {img_list_file}")
 
     def start(self):
+        if self.p is not None and self.p.is_alive():
+            # restarting for a new epoch stream while the previous worker
+            # is alive: stop it first — two workers would interleave
+            # batches into one queue and the old process would leak
+            self.end()
+        # end() (a previous epoch's, or the stop above) left the flag
+        # set and possibly a stale in-flight batch in the queue; a fresh
+        # worker needs both cleared
+        self.stop_flag.clear()
+        while not self.queue.empty():
+            try:
+                self.queue.get_nowait()
+            except _queue.Empty:
+                break
         self.p = Process(target=self.run, daemon=True)
         self.p.start()
 
@@ -202,6 +221,23 @@ class NumpyBatchIter:
         n = len(x) // batch_size if drop_last else -(-len(x) // batch_size)
         self.num_batches = n
         self._producer_thread = None  # last epoch's producer (tests/join)
+        self._producer_lock = None    # its condition + stop flag, kept so
+        self._producer_stop = None    # a re-iteration can reap it
+
+    def _stop_producer(self, timeout=2.0):
+        """Stop-and-join the previous epoch's producer thread, if one is
+        still alive (the consumer abandoned the generator without
+        closing it). Re-iterating must not stack producers: the old one
+        would sit parked on its condition until interpreter exit."""
+        t = self._producer_thread
+        if t is None or not t.is_alive():
+            return
+        lock, stop = self._producer_lock, self._producer_stop
+        if lock is not None:
+            with lock:
+                stop[0] = True
+                lock.notify_all()
+        t.join(timeout=timeout)
 
     def __len__(self):
         return self.num_batches
@@ -214,12 +250,15 @@ class NumpyBatchIter:
         return xb, self.y[sel]
 
     def __iter__(self):
+        self._stop_producer()  # a previous epoch's live producer first
         order = np.arange(len(self.x))
         if self.shuffle:
             self.rng.shuffle(order)
         nxt = {}
         lock = threading.Condition()
         stop = [False]  # set when the consumer abandons the iterator early
+        self._producer_lock = lock
+        self._producer_stop = stop
         if observe.is_enabled():
             observe.gauge(
                 "singa_data_prefetch_depth",
@@ -243,7 +282,7 @@ class NumpyBatchIter:
                     lock.notify_all()
 
         t = self._producer_thread = threading.Thread(
-            target=producer, daemon=True)
+            target=producer, name="singa-data-producer", daemon=True)
         t.start()
         try:
             for b in range(self.num_batches):

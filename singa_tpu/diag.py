@@ -136,6 +136,11 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:
             parts.append(f"(explain unavailable: {e})")
         parts.append(goodput.goodput_report())
+        try:
+            from . import overlap
+            parts.append(overlap.overlap_report())
+        except Exception as e:
+            parts.append(f"(overlap unavailable: {e})")
         mon = self._monitor()
         if mon is None:
             parts.append("== health ==\nno HealthMonitor attached")

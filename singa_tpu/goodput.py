@@ -94,6 +94,10 @@ SPAN_BUCKETS = {
     "snapshot.load": BUCKET_CHECKPOINT,
     "checkpoint.save": BUCKET_CHECKPOINT,
     "checkpoint.load": BUCKET_CHECKPOINT,
+    # async-ckpt barrier (overlap.wait_for_checkpoints): the only other
+    # blocking portion of an async save — the overlapped background
+    # write itself is deliberately unspanned (it is the reclaimed time)
+    "checkpoint.wait": BUCKET_CHECKPOINT,
     "model.eval": BUCKET_EVAL,
 }
 

@@ -155,6 +155,7 @@ class Model(Layer, metaclass=ModelMeta):
         if isinstance(self._compiled_step, dict):
             self._compiled_step = {}   # drop stale-flag executables
             self._step_execs = {}
+            self._dispatch_cache = {}
         self._compiled_eval = None
         self._eval_execs = {}
 
@@ -317,6 +318,10 @@ class Model(Layer, metaclass=ModelMeta):
                        if not isinstance(a, Tensor)}
         self._tensor_pos = tensor_pos
         self._static_args = static_args
+        # dispatch fast path: the per-step static-arg guard re-checks
+        # values against these without rebuilding/comparing dicts
+        self._n_call_args = len(example_args)
+        self._static_items = tuple(sorted(static_args.items()))
         out_template_box = {}
 
         def make_step(tag):
@@ -483,23 +488,43 @@ class Model(Layer, metaclass=ModelMeta):
         self._compiled_step = {}   # step-tag -> jitted executable
         self._step_execs = {}      # (tag, abstract sig) -> AOT executable
         self._step_sigs = set()    # (tag, input shapes) variants seen
+        # (tag, abstract sig) -> [step_fn, flops, sig, recorded]:
+        # everything the cached dispatch needs, resolved once per variant
+        # so the hot path does O(#inputs) work (the key) instead of
+        # rebuilding signatures/cache lookups every step
+        self._dispatch_cache = {}
         self._step_stats["compile_s"] = time.perf_counter() - t0
         observe.record_step_build(self._step_stats["compile_s"])
+
+    def _static_mismatch(self, args):
+        """Rebuild the full dict comparison only to phrase the error —
+        the per-step guard already proved a mismatch (or a change in
+        which positions carry Tensors)."""
+        cur_static = {i: a for i, a in enumerate(args)
+                      if not isinstance(a, Tensor)}
+        raise ValueError(
+            f"graph mode compiled with static args {self._static_args}, "
+            f"got {cur_static}; non-Tensor arguments cannot change "
+            "between calls (recompile by resetting the model, or run "
+            "with use_graph=False)")
 
     def _invoke_step(self, args):
         opt = self._optimizer
         dev = self._device
         # non-Tensor args (dist_option, spars, ...) are baked into the
         # compiled step at trace time; changing them later must not be
-        # silently ignored
-        cur_static = {i: a for i, a in enumerate(args)
-                      if not isinstance(a, Tensor)}
-        if cur_static != self._static_args:
-            raise ValueError(
-                f"graph mode compiled with static args {self._static_args}, "
-                f"got {cur_static}; non-Tensor arguments cannot change "
-                "between calls (recompile by resetting the model, or run "
-                "with use_graph=False)")
+        # silently ignored. Positions were fixed at build time, so the
+        # hot path re-checks values in place instead of building and
+        # comparing a fresh dict every step.
+        if len(args) != self._n_call_args:
+            self._static_mismatch(args)
+        for i, v in self._static_items:
+            a = args[i]
+            if isinstance(a, Tensor) or a != v:
+                self._static_mismatch(args)
+        for i in self._tensor_pos:
+            if not isinstance(args[i], Tensor):
+                self._static_mismatch(args)
         state_arrs = [t.data for t in self._state_tensors]
         opt_arrs = opt.state_arrays() if opt is not None else []
         input_arrs = [args[i].data for i in self._tensor_pos]
@@ -558,64 +583,48 @@ class Model(Layer, metaclass=ModelMeta):
             bs = input_arrs[0].shape[0]
         step_fn = fn
         exec_key = None
+        variant = None
         cold_jit = False  # this dispatch pays a fresh jit trace+compile
         if not self.sequential:
-            # AOT executable per abstract signature: the explicit
-            # trace -> lower -> compile staging happens on a cache miss
-            # ONLY, so compile-phase timing, cost/memory harvesting and
-            # recompile blame all land at build/retrace time; the cached
-            # path below dispatches the same executable bytes jit would
-            # have cached, with zero added per-step work. len(opt_arrs)
-            # is in the key because the sparse strategies GROW their
-            # optimizer state (new residual slots) between steps.
+            # dispatch fast path: one O(#inputs) key resolves everything
+            # a repeat step needs — the AOT executable (or jit fallback),
+            # its harvested flops, and the already-recorded observe
+            # signature — so the cached path rebuilds no signatures and
+            # touches no introspection. len(opt_arrs) is in the key
+            # because the sparse strategies GROW their optimizer state
+            # (new residual slots) between steps.
             exec_key = (tag,
                         tuple((tuple(a.shape), str(a.dtype))
                               for a in input_arrs),
                         len(opt_arrs))
-            entry = self._step_execs.get(exec_key, _AOT_MISS)
-            if entry is _AOT_MISS:
-                asig = introspect.signature(
-                    (state_arrs, opt_arrs, rng, input_arrs),
-                    names=("state", "opt", "rng", "arg"), tag=tag,
-                    static=repr(sorted(
-                        (i, repr(v))
-                        for i, v in self._static_args.items())),
-                    donated=(0, 1), batch_hint=bs)
-                aot, rec = introspect.build_compiled(
-                    fn, (state_arrs, opt_arrs, rng, input_arrs),
-                    "step", asig, device=dev)
-                # a failed build negative-caches as None so the cached
-                # path never re-pays a staging attempt per step
-                entry = self._step_execs[exec_key] = None if aot is None \
-                    else (aot, float((rec or {}).get("cost", {})
-                                     .get("flops", 0) or 0))
-                # staging just failed: the jit dispatch below compiles
-                # cold — goodput must book that as compile, not step
-                cold_jit = aot is None
-            if entry is not None:
-                step_fn, aot_flops = entry
-                # the MFU gauge must use the DISPATCHED variant's flops,
-                # not the most recently built one (a partial-batch build
-                # would otherwise skew later full-batch readings)
-                introspect.note_step_flops(aot_flops)
-            else:
-                # negative-cached: this variant dispatches via plain jit
-                # and has no harvested flops — zero disables the MFU
-                # gauge rather than feeding it a stale variant's count
-                introspect.note_step_flops(0)
+            variant = self._dispatch_cache.get(exec_key)
+            if variant is None:
+                variant, cold_jit = self._dispatch_slow_path(
+                    exec_key, tag, fn, state_arrs, opt_arrs, rng,
+                    input_arrs, bs)
+            step_fn = variant[0]
+            # the MFU gauge must use the DISPATCHED variant's flops, not
+            # the most recently built one (a partial-batch build would
+            # otherwise skew later full-batch readings); 0 for a
+            # negative-cached variant disables the gauge instead
+            introspect.note_step_flops(variant[1])
         else:
             introspect.note_step_flops(0)  # sequential: no AOT variant
         if obs:
             # (tag, input-shape) signature: jit retraces exactly when it
             # changes, so first-seen == a compile (first ever) or a
-            # recompile (new batch-size class / step tag)
-            sig = (tag, tuple(getattr(a, "shape", ()) for a in input_arrs))
-            if sig not in self._step_sigs:
-                observe.record_compile(
-                    bs, recompile=bool(self._step_sigs),
-                    donated_bytes=sum(int(getattr(a, "nbytes", 0))
-                                      for a in (*state_arrs, *opt_arrs)))
-                self._step_sigs.add(sig)
+            # recompile (new batch-size class / step tag). A variant
+            # records at most once (its flag), so the cached path skips
+            # the signature rebuild + set lookup entirely.
+            if variant is not None:
+                if not variant[3]:
+                    variant[3] = True
+                    self._record_step_sig(variant[2], bs,
+                                          state_arrs, opt_arrs)
+            else:  # sequential debug path: no variant cache
+                sig = (tag,
+                       tuple(getattr(a, "shape", ()) for a in input_arrs))
+                self._record_step_sig(sig, bs, state_arrs, opt_arrs)
             t_obs = time.perf_counter()
         profiling = (dev.verbosity > 0 and
                      self._step_stats["steps"] >= dev.skip_iteration)
@@ -646,6 +655,9 @@ class Model(Layer, metaclass=ModelMeta):
                 # negative-cache the signature so jit owns it from now on —
                 # correctness over telemetry, and no rebuild-per-step churn
                 self._step_execs[exec_key] = None
+                if variant is not None:
+                    variant[0] = fn     # later fast-path hits go straight
+                    variant[1] = 0.0    # to jit, with the MFU gauge off
                 introspect.note_step_flops(0)  # this step: jit-dispatched
                 with observe.span("model.jit_fallback"):
                     new_states, new_opt, new_rng, outs, hstats = fn(
@@ -691,6 +703,59 @@ class Model(Layer, metaclass=ModelMeta):
         tensors = [Tensor(data=a, device=dev, requires_grad=False)
                    for a in outs]
         return _rebuild_out(self._out_template_box["t"], tensors)
+
+    def _dispatch_slow_path(self, exec_key, tag, fn, state_arrs, opt_arrs,
+                            rng, input_arrs, bs):
+        """First dispatch of a (tag, abstract-signature) variant: the
+        explicit trace -> lower -> compile staging happens here ONLY, so
+        compile-phase timing, cost/memory harvesting and recompile blame
+        all land at build/retrace time; the resolved executable (the
+        same bytes jit would have cached), its flops, and the observe
+        signature are cached in a slim per-variant record for every
+        later step. Returns (variant_record, cold_jit)."""
+        entry = self._step_execs.get(exec_key, _AOT_MISS)
+        cold_jit = False
+        if entry is _AOT_MISS:
+            asig = introspect.signature(
+                (state_arrs, opt_arrs, rng, input_arrs),
+                names=("state", "opt", "rng", "arg"), tag=tag,
+                static=repr(sorted(
+                    (i, repr(v))
+                    for i, v in self._static_args.items())),
+                donated=(0, 1), batch_hint=bs)
+            aot, rec = introspect.build_compiled(
+                fn, (state_arrs, opt_arrs, rng, input_arrs),
+                "step", asig, device=self._device)
+            # a failed build negative-caches as None so the cached path
+            # never re-pays a staging attempt per step
+            entry = self._step_execs[exec_key] = None if aot is None \
+                else (aot, float((rec or {}).get("cost", {})
+                                 .get("flops", 0) or 0))
+            # staging just failed: the jit dispatch below compiles
+            # cold — goodput must book that as compile, not step
+            cold_jit = aot is None
+        if entry is not None:
+            step_fn, flops = entry
+        else:
+            step_fn, flops = fn, 0.0  # negative-cached: plain jit owns it
+        sig = (tag, tuple(getattr(a, "shape", ()) for a in input_arrs))
+        variant = self._dispatch_cache[exec_key] = \
+            [step_fn, flops, sig, False]
+        return variant, cold_jit
+
+    def _record_step_sig(self, sig, bs, state_arrs, opt_arrs):
+        """First sighting of a (tag, input-shape) signature == a jit
+        trace: record the compile (or recompile, when other signatures
+        exist) with the donated-buffer bytes. Shared by the variant
+        fast path and the sequential debug path."""
+        if sig in self._step_sigs:
+            return
+        observe.record_compile(
+            bs, recompile=bool(self._step_sigs),
+            donated_bytes=sum(
+                int(getattr(a, "nbytes", 0))
+                for a in (*state_arrs, *opt_arrs)))
+        self._step_sigs.add(sig)
 
     # ---- training health (singa_tpu.health) ------------------------------
     def _health_groups(self):
@@ -738,12 +803,21 @@ class Model(Layer, metaclass=ModelMeta):
         return out
 
     # ---- minimal training loop -------------------------------------------
-    def fit(self, data, epochs=1, verbose=0):
+    def fit(self, data, epochs=1, verbose=0, prefetch_to_device=0):
         """Host-side training loop over `data`, an iterable of per-batch
         argument tuples for `train_one_batch` (re-iterated each epoch, so
         pass a list/dataset, not a one-shot generator). Returns the list
         of per-epoch mean losses (by convention the second element of the
         step's return, or the whole return when it is a single Tensor).
+
+        prefetch_to_device=N wraps each epoch's iterator in an
+        overlap.DevicePrefetcher: a background thread moves up to N
+        batches to the device (with the model's input sharding) ahead of
+        consumption, so host batch assembly and host->device transfer
+        overlap the previous step's execution instead of serializing
+        into the goodput `data_wait` bucket. The prefetcher is closed on
+        every exit path — normal end of epoch, an early break, or a
+        HealthError raised out of the loop.
 
         This is where the health layer meets the loop: every step feeds
         the attached HealthMonitor (skip_step discards bad updates
@@ -755,28 +829,40 @@ class Model(Layer, metaclass=ModelMeta):
             losses = []
             with observe.span("model.fit_epoch", epoch=epoch):
                 it = iter(data)
-                while True:
-                    # fetch wait measured per batch: the host-side
-                    # pipeline stall signal (goodput `data_wait`; an
-                    # iterator's own data.wait span nests and nets out)
-                    with observe.span("data.wait"):
-                        batch = next(it, _end)
-                    if batch is _end:
-                        break
-                    if not isinstance(batch, (tuple, list)):
-                        batch = (batch,)
-                    out = self(*batch)
-                    loss = out[1] if isinstance(out, (tuple, list)) \
-                        and len(out) > 1 else out
-                    if isinstance(loss, Tensor):
-                        # keep the device scalar; fetch once per epoch so
-                        # the loop stays async-dispatched
-                        losses.append(loss.data)
+                prefetcher = None
+                if prefetch_to_device:
+                    from . import overlap
+                    prefetcher = overlap.DevicePrefetcher(
+                        it, model=self, size=int(prefetch_to_device))
+                    it = prefetcher
+                try:
+                    while True:
+                        # fetch wait measured per batch: the host-side
+                        # pipeline stall signal (goodput `data_wait`; an
+                        # iterator's own data.wait span nests, nets out)
+                        with observe.span("data.wait"):
+                            batch = next(it, _end)
+                        if batch is _end:
+                            break
+                        if not isinstance(batch, (tuple, list)):
+                            batch = (batch,)
+                        out = self(*batch)
+                        loss = out[1] if isinstance(out, (tuple, list)) \
+                            and len(out) > 1 else out
+                        if isinstance(loss, Tensor):
+                            # keep the device scalar; fetch once per
+                            # epoch so the loop stays async-dispatched
+                            losses.append(loss.data)
+                finally:
+                    if prefetcher is not None:
+                        prefetcher.close()
             if not losses:
                 raise ValueError(
                     f"fit epoch {epoch} saw no batches - `data` must be "
                     "re-iterable across epochs (a list, not a generator)")
-            vals = [float(np.asarray(jax.device_get(a))) for a in losses]
+            # ONE transfer for the whole epoch (was one device_get per
+            # element — a host<->device round-trip per step)
+            vals = [float(np.asarray(a)) for a in jax.device_get(losses)]
             mean = sum(vals) / len(vals)
             history.append(mean)
             if verbose:
@@ -1018,17 +1104,30 @@ class Model(Layer, metaclass=ModelMeta):
     # which writes sharded jax.Arrays per-shard (no host gather): the
     # pod-scale checkpoint path the zip format cannot be.
     def save_checkpoint(self, ckpt_dir: str, step: int = 0,
-                        overwrite: bool = False):
+                        overwrite: bool = False, async_save: bool = True):
         """Write a resumable training checkpoint under `ckpt_dir/step_N`.
         Captures model states, optimizer state (slot buffers + step
         counter) and the device PRNG stream, so training resumed from it
         is bit-identical to uninterrupted training (tests/test_model.py::
         test_checkpoint_resume_equivalence). An existing step_N directory
         raises unless `overwrite=True` (a save-latest loop should either
-        thread a real step counter or pass overwrite)."""
+        thread a real step counter or pass overwrite).
+
+        async_save=True (the default) routes the write through orbax's
+        AsyncCheckpointer when this orbax has one: the call returns once
+        the device->host snapshot is taken and the serialize/write
+        overlaps training. The bytes are durable only after
+        `singa_tpu.overlap.wait_for_checkpoints()` — auto-invoked by the
+        next save, by `load_checkpoint`, and at interpreter exit — which
+        also re-raises any deferred write failure. Pass async_save=False
+        (or run on an old orbax) for the blocking write."""
         import jax
         import orbax.checkpoint as ocp
+        from . import overlap
         from .device import get_default_device
+        # barrier on the previous async save: at most one write is in
+        # flight, and its deferred error surfaces HERE, not never
+        overlap.wait_for_checkpoints()
         dev = self._device or get_default_device()
         rng = dev.rng_state
         if jnp.issubdtype(getattr(rng, "dtype", None), jax.dtypes.prng_key):
@@ -1054,15 +1153,21 @@ class Model(Layer, metaclass=ModelMeta):
             "res": res_tree,
             "rng": rng,
         }
-        ck = ocp.StandardCheckpointer()
         path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+        nbytes = sum(int(getattr(a, "nbytes", 0) or 0)
+                     for a in jax.tree_util.tree_leaves(tree))
+        if async_save and overlap.start_async_save(path, tree,
+                                                   force=overwrite):
+            # blocking portion only (the snapshot) was spanned inside
+            # start_async_save; the background write is the overlap
+            observe.record_checkpoint_bytes(nbytes)
+            return path
+        ck = ocp.StandardCheckpointer()
         # span -> the goodput `checkpoint` bucket
         with observe.span("checkpoint.save"):
             ck.save(path, tree, force=overwrite)
             ck.wait_until_finished()
-        observe.record_checkpoint_bytes(sum(
-            int(getattr(a, "nbytes", 0) or 0)
-            for a in jax.tree_util.tree_leaves(tree)))
+        observe.record_checkpoint_bytes(nbytes)
         return path
 
     def _restore_template(self, path):
@@ -1128,6 +1233,11 @@ class Model(Layer, metaclass=ModelMeta):
         examples/multihost/ckpt_2proc.py (the CI leg)."""
         import jax
         import orbax.checkpoint as ocp
+        from . import overlap
+        # barrier: an async save of THIS path (or any other) must be
+        # durable before restore reads it — and its deferred error must
+        # surface here rather than restore racing a half-written dir
+        overlap.wait_for_checkpoints()
         ck = ocp.StandardCheckpointer()
         with observe.span("checkpoint.load"):
             tree = ck.restore(os.path.abspath(path),

@@ -488,6 +488,33 @@ def current_span() -> "str | None":
     return stack[-1] if stack else None
 
 
+class suppress_spans:
+    """Context manager: `span()` regions entered on THIS thread while
+    active are no-ops (no histogram, no trace annotation, no listener
+    callbacks). For background worker threads whose internal waits must
+    not be attributed as run wall time — the overlap prefetcher runs
+    its source iterator under this, so a wrapped NumpyBatchIter's own
+    data.wait spans don't book overlapped producer time into the
+    goodput `data_wait` bucket the prefetch exists to drain. Reentrant
+    (a depth counter, not a flag)."""
+
+    def __enter__(self):
+        _tls.suppress = getattr(_tls, "suppress", 0) + 1
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _tls.suppress = max(0, getattr(_tls, "suppress", 1) - 1)
+        return False
+
+
+def spans_suppressed() -> bool:
+    """True while `suppress_spans` is active on the calling thread —
+    for metric sites that should also stay quiet on suppressed worker
+    threads (data.py's consumer-blocked histogram: a background
+    prefetch producer is not the training loop)."""
+    return bool(getattr(_tls, "suppress", 0))
+
+
 class span:
     """`with span("serving.prefill", tokens=4096): ...`
 
@@ -501,15 +528,19 @@ class span:
     (annotation + wall time then describe the trace, not the step).
     """
 
-    __slots__ = ("name", "attrs", "path", "_t0", "_ann")
+    __slots__ = ("name", "attrs", "path", "_t0", "_ann", "_off")
 
     def __init__(self, name: str, **attrs):
         self.name = name
         self.attrs = attrs
         self.path = None
         self._ann = None
+        self._off = False
 
     def __enter__(self):
+        if getattr(_tls, "suppress", 0):
+            self._off = True  # suppress_spans active on this thread
+            return self
         stack = getattr(_tls, "span_stack", None)
         if stack is None:
             stack = _tls.span_stack = []
@@ -532,6 +563,8 @@ class span:
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        if self._off:
+            return False
         dt = time.perf_counter() - self._t0
         if self._ann is not None:
             try:
@@ -710,6 +743,45 @@ def record_decode(kind: str, seconds: float, new_tokens: int, batch: int,
                    "tokens_per_sec": round(tps, 3)})
 
 
+def record_prefetch(depth: "int | None" = None,
+                    blocked_s: "float | None" = None,
+                    produced: bool = False):
+    """DevicePrefetcher telemetry (singa_tpu.overlap): ring occupancy,
+    consumer blocked-time on an empty ring (the wall time its data.wait
+    span also feeds into the goodput `data_wait` bucket), and batches
+    the producer moved to the device."""
+    if not _enabled:
+        return
+    if depth is not None:
+        gauge("singa_prefetch_ring_depth",
+              "on-device batches ready in the prefetch ring"
+              ).set(float(depth))
+    if blocked_s is not None:
+        histogram("singa_prefetch_blocked_seconds",
+                  "wall seconds the consumer blocked on an empty "
+                  "prefetch ring").observe(blocked_s)
+    if produced:
+        counter("singa_prefetch_batches_total",
+                "batches the prefetcher moved to the device").inc()
+
+
+def record_ckpt_async(pending: int, blocking_s: "float | None" = None):
+    """Async-checkpoint telemetry (singa_tpu.overlap): in-flight save
+    count, and — when a save just started — how long it blocked the
+    caller before handing the write to the background thread."""
+    if not _enabled:
+        return
+    gauge("singa_checkpoint_async_pending",
+          "async checkpoint saves started but not yet durable"
+          ).set(float(pending))
+    if blocking_s is not None:
+        histogram("singa_checkpoint_async_blocking_seconds",
+                  "wall seconds save_checkpoint blocked before returning "
+                  "(async path)").observe(blocking_s)
+        counter("singa_checkpoint_async_total",
+                "async checkpoint saves started").inc()
+
+
 def record_checkpoint_bytes(nbytes: int):
     """Bytes of the checkpoint/snapshot flush that just completed
     (model.save_checkpoint's orbax tree, Snapshot.flush's store)."""
@@ -736,7 +808,8 @@ def record_bench(rec: dict):
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "EventLog",
-    "span", "current_span", "get_registry", "enable", "is_enabled",
+    "span", "suppress_spans", "spans_suppressed", "current_span",
+    "get_registry", "enable", "is_enabled",
     "counter", "gauge", "histogram", "set_event_log", "get_event_log",
     "to_prometheus_text", "dump", "DEFAULT_BUCKETS", "SPAN_TRACE_PREFIX",
     "set_step_callback", "add_span_listener", "remove_span_listener",
@@ -744,4 +817,5 @@ __all__ = [
     "record_step", "record_step_build", "record_step_fenced",
     "record_compile", "record_hbm", "record_opt_update", "record_comm",
     "record_decode", "record_bench", "record_checkpoint_bytes",
+    "record_prefetch", "record_ckpt_async",
 ]

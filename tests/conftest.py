@@ -29,8 +29,17 @@ except AttributeError:
 # directly, so install before any test module imports
 import singa_tpu._compat  # noqa: E402,F401
 
+import threading  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# Non-daemon worker pools orbax creates process-wide on first use and
+# keeps for the process lifetime (checkpointer.close() reaps them, but
+# the pools are shared across checkpointers) — legitimate residents, not
+# leaks. Anything non-daemon outside this list IS a leak.
+_ORBAX_POOL_THREADS = ("metadata_store", "array_type_handler",
+                       "base_pytree_ch", "utils_thread")
 
 
 @pytest.fixture(autouse=True)
@@ -40,7 +49,9 @@ def _metrics_isolation():
     instrumentation enabled — counter state accumulated by one test can
     no longer leak into another's assertions. Teardown also stops any
     diag server and uninstalls the goodput tracker, so tests never leak
-    HTTP ports, server threads, or span listeners."""
+    HTTP ports, server threads, or span listeners — and (ISSUE-5)
+    asserts the test left no async checkpoint pending, no prefetcher
+    thread alive, and no stray non-daemon thread behind."""
     from singa_tpu import diag, goodput, health, introspect, observe
     diag.stop_diag_server()
     goodput.uninstall()
@@ -52,6 +63,26 @@ def _metrics_isolation():
     yield
     diag.stop_diag_server()
     goodput.uninstall()
+    from singa_tpu import overlap
+    pending = overlap.pending_checkpoints()
+    # drain regardless so ONE leaky test doesn't cascade into the rest
+    # of the suite; re-raise a deferred write failure as this test's
+    overlap.wait_for_checkpoints()
+    assert pending == 0, (
+        f"{pending} async checkpoint save(s) left pending — call "
+        "overlap.wait_for_checkpoints() (or load_checkpoint) before "
+        "the test ends")
+    stray_prefetch = [t.name for t in threading.enumerate()
+                      if t.is_alive()
+                      and t.name.startswith("singa-prefetch")]
+    assert not stray_prefetch, (
+        f"prefetcher thread(s) leaked: {stray_prefetch} — close() the "
+        "DevicePrefetcher (Model.fit does this on every exit path)")
+    stray = [t.name for t in threading.enumerate()
+             if t.is_alive() and t is not threading.main_thread()
+             and not t.daemon
+             and not t.name.startswith(_ORBAX_POOL_THREADS)]
+    assert not stray, f"non-daemon thread(s) leaked: {stray}"
 
 
 @pytest.fixture

@@ -60,6 +60,41 @@ def test_numpy_batch_iter_joins_producer_on_abandonment():
     assert not it._producer_thread.is_alive()
 
 
+def test_numpy_batch_iter_reiteration_reaps_live_producer():
+    """ISSUE-5 satellite: re-iterating for a new epoch while the
+    previous epoch's producer is still alive (the consumer abandoned
+    the generator without closing it) must stop/join the old thread —
+    producers never stack across epochs — and the new epoch still
+    yields every batch."""
+    x = np.arange(128, dtype=np.float32).reshape(128, 1)
+    y = np.arange(128, dtype=np.int32)
+    it = data.NumpyBatchIter(x, y, 8, shuffle=False, prefetch=4)
+    g = iter(it)
+    next(g)  # abandon mid-epoch WITHOUT closing: producer stays parked
+    old = it._producer_thread
+    assert old is not None and old.is_alive()
+    seen = [yb[0] for _, yb in iter(it)]  # epoch 2
+    assert not old.is_alive()             # old producer was reaped
+    assert len(seen) == 16                # and the new epoch is complete
+    assert not it._producer_thread.is_alive()
+    g.close()  # the abandoned generator's finally is a no-op now
+
+
+def test_numpy_batch_iter_epoch_loop_leaves_no_threads():
+    """The Model.fit pattern — iter(data) once per epoch — ends each
+    epoch with the producer joined (generator finally), so an N-epoch
+    run leaks nothing."""
+    import threading
+    x = np.zeros((32, 1), np.float32)
+    y = np.zeros(32, np.int32)
+    it = data.NumpyBatchIter(x, y, 8, shuffle=False)
+    for _ in range(3):
+        assert sum(1 for _ in it) == 4
+    assert not it._producer_thread.is_alive()
+    assert not any(t.name == "singa-data-producer"
+                   for t in threading.enumerate() if t.is_alive())
+
+
 def test_numpy_batch_iter_raises_on_dead_producer():
     """Same dead-producer guard as ImageBatchIter: a transform that
     raises kills the producer thread, and the consumer must get a
@@ -116,6 +151,46 @@ def test_image_batch_iter_raises_on_dead_worker(tmp_path):
     try:
         with pytest.raises(RuntimeError, match="worker process died"):
             next(it)
+    finally:
+        it.end()
+
+
+def test_image_batch_iter_restart_stops_previous_worker(tmp_path):
+    """ISSUE-5 satellite: start() while the previous epoch's worker
+    process is alive must terminate it first (no two workers feeding
+    one queue, no leaked process), and the restarted stream serves
+    fresh batches."""
+    lst = tmp_path / "list.txt"
+    lst.write_text("a.png 0\nb.png 1\nc.png 2\nd.png 3\n")
+    it = data.ImageBatchIter(str(lst), 2, _ident_images, shuffle=False)
+    it.start()
+    try:
+        next(it)
+        old = it.p
+        assert old.is_alive()
+        it.start()  # epoch restart with the old worker still running
+        assert not old.is_alive()
+        assert it.p is not old
+        x, yb = next(it)  # the fresh worker serves from batch 0 again
+        assert x.shape == (2, 3, 4, 4)
+        np.testing.assert_array_equal(yb, np.array([0, 1], np.int32))
+    finally:
+        it.end()
+
+
+def test_image_batch_iter_restart_after_end(tmp_path):
+    """start() after a deliberate end() clears the stop flag and any
+    stale drained batch, so the iterator is reusable across epochs."""
+    lst = tmp_path / "list.txt"
+    lst.write_text("a.png 0\nb.png 1\nc.png 2\nd.png 3\n")
+    it = data.ImageBatchIter(str(lst), 2, _ident_images, shuffle=False)
+    it.start()
+    next(it)
+    it.end()
+    it.start()  # must not inherit the set stop_flag -> StopIteration
+    try:
+        x, _ = next(it)
+        assert x.shape == (2, 3, 4, 4)
     finally:
         it.end()
 

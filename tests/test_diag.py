@@ -123,6 +123,9 @@ def test_statusz_golden_sections(served):
     # the 3-step run was productive: a nonzero step line
     m = re.search(r"step\s+([0-9.]+) s", body)
     assert m and float(m.group(1)) > 0.0, body
+    # ISSUE-5: the overlap section (prefetch ring + async-ckpt state)
+    assert "== overlap ==" in body
+    assert "async-ckpt: pending=0" in body
     assert "== health ==" in body
 
 

@@ -167,6 +167,28 @@ def test_lint_bucket_label_values(tmp_path):
     assert any("dynamic" in p for p in problems)
 
 
+def test_lint_covers_overlap_metric_names():
+    """ISSUE-5 satellite: the singa_prefetch_* / singa_checkpoint_async_*
+    registrations (observe.py record hooks, read back by overlap.py's
+    /statusz section) are in the default scan and pass every rule —
+    name pattern, counter _total suffix, unique helps, and rule 5 (the
+    overlap metrics carry no reason=/phase=/bucket= labels, so no new
+    enum proof is required)."""
+    obs_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                          "observe.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(obs_py)}
+    assert "singa_prefetch_ring_depth" in names
+    assert "singa_prefetch_blocked_seconds" in names
+    assert "singa_prefetch_batches_total" in names
+    assert "singa_checkpoint_async_pending" in names
+    assert "singa_checkpoint_async_blocking_seconds" in names
+    assert "singa_checkpoint_async_total" in names
+    ov_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                         "overlap.py")
+    assert check_metrics_names.check([obs_py, ov_py]) == []
+
+
 def test_lint_goodput_enum_usage_clean():
     """goodput.py's own bucket= recording passes the enum rule (also
     covered by the default-scan test; this pins the file)."""
