@@ -157,6 +157,15 @@ def main():
                         "time to durable (ckpt_total_s, includes the "
                         "wait_for_checkpoints barrier) vs the fully "
                         "synchronous write (ckpt_sync_s)")
+    p.add_argument("--resume", action="store_true",
+                   help="resilience cold-vs-resumed A/B: a controller "
+                        "run with periodic async saves is killed "
+                        "mid-epoch (injected fault), then auto-resumed "
+                        "in a fresh model from the latest valid "
+                        "checkpoint; records resume_restore_s, "
+                        "steps_replayed and the goodput "
+                        "checkpoint-bucket delta of each arm into the "
+                        "JSON record + singa_bench_* mirror")
     p.add_argument("--diag-port", type=int, default=None, metavar="PORT",
                    help="serve the live diagnostics HTTP endpoints "
                         "(/metrics /healthz /statusz /flightz /profilez) "
@@ -330,7 +339,8 @@ def main():
     # run: snapshot before the A/B arms feed the same tracker synthetic
     # sleep-injected stalls and extra checkpoint saves
     goodput_snap = None
-    if goodput_tracker is not None and (args.overlap or args.ckpt_async):
+    if goodput_tracker is not None and (args.overlap or args.ckpt_async
+                                        or args.resume):
         goodput_snap = goodput_tracker.snapshot(final=True)
     overlap_fields = {}
     if args.overlap:
@@ -403,6 +413,72 @@ def main():
             overlap_fields["ckpt_sync_s"] = round(
                 time.perf_counter() - t1, 4)
         finally:
+            shutil.rmtree(ckdir, ignore_errors=True)
+
+    # ---- resilience cold-vs-resumed A/B (--resume) -----------------------
+    if args.resume:
+        import shutil
+        import tempfile
+
+        from singa_tpu import goodput as goodput_mod
+        from singa_tpu import resilience as res_mod
+        tracker = goodput_mod.install()  # idempotent with --goodput
+        ckdir = tempfile.mkdtemp(prefix="bench_resume_")
+        n_steps, save_every, kill_at = 8, 3, 7
+        data = [(tx, ty)] * n_steps
+        try:
+            def _arm_model():
+                mm = model_factory()
+                mm.set_optimizer(opt.SGD(lr=0.1, momentum=0.9,
+                                         weight_decay=1e-5))
+                mm.compile([tx], is_train=True, use_graph=True,
+                           amp="bfloat16" if args.amp else None)
+                return mm
+
+            # cold arm: fresh start under the controller, killed at
+            # step `kill_at` by an injected fault — it leaves durable
+            # checkpoints behind (manifest of step 3 flushed by save 6)
+            res_mod.install_fault_plan(
+                res_mod.FaultPlan().fail("step", step=kill_at))
+            b0 = tracker.snapshot()["buckets"]
+            t1 = time.perf_counter()
+            try:
+                res_mod.TrainController(
+                    _arm_model(), ckdir, save_every_steps=save_every,
+                    max_restarts=0, handle_signals=False).fit(data)
+            except RuntimeError:
+                pass  # the injected kill at step `kill_at`
+            cold_wall = time.perf_counter() - t1
+            res_mod.clear_fault_plan()
+            from singa_tpu import overlap as overlap_mod
+            overlap_mod.wait_for_checkpoints()
+            b1 = tracker.snapshot()["buckets"]
+
+            # resumed arm: fresh model, same dir — restore + replay +
+            # finish the remaining steps
+            ctrl = res_mod.TrainController(
+                _arm_model(), ckdir, save_every_steps=save_every,
+                handle_signals=False)
+            t1 = time.perf_counter()
+            rep = ctrl.fit(data)
+            warm_wall = time.perf_counter() - t1
+            b2 = tracker.snapshot()["buckets"]
+            overlap_fields.update({
+                "resume_steps": n_steps,
+                "resume_killed_at_step": kill_at,
+                "resume_resumed_step": rep["resumed_step"],
+                "resume_steps_replayed": rep["resumed_step"],
+                "resume_restore_s": rep["resume_restore_s"],
+                "resume_cold_wall_s": round(cold_wall, 4),
+                "resume_warm_wall_s": round(warm_wall, 4),
+                "resume_ckpt_cold_s": round(
+                    b1["checkpoint"] - b0["checkpoint"], 4),
+                "resume_ckpt_warm_s": round(
+                    b2["checkpoint"] - b1["checkpoint"], 4),
+                "resume_step_warm_s": round(b2["step"] - b1["step"], 4),
+            })
+        finally:
+            res_mod.clear_fault_plan()
             shutil.rmtree(ckdir, ignore_errors=True)
 
     # ---- self-validation against physics ---------------------------------

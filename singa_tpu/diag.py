@@ -141,6 +141,11 @@ class _Handler(BaseHTTPRequestHandler):
             parts.append(overlap.overlap_report())
         except Exception as e:
             parts.append(f"(overlap unavailable: {e})")
+        try:
+            from . import resilience
+            parts.append(resilience.resilience_report())
+        except Exception as e:
+            parts.append(f"(resilience unavailable: {e})")
         mon = self._monitor()
         if mon is None:
             parts.append("== health ==\nno HealthMonitor attached")

@@ -86,6 +86,35 @@ def global_mesh(axis_sizes: dict | None = None):
     return make_mesh(axis_sizes, devices=devs)
 
 
+def topology() -> dict:
+    """The live cluster topology, in the shape checkpoint manifests
+    record it (resilience.build_manifest): device/process counts plus
+    this process's rank. An elastic restart compares this against the
+    manifest's saved topology to decide whether the restore reshards."""
+    return {
+        "n_devices": len(jax.devices()),
+        "n_processes": jax.process_count(),
+        "process_index": jax.process_index(),
+    }
+
+
+def resume_mesh(n: int | None = None, axis: str = "data"):
+    """A data mesh over the devices THIS incarnation of the job has —
+    the elastic-restart hook: a run killed on 8 workers relaunches on
+    whatever survived, asks for `resume_mesh()`, and restores the
+    checkpoint onto it (orbax reshards; see Model.load_checkpoint).
+    `n` caps the device count (e.g. to match a power-of-two batch
+    divisor); more devices than available is an error, fewer uses the
+    first `n` (stable order, so every process picks the same set)."""
+    from .parallel.mesh import make_mesh
+    devs = jax.devices()
+    if n is None:
+        n = len(devs)
+    assert n <= len(devs), \
+        f"resume_mesh wants {n} devices, only {len(devs)} available"
+    return make_mesh({axis: int(n)}, devices=devs[:int(n)])
+
+
 def global_batch(host_array, mesh, axis: str = "data"):
     """Assemble a global jax.Array sharded along `axis` from a host array
     holding the FULL global batch (identical on every process). Each

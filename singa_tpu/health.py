@@ -64,12 +64,19 @@ KIND_GRAD_NORM = "grad_norm_limit"
 
 
 class HealthError(RuntimeError):
-    """Raised by the `halt` policy; carries the flight-bundle path."""
+    """Raised by the `halt` policy; carries the flight-bundle path.
 
-    def __init__(self, msg, bundle_path=None, stats=None):
+    `partial` is filled in by supervising loops on the way out:
+    `Model.fit` attaches {"epoch", "steps_completed", "losses",
+    "last_loss"} so a halt does not discard the epoch's progress, and
+    `resilience.TrainController` additionally attaches its run report
+    as `.resilience` after the save-then-stop path ran."""
+
+    def __init__(self, msg, bundle_path=None, stats=None, partial=None):
         super().__init__(msg)
         self.bundle_path = bundle_path
         self.stats = stats
+        self.partial = partial
 
 
 # ---- trace-time collector hook ---------------------------------------------

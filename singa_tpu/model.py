@@ -822,7 +822,11 @@ class Model(Layer, metaclass=ModelMeta):
         This is where the health layer meets the loop: every step feeds
         the attached HealthMonitor (skip_step discards bad updates
         in-graph without breaking the loop; halt raises HealthError out
-        of fit with the flight-recorder bundle already on disk)."""
+        of fit with the flight-recorder bundle already on disk AND the
+        epoch's partial progress attached as `HealthError.partial` —
+        {"epoch", "steps_completed", "losses", "last_loss"} — so a
+        supervising controller can log/checkpoint what the epoch did
+        achieve instead of losing it with the raise)."""
         history = []
         _end = object()
         for epoch in range(epochs):
@@ -853,6 +857,19 @@ class Model(Layer, metaclass=ModelMeta):
                             # keep the device scalar; fetch once per
                             # epoch so the loop stays async-dispatched
                             losses.append(loss.data)
+                except health.HealthError as e:
+                    # a mid-epoch halt must not discard the epoch's loss
+                    # history: surface the partial progress on the error
+                    # (one transfer, same as the happy path below)
+                    vals = [float(np.asarray(a))
+                            for a in jax.device_get(losses)]
+                    e.partial = {
+                        "epoch": epoch,
+                        "steps_completed": len(vals),
+                        "losses": vals,
+                        "last_loss": vals[-1] if vals else None,
+                    }
+                    raise
                 finally:
                     if prefetcher is not None:
                         prefetcher.close()
@@ -1109,9 +1126,13 @@ class Model(Layer, metaclass=ModelMeta):
         Captures model states, optimizer state (slot buffers + step
         counter) and the device PRNG stream, so training resumed from it
         is bit-identical to uninterrupted training (tests/test_model.py::
-        test_checkpoint_resume_equivalence). An existing step_N directory
-        raises unless `overwrite=True` (a save-latest loop should either
-        thread a real step counter or pass overwrite).
+        test_checkpoint_resume_equivalence). An existing COMPLETE step_N
+        directory (one carrying a `step_N.manifest.json` sibling, the
+        resilience layer's durability marker) raises unless
+        `overwrite=True`; an existing step_N WITHOUT a manifest is an
+        interrupted, half-written save — a crashed run's leftover — and
+        is reclaimed (overwritten) by default, so a restarted job never
+        wedges on its predecessor's debris.
 
         async_save=True (the default) routes the write through orbax's
         AsyncCheckpointer when this orbax has one: the call returns once
@@ -1154,6 +1175,20 @@ class Model(Layer, metaclass=ModelMeta):
             "rng": rng,
         }
         path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+        if os.path.isdir(path):
+            from . import resilience
+            if not overwrite \
+                    and not resilience.is_complete_checkpoint(path):
+                # no manifest == the previous writer died mid-save;
+                # nothing durable is lost by replacing it
+                overwrite = True
+            if overwrite:
+                # a stale manifest must not mark the in-flight rewrite
+                # as complete (discovery keys on manifest presence)
+                try:
+                    os.remove(resilience.manifest_path(path))
+                except OSError:
+                    pass
         nbytes = sum(int(getattr(a, "nbytes", 0) or 0)
                      for a in jax.tree_util.tree_leaves(tree))
         if async_save and overlap.start_async_save(path, tree,
@@ -1219,13 +1254,26 @@ class Model(Layer, metaclass=ModelMeta):
                       for k, m in (meta.get("res") or {}).items()}
         return tpl
 
-    def load_checkpoint(self, path: str):
+    def load_checkpoint(self, path: str, validate: bool = True):
         """Restore a `save_checkpoint` directory (a .../step_N path) into
         this model + its optimizer + the device RNG. The model must be
-        built/compiled to the same topology first (params exist; under
-        `jax.distributed` every process calls this with the same path and
-        receives its own shards — restore targets carry the live training
-        state's shardings, so no host ever gathers the full arrays).
+        built/compiled first so params exist, but NOT to the same
+        topology: the restore template carries the LIVE training state's
+        shardings, so orbax reshards the saved arrays onto whatever mesh
+        this process runs — a checkpoint saved on an 8-device mesh
+        restores onto 4 (or onto a single device) with the training
+        state intact (tests/test_resilience.py::
+        test_kill_and_resume_onto_smaller_mesh). Under `jax.distributed`
+        every process calls this with the same path and receives only
+        its own shards — no host ever gathers the full arrays.
+
+        With `validate` (default) and a `step_N.manifest.json` sibling
+        present (the resilience layer writes one per durable save), the
+        manifest's parameter signature is checked against this model
+        first — a shape/dtype mismatch raises ValueError naming the
+        offending params instead of orbax failing midway through a
+        partial restore; topology differences are allowed (that is the
+        resharding path) and reported as a `resilience` event.
         Optimizer state (including sparse error-feedback residuals saved
         before/after their order existed) resumes exactly; bit-identical
         continuation is asserted single-process by tests/test_model.py::
@@ -1233,11 +1281,25 @@ class Model(Layer, metaclass=ModelMeta):
         examples/multihost/ckpt_2proc.py (the CI leg)."""
         import jax
         import orbax.checkpoint as ocp
-        from . import overlap
+        from . import overlap, resilience
         # barrier: an async save of THIS path (or any other) must be
         # durable before restore reads it — and its deferred error must
         # surface here rather than restore racing a half-written dir
         overlap.wait_for_checkpoints()
+        manifest = resilience.read_manifest(path)
+        if validate and manifest is not None:
+            problems = resilience.validate_manifest(manifest, self)
+            if problems:
+                raise ValueError(
+                    f"checkpoint {path} does not fit this model: "
+                    + "; ".join(problems))
+            saved = (manifest.get("mesh") or {}).get("n_devices")
+            live = len(jax.devices())
+            if saved and saved != live:
+                observe.get_registry().emit(
+                    {"kind": "resilience", "event": "reshard_restore",
+                     "path": path, "saved_devices": saved,
+                     "live_devices": live})
         ck = ocp.StandardCheckpointer()
         with observe.span("checkpoint.load"):
             tree = ck.restore(os.path.abspath(path),

@@ -294,8 +294,16 @@ def _get_async_checkpointer():
 
 def _atexit_barrier():
     # a deferred write error surfacing here (traceback at exit) beats
-    # silently losing the checkpoint
-    wait_for_checkpoints()
+    # silently losing the checkpoint. Printed explicitly: the bare
+    # "Exception ignored in atexit callback" report drops the chained
+    # __cause__, which is exactly the part naming WHY the write failed
+    # (regression-tested by tests/test_resilience.py via a subprocess)
+    try:
+        wait_for_checkpoints()
+    except BaseException:
+        import traceback
+        traceback.print_exc()
+        raise
 
 
 def _register_pending(entry, blocking_s=None):
@@ -332,9 +340,14 @@ def wait_for_checkpoints():
     errors = []
     # the barrier wait is the checkpoint path's only remaining blocking
     # portion: span -> goodput `checkpoint`
+    from . import resilience  # lazy: no module-level cycle
     with observe.span("checkpoint.wait"):
         for e in entries:
             try:
+                # deterministic stand-in for a deferred write failure /
+                # a slow durability barrier (tests drive both through
+                # resilience.FaultPlan; no-op without a plan installed)
+                resilience.fault_point("ckpt.wait", path=e.path)
                 e.wait()
             except BaseException as err:  # noqa: BLE001 — re-raised below
                 errors.append((e, err))

@@ -126,6 +126,9 @@ def test_statusz_golden_sections(served):
     # ISSUE-5: the overlap section (prefetch ring + async-ckpt state)
     assert "== overlap ==" in body
     assert "async-ckpt: pending=0" in body
+    # ISSUE-6: the resilience section (controller + recovery counters)
+    assert "== resilience ==" in body
+    assert "saves=" in body and "restarts=" in body
     assert "== health ==" in body
 
 

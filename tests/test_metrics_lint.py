@@ -204,3 +204,24 @@ def test_lint_introspect_enum_usage_clean():
                          "introspect.py")
     problems = check_metrics_names.check([intro])
     assert problems == []
+
+
+def test_lint_covers_resilience_metric_names():
+    """ISSUE-6 satellite: the singa_resilience_* registrations in
+    singa_tpu/resilience.py are inside the default scan and pass every
+    rule — name pattern, counter _total suffix, unique helps (the
+    `kind=` label on faults_injected is not an enum-checked kwarg, so
+    no new enum proof is required)."""
+    res_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                          "resilience.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(res_py)}
+    assert "singa_resilience_restarts_total" in names
+    assert "singa_resilience_retries_total" in names
+    assert "singa_resilience_saves_total" in names
+    assert "singa_resilience_corrupt_skipped_total" in names
+    assert "singa_resilience_preempt_total" in names
+    assert "singa_resilience_faults_injected_total" in names
+    assert "singa_resilience_resumed_step" in names
+    assert "singa_resilience_last_save_age_seconds" in names
+    assert check_metrics_names.check([res_py]) == []

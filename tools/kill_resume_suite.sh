@@ -1,0 +1,24 @@
+#!/bin/bash
+# Kill-and-resume A/B harness -> RESILIENCE_rNN.json (MULTICHIP-style
+# subprocess record). Three real process legs, all on the forced-host
+# CPU backend so it runs anywhere the tier-1 suite runs:
+#   A   uninterrupted baseline on 8 virtual devices
+#   B1  the same run SIGTERM'd mid-epoch (the controller finishes the
+#       in-flight step, writes a final checkpoint + manifest, waits the
+#       durability barrier, exits 0)
+#   B2  auto-resume of B1's checkpoint dir on 4 virtual devices
+#       (orbax reshards the restore onto the smaller mesh)
+# The record compares B2's per-step losses against A's at the same
+# global steps: ok=true iff every leg exited cleanly, B1 reports
+# "preempted", B2 reports "completed" with resumed_step > 0, and the
+# max |loss delta| is inside tolerance.
+#
+# Usage: tools/kill_resume_suite.sh [RESILIENCE_r01.json] [extra args]
+# Extra args pass through to `python -m singa_tpu.resilience --ab`,
+# e.g.: tools/kill_resume_suite.sh RESILIENCE_r02.json --devices-b 2
+set -eo pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-RESILIENCE_r01.json}"
+shift || true
+JAX_PLATFORMS=cpu python -m singa_tpu.resilience --ab --out "$OUT" "$@"
+echo "wrote $OUT"
