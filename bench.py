@@ -440,14 +440,25 @@ def main():
             # checkpoints behind (manifest of step 3 flushed by save 6)
             res_mod.install_fault_plan(
                 res_mod.FaultPlan().fail("step", step=kill_at))
+            # build/compile OUTSIDE the timed region, like the warm arm
+            cold_model = _arm_model()
             b0 = tracker.snapshot()["buckets"]
             t1 = time.perf_counter()
+            killed = False
             try:
                 res_mod.TrainController(
-                    _arm_model(), ckdir, save_every_steps=save_every,
+                    cold_model, ckdir, save_every_steps=save_every,
                     max_restarts=0, handle_signals=False).fit(data)
-            except RuntimeError:
-                pass  # the injected kill at step `kill_at`
+            except RuntimeError as e:
+                # ONLY the injected kill is expected; a genuine failure
+                # must not be recorded as a valid cold arm
+                if "injected fault" not in str(e):
+                    raise
+                killed = True
+            if not killed:
+                raise RuntimeError(
+                    f"--resume cold arm completed; the injected kill at "
+                    f"step {kill_at} never fired")
             cold_wall = time.perf_counter() - t1
             res_mod.clear_fault_plan()
             from singa_tpu import overlap as overlap_mod
@@ -466,7 +477,9 @@ def main():
             overlap_fields.update({
                 "resume_steps": n_steps,
                 "resume_killed_at_step": kill_at,
-                "resume_resumed_step": rep["resumed_step"],
+                # batches the resumed arm consumed without training to
+                # reach its checkpoint — which is also the step it
+                # resumed from (single-epoch arm), so record it once
                 "resume_steps_replayed": rep["resumed_step"],
                 "resume_restore_s": rep["resume_restore_s"],
                 "resume_cold_wall_s": round(cold_wall, 4),

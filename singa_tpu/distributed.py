@@ -87,10 +87,11 @@ def global_mesh(axis_sizes: dict | None = None):
 
 
 def topology() -> dict:
-    """The live cluster topology, in the shape checkpoint manifests
-    record it (resilience.build_manifest): device/process counts plus
-    this process's rank. An elastic restart compares this against the
-    manifest's saved topology to decide whether the restore reshards."""
+    """The live cluster topology: device/process counts plus this
+    process's rank. `resilience.build_manifest` embeds it verbatim in
+    each checkpoint manifest's `mesh` section (alongside the mesh
+    `axes`); an elastic restart compares the saved copy against the
+    live one to decide whether the restore reshards."""
     return {
         "n_devices": len(jax.devices()),
         "n_processes": jax.process_count(),
@@ -110,8 +111,9 @@ def resume_mesh(n: int | None = None, axis: str = "data"):
     devs = jax.devices()
     if n is None:
         n = len(devs)
-    assert n <= len(devs), \
-        f"resume_mesh wants {n} devices, only {len(devs)} available"
+    if n > len(devs):
+        raise ValueError(
+            f"resume_mesh wants {n} devices, only {len(devs)} available")
     return make_mesh({axis: int(n)}, devices=devs[:int(n)])
 
 

@@ -1129,10 +1129,12 @@ class Model(Layer, metaclass=ModelMeta):
         test_checkpoint_resume_equivalence). An existing COMPLETE step_N
         directory (one carrying a `step_N.manifest.json` sibling, the
         resilience layer's durability marker) raises unless
-        `overwrite=True`; an existing step_N WITHOUT a manifest is an
-        interrupted, half-written save — a crashed run's leftover — and
-        is reclaimed (overwritten) by default, so a restarted job never
-        wedges on its predecessor's debris.
+        `overwrite=True`; an existing step_N WITHOUT a manifest —
+        usually an interrupted, half-written save — is reclaimed by
+        default: renamed aside as `step_N.reclaimed` (data preserved,
+        since a plain-API save never writes a manifest and may be a
+        complete checkpoint) so a restarted job never wedges on its
+        predecessor's debris.
 
         async_save=True (the default) routes the write through orbax's
         AsyncCheckpointer when this orbax has one: the call returns once
@@ -1179,10 +1181,16 @@ class Model(Layer, metaclass=ModelMeta):
             from . import resilience
             if not overwrite \
                     and not resilience.is_complete_checkpoint(path):
-                # no manifest == the previous writer died mid-save;
-                # nothing durable is lost by replacing it
-                overwrite = True
-            if overwrite:
+                # no manifest == not PROVEN complete: usually the
+                # controller's crashed-writer debris, but possibly a
+                # fine checkpoint written by this plain API (which
+                # never writes manifests). Vacate the step_N name by
+                # setting the old dir ASIDE (any manifest file rides
+                # along) instead of destroying it — a restarted job
+                # never wedges on its predecessor's leftovers, and
+                # nothing durable is ever silently lost.
+                resilience.set_aside_checkpoint(path, ".reclaimed")
+            elif overwrite:
                 # a stale manifest must not mark the in-flight rewrite
                 # as complete (discovery keys on manifest presence)
                 try:
@@ -1202,6 +1210,9 @@ class Model(Layer, metaclass=ModelMeta):
         with observe.span("checkpoint.save"):
             ck.save(path, tree, force=overwrite)
             ck.wait_until_finished()
+        # this blocking write is durable here: it supersedes any
+        # recorded async-write failure for the same path
+        overlap.clear_write_failed(path)
         observe.record_checkpoint_bytes(nbytes)
         return path
 

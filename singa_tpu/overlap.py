@@ -42,6 +42,7 @@ early break, HealthError) — and no async save may be left pending.
 from __future__ import annotations
 
 import atexit
+import os
 import threading
 import time
 from collections import deque
@@ -253,6 +254,9 @@ def prefetch_to_device(it, model, size: int = 2, device=None):
 
 _ckpt_lock = threading.Lock()
 _pending: "list[_PendingSave]" = []
+# paths whose deferred write failed at a barrier — outlives the barrier
+# that drained them (see write_failed); a fresh save to the path clears it
+_failed_paths: "set[str]" = set()
 _async_ck = None       # cached orbax AsyncCheckpointer (or False: probed,
 _atexit_installed = False  # unavailable on this orbax)
 
@@ -324,6 +328,28 @@ def pending_checkpoints() -> int:
         return len(_pending)
 
 
+def write_failed(path: str) -> bool:
+    """True when a deferred async write to `path` failed at some past
+    barrier. The record survives the `wait_for_checkpoints` that
+    drained it, so an actor OTHER than the one that raised can still
+    learn the outcome — the resilience controller consults this before
+    manifesting a checkpoint complete, closing the window where a
+    second actor's barrier consumes the error and a later, vacuously
+    clean barrier looks like success. A new `start_async_save` to the
+    same path clears the record."""
+    with _ckpt_lock:
+        return os.path.abspath(path) in _failed_paths
+
+
+def clear_write_failed(path: str):
+    """Forget a recorded write failure for `path` — call only once a
+    later write to it is proven durable. `start_async_save` clears on
+    starting a superseding write; `Model.save_checkpoint`'s synchronous
+    branch clears after its blocking write finishes."""
+    with _ckpt_lock:
+        _failed_paths.discard(os.path.abspath(path))
+
+
 def wait_for_checkpoints():
     """Barrier: block until every in-flight async save is durable.
     Re-raises the first deferred write failure (remaining saves are
@@ -356,6 +382,8 @@ def wait_for_checkpoints():
         # the failed checkpointer's state is suspect: drop the cache so
         # the next save builds a fresh one
         with _ckpt_lock:
+            _failed_paths.update(os.path.abspath(e.path)
+                                 for e, _ in errors)
             if _async_ck and any(e.checkpointer is _async_ck
                                  for e, _ in errors):
                 try:
@@ -386,6 +414,8 @@ def start_async_save(path: str, tree, force: bool = False) -> bool:
     if save_args is None:
         return False
     t0 = time.perf_counter()
+    # a fresh write supersedes any recorded failure for this path
+    clear_write_failed(path)
     # span -> goodput `checkpoint`: ONLY the blocking snapshot portion
     with observe.span("checkpoint.save"):
         ck.save(path, args=save_args, force=force)
@@ -424,5 +454,6 @@ def overlap_report() -> str:
 __all__ = [
     "DevicePrefetcher", "prefetch_to_device",
     "start_async_save", "wait_for_checkpoints", "pending_checkpoints",
-    "async_available", "overlap_report",
+    "write_failed", "clear_write_failed", "async_available",
+    "overlap_report",
 ]

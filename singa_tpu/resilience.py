@@ -234,7 +234,7 @@ def param_signature(model) -> dict:
 def build_manifest(model, step: int, status: str = "ok",
                    extra: "dict | None" = None) -> dict:
     """Assemble the manifest dict for a checkpoint of `model` at `step`."""
-    import jax
+    from .distributed import topology
     assert status in RUN_STATUSES, status
     mesh_axes = None
     opt = getattr(model, "_optimizer", None)
@@ -255,9 +255,7 @@ def build_manifest(model, step: int, status: str = "ok",
         "step": int(step),
         "ts": round(time.time(), 6),
         "status": status,
-        "mesh": {"axes": mesh_axes,
-                 "n_devices": len(jax.devices()),
-                 "n_processes": jax.process_count()},
+        "mesh": {"axes": mesh_axes, **topology()},
         "params": param_signature(model),
         "n_opt_slots": len(opt.state_arrays()) if opt is not None else 0,
         "hlo_fingerprints": fingerprints,
@@ -364,11 +362,48 @@ def latest_checkpoint(ckpt_dir: str):
     return path, man
 
 
+def set_aside_checkpoint(path: str, suffix: str, keep: int = 3) -> str:
+    """Rename a `step_N` checkpoint dir out of discovery's namespace as
+    `path + suffix` (collision-numbered), manifest first — a crash
+    between the two renames leaves an unmanifested dir (ignorable
+    debris), never a manifested half-move. Returns the destination.
+    The dir rename is NOT guarded: failing to vacate the step_N name
+    must surface, or the next save at this step wedges while telemetry
+    claims the collision was cleared. At most `keep` set-asides per
+    (path, suffix) are retained, oldest deleted first — a crash-restart
+    loop reclaiming the same step cannot grow disk without bound, while
+    the most recent leftovers stay recoverable."""
+    dst = path + suffix
+    i = 0
+    while os.path.exists(dst):
+        i += 1
+        dst = f"{path}{suffix}{i}"
+    try:
+        os.replace(manifest_path(path), dst + MANIFEST_SUFFIX)
+    except OSError:
+        pass  # no manifest to move
+    os.replace(path, dst)
+    base = os.path.basename(path) + suffix
+    parent = os.path.dirname(path)
+    aside = [os.path.join(parent, n) for n in os.listdir(parent)
+             if n.startswith(base) and not n.endswith(MANIFEST_SUFFIX)
+             and os.path.isdir(os.path.join(parent, n))]
+    aside.sort(key=os.path.getmtime)
+    for p in aside[:-keep] if len(aside) > keep else []:
+        try:
+            os.remove(p + MANIFEST_SUFFIX)
+        except OSError:
+            pass
+        shutil.rmtree(p, ignore_errors=True)
+    return dst
+
+
 def keep_last_k(ckpt_dir: str, k: int) -> list:
     """Retention GC: delete all but the newest `k` COMPLETE checkpoints
     (directory + manifest). Incomplete dirs are left alone — the newest
     one is usually an in-flight async write, and `save_checkpoint`
-    reclaims abandoned ones by overwriting. Returns the removed paths."""
+    reclaims abandoned ones by renaming them aside. Returns the
+    removed paths."""
     if k <= 0:
         return []
     removed = []
@@ -492,9 +527,13 @@ class TrainController:
 
     # -- checkpointing ------------------------------------------------------
     def _flush_pending_manifest(self):
-        """Write the manifest of the previous save — call only once its
-        bytes are durable (after a barrier, or after the NEXT
-        save_checkpoint call returned, which barriers internally)."""
+        """Write the manifest of the previous save — call only after a
+        barrier has PROVEN its bytes durable and, when the barrier's
+        outcome is ambiguous (drained by another actor), after
+        `overlap.write_failed` cleared the path. The only call sites
+        are _settle_pending and the final branch of _save; relying on
+        any save_checkpoint's INTERNAL barrier instead is exactly the
+        retried-vacuous-success bug this protocol exists to prevent."""
         if self._pending_manifest is None:
             return
         path, man = self._pending_manifest
@@ -505,6 +544,10 @@ class TrainController:
         if self._step <= self._last_saved_step and not final:
             return
         step = self._step
+        # drain the accumulated device loss scalars in one device_get —
+        # the save blocks on the device anyway, and this keeps _history
+        # from pinning one device buffer per step for the whole run
+        self._flush_losses()
 
         def do_save():
             fault_point("ckpt.save", step=step)
@@ -512,10 +555,16 @@ class TrainController:
                 self.ckpt_dir, step=step, async_save=self.async_save)
 
         if step > self._last_saved_step:
+            # Barrier the PREVIOUS async write ourselves before starting
+            # the new one. save_checkpoint barriers internally too, but a
+            # deferred write error surfacing there would be retried by
+            # _retry — and the retry, finding the error already drained,
+            # would succeed, leaving the failed write's manifest pending
+            # and later flushed as if its bytes had landed. The settle
+            # flushes the manifest only on proof of durability and drops
+            # it on failure: a failed save is never manifested complete.
+            self._settle_pending()
             path = self._retry("checkpoint save", do_save)
-            # save_checkpoint barriered the PREVIOUS async write before
-            # starting this one — the previous manifest is safe now
-            self._flush_pending_manifest()
             self._pending_manifest = (
                 path, build_manifest(self.model, step, status=status))
             self._last_saved_step = step
@@ -527,9 +576,39 @@ class TrainController:
             self._emit("save", path=path, status=status, final=final)
         if final:
             # durability barrier: the report (and a clean preempt exit)
-            # must only ever claim a checkpoint that is actually on disk
+            # must only ever claim a checkpoint that is actually on disk.
+            # NOT retried: a barrier failure means the write already
+            # failed and its error was drained — a second wait would
+            # succeed vacuously and flush the dead checkpoint's manifest.
             from . import overlap
-            self._retry("checkpoint barrier", overlap.wait_for_checkpoints)
+            if status != "ok" and self._pending_manifest is not None:
+                # a preempt/halt landing on a step whose cadence save
+                # already ran must still leave its terminal status in
+                # the manifest, not the save-time "ok"
+                p, man = self._pending_manifest
+                self._pending_manifest = (p, dict(man, status=status))
+            try:
+                overlap.wait_for_checkpoints()
+            except Exception:
+                # the raise may belong to ANOTHER actor's save drained
+                # by the same shared barrier: our checkpoint is durable
+                # (and manifested before the re-raise) unless the
+                # per-path record names it
+                if self._pending_manifest is not None and \
+                        not overlap.write_failed(self._pending_manifest[0]):
+                    self._flush_pending_manifest()
+                else:
+                    self._pending_manifest = None
+                raise
+            if self._pending_manifest is not None \
+                    and overlap.write_failed(self._pending_manifest[0]):
+                # the error was drained by another actor's barrier; the
+                # bytes are gone all the same — never manifest them
+                bad = self._pending_manifest[0]
+                self._pending_manifest = None
+                raise RuntimeError(
+                    f"final checkpoint write to {bad} failed (deferred "
+                    f"error was drained by another barrier)")
             self._flush_pending_manifest()
         keep_last_k(self.ckpt_dir, self.keep)
 
@@ -559,13 +638,14 @@ class TrainController:
 
     def _settle_pending(self):
         """Make any in-flight async save durable and flush its manifest
-        BEFORE scanning for checkpoints — without this, a restart right
-        after a save would skip the newest durable checkpoint (its
-        manifest still pending) or, worse, later write that stale
-        manifest for a brand-new in-flight save at the same step. A
-        failed write drops the pending manifest (a failed save must
-        never be marked complete) and is reported, not raised: the
-        resume falls back to an older checkpoint."""
+        — called before starting a new save and before scanning for
+        checkpoints on resume. Without it, a restart right after a save
+        would skip the newest durable checkpoint (its manifest still
+        pending) or, worse, later write that stale manifest for a
+        brand-new in-flight save at the same step. A failed write drops
+        the pending manifest (a failed save must never be marked
+        complete) and is reported, not raised: the next save proceeds
+        and a resume falls back to an older checkpoint."""
         from . import overlap
         if self._pending_manifest is None \
                 and not overlap.pending_checkpoints():
@@ -573,11 +653,32 @@ class TrainController:
         try:
             overlap.wait_for_checkpoints()
         except Exception as e:
-            self._pending_manifest = None
+            # the shared barrier may have raised for ANOTHER actor's
+            # save: the per-path record decides the fate of OUR pending
+            # manifest — the barrier proved our bytes durable unless it
+            # recorded our path as failed
+            if self._pending_manifest is not None and \
+                    not overlap.write_failed(self._pending_manifest[0]):
+                self._flush_pending_manifest()
+            else:
+                self._pending_manifest = None
             self._emit("pending_save_failed",
                        error=f"{type(e).__name__}: {e}")
-        else:
-            self._flush_pending_manifest()
+            return
+        # a clean barrier can still hide a failure: ANOTHER actor's
+        # barrier (a second controller, a direct wait_for_checkpoints,
+        # any save/load_checkpoint) may have drained the shared pending
+        # list and consumed the error — the per-path failure record
+        # outlives that drain, so consult it before manifesting
+        if self._pending_manifest is not None \
+                and overlap.write_failed(self._pending_manifest[0]):
+            path = self._pending_manifest[0]
+            self._pending_manifest = None
+            self._emit("pending_save_failed", path=path,
+                       error="deferred write failed "
+                             "(drained by another barrier)")
+            return
+        self._flush_pending_manifest()
 
     def _do_resume(self, require: bool):
         m = _metrics()
@@ -641,20 +742,7 @@ class TrainController:
                     shutil.rmtree(p2, ignore_errors=True)
                     self._emit("purge_stale_checkpoint", path=p2)
                 else:
-                    dst = p2 + ".stale"
-                    i = 0
-                    while os.path.exists(dst):
-                        i += 1
-                        dst = f"{p2}.stale{i}"
-                    try:
-                        # manifest first: a crash between the renames
-                        # leaves an unmanifested dir (ignorable debris),
-                        # never a manifested half-move
-                        os.replace(manifest_path(p2),
-                                   dst + MANIFEST_SUFFIX)
-                        os.replace(p2, dst)
-                    except OSError:
-                        pass
+                    dst = set_aside_checkpoint(p2, ".stale")
                     self._emit("stale_checkpoint_set_aside",
                                src=p2, dst=dst)
             return
@@ -747,7 +835,6 @@ class TrainController:
         signum = self._preempt
         self._log(f"preemption (signal {signum}): finishing with a "
                   "final checkpoint")
-        self._flush_losses()
         self._save(status="preempt", final=True)
         _metrics()["preempt"].inc()
         self._emit("preempted", signum=signum,
@@ -765,8 +852,18 @@ class TrainController:
         model's health policy halts; re-raises the last step error when
         `max_restarts` in-process restarts are exhausted."""
         global _active_controller
+        if iter(data) is data:
+            # the controller re-iterates `data` on every epoch, restart
+            # and resume — a one-shot iterator would silently "complete"
+            # at the first re-entry instead of training
+            raise ValueError(
+                "`data` must be re-iterable (a list, not a generator): "
+                "the resilient loop replays it across epochs, restarts "
+                "and resumes")
         _active_controller = self
         self._status = "running"
+        # a prior fit()'s preemption must not preempt this one
+        self._preempt = None
         prev_handlers = self._install_signals()
         try:
             self.resume()
@@ -779,7 +876,6 @@ class TrainController:
                     return self._fit_once(data, epochs)
                 except health.HealthError as e:
                     self._status = "halted"
-                    self._flush_losses()
                     try:
                         self._save(status="halt", final=True)
                     except Exception as save_err:
@@ -983,6 +1079,7 @@ def _spawn_worker(py, root, ckpt_dir, n_devices, steps, save_every,
 
 
 def _ab_main(args) -> int:
+    import subprocess
     import sys
     import tempfile
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -1009,13 +1106,23 @@ def _ab_main(args) -> int:
             if proc.poll() is None:
                 time.sleep(kill_after)
                 proc.send_signal(_signal.SIGTERM)
-        rc = proc.wait(timeout=args.timeout)
+        try:
+            rc = proc.wait(timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            # a hung worker must not hang (or crash) the A/B: kill it
+            # and record the leg as timed out so the RESILIENCE record
+            # is still written, with ok=false
+            proc.kill()
+            proc.wait()
+            rc = None
         report = {}
         try:
             with open(rep_path, encoding="utf-8") as f:
                 report = json.load(f)
         except (OSError, ValueError):
             pass
+        if rc is None and not report:
+            report = {"status": "timeout"}
         return rc, report
 
     # leg A: uninterrupted baseline
@@ -1100,6 +1207,7 @@ __all__ = [
     "manifest_path", "param_signature", "build_manifest", "write_manifest",
     "read_manifest", "is_complete_checkpoint", "validate_manifest",
     "list_checkpoints", "latest_checkpoint", "keep_last_k",
+    "set_aside_checkpoint",
     "TrainController", "fit_resilient", "active_controller",
     "resilience_report", "RUN_STATUSES", "MANIFEST_SUFFIX",
 ]

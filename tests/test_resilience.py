@@ -201,13 +201,37 @@ def test_half_written_step_overwritable_by_default(dev, tmp_path):
     (stale / "junk").write_text("half-written")
     path = m.save_checkpoint(str(tmp_path / "ck"), step=0)  # no overwrite=
     overlap.wait_for_checkpoints()
-    assert not (stale / "junk").exists()   # reclaimed, then rewritten
+    assert not (stale / "junk").exists()   # step_0 name vacated, rewritten
+    # ...but the leftover was set ASIDE, not destroyed (a plain-API
+    # save never writes a manifest yet may be a complete checkpoint)
+    assert (tmp_path / "ck" / "step_0.reclaimed" / "junk").exists()
     m2, _tx, _ty = _build(dev, n_mesh=None, seed=9)
     m2.load_checkpoint(path)               # restorable: a real checkpoint
     for k, v in m.get_params().items():
         np.testing.assert_array_equal(
             np.asarray(jax.device_get(v.data)),
             np.asarray(jax.device_get(m2.get_params()[k].data)), err_msg=k)
+
+
+def test_set_aside_checkpoints_bounded(tmp_path):
+    """Review fix: reclaiming the same step in a crash-restart loop
+    must not grow disk without bound — set_aside_checkpoint keeps the
+    newest `keep` set-asides and deletes older ones."""
+    base = str(tmp_path / "step_0")
+    for i in range(6):
+        os.makedirs(base)
+        with open(os.path.join(base, "x"), "w", encoding="utf-8") as f:
+            f.write(str(i))
+        resilience.set_aside_checkpoint(base, ".reclaimed")
+        time.sleep(0.01)               # distinct mtimes for the pruner
+    aside = [n for n in os.listdir(tmp_path)
+             if n.startswith("step_0.reclaimed")]
+    assert len(aside) == 3                  # bounded (names recycle)
+    survived = set()
+    for n in aside:
+        with open(str(tmp_path / n / "x"), encoding="utf-8") as f:
+            survived.add(f.read())
+    assert survived == {"3", "4", "5"}      # ...and the newest survive
 
 
 def test_complete_step_still_raises_without_overwrite(dev, tmp_path):
@@ -324,6 +348,136 @@ def test_retry_after_transient_save_failure(dev, tmp_path):
     assert reg.get("singa_resilience_saves_total").value() >= 1
     path, man = resilience.latest_checkpoint(str(tmp_path / "ck"))
     assert man["step"] == 3                # the final save, durable
+
+
+def test_failed_async_save_never_manifested_complete(dev, tmp_path):
+    """Review fix: a deferred async-write failure must leave that save
+    UNMANIFESTED. Before the fix it surfaced inside the NEXT save's
+    internal barrier, where _retry re-ran save_checkpoint; the retry
+    succeeded vacuously (the error was already drained) and the dead
+    checkpoint's manifest was flushed as if its bytes had landed —
+    discovery would then trust a corrupt checkpoint."""
+    if not overlap.async_available():
+        pytest.skip("no AsyncCheckpointer in this orbax")
+    m, tx, ty = _build(dev)
+    # the step-2 save's deferred write fails at the barrier that
+    # settles it (the start of the step-4 save)
+    plan = resilience.install_fault_plan(
+        resilience.FaultPlan().fail("ckpt.wait", times=1))
+    ctrl = resilience.TrainController(
+        m, str(tmp_path / "ck"), save_every_steps=2, retries=2,
+        backoff_s=0.01, handle_signals=False)
+    report = ctrl.fit([(tx, ty)] * 6, epochs=1)
+    assert report["status"] == "completed"
+    assert [k for _pt, _n, k in plan.fired] == ["fail"]
+    # the failed save's dir is on disk but has NO manifest: discovery
+    # and retention both ignore it
+    s2 = tmp_path / "ck" / "step_2"
+    assert s2.is_dir()
+    assert not resilience.is_complete_checkpoint(str(s2))
+    steps = [s for s, _p, _m in
+             resilience.list_checkpoints(str(tmp_path / "ck"))]
+    assert steps == [4, 6]
+    # the settle consumed the failure outside the retry wrapper: it was
+    # dropped (reported), never retried into a vacuous success
+    assert observe.get_registry().get(
+        "singa_resilience_retries_total").value() == 0
+
+
+def test_manifest_survives_error_drained_by_another_barrier(dev, tmp_path):
+    """Review fix: when ANOTHER actor's wait_for_checkpoints drains the
+    shared pending list and consumes a deferred write failure, the
+    controller's own (now vacuously clean) barrier must still not
+    manifest the dead save — overlap records the failed path past the
+    drain (overlap.write_failed) and the settle consults it."""
+    if not overlap.async_available():
+        pytest.skip("no AsyncCheckpointer in this orbax")
+    ck = str(tmp_path / "ck")
+    m, tx, ty = _build(dev, n_mesh=None)
+    ctrl = resilience.TrainController(m, ck, handle_signals=False)
+    ctrl._step = 1
+    ctrl._save()                        # async save, manifest pending
+    assert ctrl._pending_manifest is not None
+    # an unrelated actor barriers and eats the deferred failure
+    resilience.install_fault_plan(resilience.FaultPlan().fail("ckpt.wait"))
+    with pytest.raises(RuntimeError, match="async checkpoint write"):
+        overlap.wait_for_checkpoints()
+    resilience.clear_fault_plan()
+    assert overlap.pending_checkpoints() == 0
+    assert overlap.write_failed(os.path.join(ck, "step_1"))
+    ctrl._settle_pending()              # clean barrier — still no flush
+    assert ctrl._pending_manifest is None
+    assert resilience.list_checkpoints(ck) == []
+    # a fresh save to the same step supersedes the failure record and
+    # reclaims the unmanifested debris
+    ctrl._last_saved_step = -1
+    ctrl._save(final=True)
+    _path, man = resilience.latest_checkpoint(ck)
+    assert man["step"] == 1
+
+
+def test_foreign_barrier_failure_does_not_drop_own_manifest(dev, tmp_path):
+    """Review fix: when the shared barrier raises for ANOTHER actor's
+    save, the controller's own durable save must still be manifested —
+    the per-path failure record, not the raise, decides."""
+    if not overlap.async_available():
+        pytest.skip("no AsyncCheckpointer in this orbax")
+    ck = str(tmp_path / "ck")
+    m, tx, ty = _build(dev, n_mesh=None)
+    ctrl = resilience.TrainController(m, ck, handle_signals=False)
+    ctrl._step = 1
+    ctrl._save()                        # our async save: entry 1
+    other = str(tmp_path / "other")
+    assert overlap.start_async_save(    # a foreign save: entry 2
+        other, {"a": np.arange(8, dtype=np.float32)})
+    resilience.install_fault_plan(
+        resilience.FaultPlan().fail("ckpt.wait", nth=2))
+    ctrl._settle_pending()              # foreign failure reported...
+    resilience.clear_fault_plan()
+    assert ctrl._pending_manifest is None
+    assert overlap.write_failed(other)
+    assert not overlap.write_failed(os.path.join(ck, "step_1"))
+    _path, man = resilience.latest_checkpoint(ck)
+    assert man["step"] == 1             # ...our checkpoint is complete
+
+
+def test_sync_rewrite_clears_failed_path_record(dev, tmp_path):
+    """Review fix: a good SYNCHRONOUS rewrite of a path whose async
+    write once failed must supersede the failure record, like a fresh
+    async write does — otherwise that step can never be manifested."""
+    if not overlap.async_available():
+        pytest.skip("no AsyncCheckpointer in this orbax")
+    m, tx, ty = _build(dev, n_mesh=None)
+    ck = str(tmp_path / "ck")
+    p1 = m.save_checkpoint(ck, step=1, async_save=True)
+    resilience.install_fault_plan(resilience.FaultPlan().fail("ckpt.wait"))
+    with pytest.raises(RuntimeError, match="async checkpoint write"):
+        overlap.wait_for_checkpoints()
+    resilience.clear_fault_plan()
+    assert overlap.write_failed(p1)
+    # the unmanifested debris is reclaimed; the blocking write is
+    # durable on return and clears the record
+    p2 = m.save_checkpoint(ck, step=1, async_save=False)
+    assert p2 == p1
+    assert not overlap.write_failed(p1)
+
+
+def test_preempt_at_already_saved_step_keeps_terminal_status(dev, tmp_path):
+    """Review fix: a preemption landing on a step whose cadence save
+    already ran (step == _last_saved_step, manifest pending with status
+    'ok') must still flush that manifest with status 'preempt' — the
+    terminal-status marker is what tooling reads off the manifest."""
+    ck = str(tmp_path / "ck")
+    m, tx, ty = _build(dev, n_mesh=None)
+    resilience.install_fault_plan(resilience.FaultPlan().send_signal(
+        "step", signal.SIGTERM, step=3))
+    report = resilience.TrainController(
+        m, ck, save_every_steps=1, handle_signals=True).fit(
+        [(tx, ty)] * 8, epochs=1)
+    assert report["status"] == "preempted"
+    assert report["final_step"] == 3
+    _path, man = resilience.latest_checkpoint(ck)
+    assert man["step"] == 3 and man["status"] == "preempt"
 
 
 def test_save_retries_exhausted_raises(dev, tmp_path):
@@ -512,6 +666,35 @@ def test_preemption_signal_saves_and_resumes(dev, tmp_path):
     got = dict(report["history"] + report2["history"])
     np.testing.assert_allclose([got[k] for k in range(8)], ref,
                                rtol=1e-6, atol=1e-7)
+
+
+def test_fit_rejects_one_shot_iterator(dev, tmp_path):
+    """Review fix: a generator-fed controller would silently 'complete'
+    at the first restart/resume/epoch re-entry — reject it up front,
+    like Model.fit's no-batches guard."""
+    m, tx, ty = _build(dev, n_mesh=None)
+    ctrl = resilience.TrainController(
+        m, str(tmp_path / "ck"), handle_signals=False)
+    with pytest.raises(ValueError, match="re-iterable"):
+        ctrl.fit((b for b in [(tx, ty)] * 4), epochs=1)
+
+
+def test_fit_reentry_after_preemption_trains(dev, tmp_path):
+    """Review fix: the preemption flag is cleared at fit() entry, so
+    calling fit() again on a preempted controller continues training
+    instead of instantly returning another stale 'preempted' report."""
+    m, tx, ty = _build(dev, n_mesh=None)
+    resilience.install_fault_plan(resilience.FaultPlan().send_signal(
+        "step", signal.SIGTERM, step=3))
+    ctrl = resilience.TrainController(
+        m, str(tmp_path / "ck"), save_every_steps=2, handle_signals=True)
+    report = ctrl.fit([(tx, ty)] * 6, epochs=1)
+    assert report["status"] == "preempted"
+    assert report["final_step"] == 3
+    resilience.clear_fault_plan()
+    report2 = ctrl.fit([(tx, ty)] * 6, epochs=1)
+    assert report2["status"] == "completed"
+    assert report2["final_step"] == 6
 
 
 def test_halt_flows_into_save_then_stop(dev, tmp_path):
