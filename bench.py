@@ -171,6 +171,12 @@ def main():
                         "(/metrics /healthz /statusz /flightz /profilez) "
                         "on PORT (0 = ephemeral) while the bench runs; "
                         "implies --goodput")
+    p.add_argument("--fleet-dir", default=None, metavar="DIR",
+                   help="publish this process's telemetry shard "
+                        "(metrics + goodput + spans) into DIR while the "
+                        "bench runs, so a fleet coordinator aggregating "
+                        "DIR sees the bench as one more worker "
+                        "(singa_tpu.fleet)")
     p.add_argument("--metrics-out", default=None, metavar="FILE",
                    help="write the observe registry as Prometheus text "
                         "after the run (step histograms, compile counts, "
@@ -202,6 +208,13 @@ def main():
         # installed before the model exists so warmup compiles land in
         # the `compile` bucket
         goodput_tracker = goodput_mod.install()
+
+    fleet_writer = None
+    if args.fleet_dir:
+        from singa_tpu import fleet
+        # started before the build so compile-era spans ride the shards
+        fleet_writer = fleet.start_shard_writer(args.fleet_dir,
+                                                interval_s=0.5)
 
     dev = device.best_device()
     on_cpu = dev.is_host()
@@ -664,6 +677,10 @@ def main():
     if args.metrics_out:
         with open(args.metrics_out, "w") as f:
             f.write(observe.to_prometheus_text())
+    if fleet_writer is not None:
+        from singa_tpu import fleet
+        # final publish carries the bench record's singa_bench_* gauges
+        fleet.stop_shard_writer()
     print(json.dumps(rec))
     return 0
 

@@ -24,7 +24,7 @@ _LAZY_MODULES = (
     "initializer", "sonnx", "data", "image_tool", "snapshot",
     "parallel", "utils", "ops", "models", "io", "channel", "native",
     "observe", "xprof", "health", "serving", "introspect",
-    "goodput", "diag", "overlap", "resilience", "distributed",
+    "goodput", "diag", "overlap", "resilience", "distributed", "fleet",
 )
 
 

@@ -81,6 +81,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "/healthz": self._healthz,
                 "/statusz": self._statusz,
                 "/flightz": self._flightz,
+                "/fleetz": self._fleetz,
+                "/fleetz/trace": self._fleetz_trace,
                 "/profilez": self._profilez,
             }.get(url.path.rstrip("/") or "/")
             if route is None:
@@ -97,11 +99,14 @@ class _Handler(BaseHTTPRequestHandler):
     def _index(self, q):
         self._send(
             "singa_tpu diag server\n"
-            "  /metrics   Prometheus text\n"
-            "  /healthz   HealthMonitor verdict (JSON)\n"
-            "  /statusz   explain + goodput + recompile blame (text)\n"
-            "  /flightz   flight-bundle index; ?name=<bundle> fetches\n"
-            "  /profilez  ?steps=N[&seconds=S] on-demand xplane capture\n")
+            "  /metrics      Prometheus text\n"
+            "  /healthz      HealthMonitor verdict (JSON)\n"
+            "  /statusz      explain + goodput + recompile blame (text)\n"
+            "  /flightz      flight-bundle index; ?name=<bundle> fetches\n"
+            "  /fleetz       aggregated per-host fleet status (text)\n"
+            "  /fleetz/trace merged Perfetto/Chrome trace (JSON)\n"
+            "  /profilez     ?steps=N[&seconds=S] on-demand xplane "
+            "capture\n")
 
     def _metrics(self, q):
         gp = goodput.get_tracker()
@@ -180,6 +185,31 @@ class _Handler(BaseHTTPRequestHandler):
             return
         with open(path, "rb") as f:
             self._send(f.read(), ctype="application/x-ndjson")
+
+    def _fleetz(self, q):
+        """Aggregated fleet status: per-host step rate, goodput ratio,
+        straggler score, shard staleness — the coordinator's one-page
+        answer to "which host is slow?". Served from the process's
+        installed fleet.FleetAggregator (singa_tpu.fleet)."""
+        from . import fleet
+        self._send(fleet.fleet_report() + "\n",
+                   status=200 if fleet.get_aggregator() is not None
+                   else 503)
+
+    def _fleetz_trace(self, q):
+        """The merged Perfetto/Chrome trace (Trace Event Format JSON,
+        one track per host) built from every worker's published span
+        records, clocks aligned — download and open in Perfetto."""
+        from . import fleet
+        agg = fleet.get_aggregator()
+        if agg is None:
+            self._send_json(
+                {"error": "no FleetAggregator installed "
+                          "(singa_tpu.fleet.install_aggregator)"},
+                status=503)
+            return
+        agg.poll()
+        self._send_json(agg.trace_events())
 
     def _profilez(self, q):
         import tempfile

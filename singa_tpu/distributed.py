@@ -99,6 +99,21 @@ def topology() -> dict:
     }
 
 
+def host_label() -> str:
+    """The bounded-cardinality `host=` metric label for THIS process:
+    "host<process_index>" from the live `topology()`. Every `host=`
+    label value in the package must originate here (or from topology()
+    directly) — tools/check_metrics_names.py rule 6 rejects free-form
+    host labels, the same enum-proof contract as reason=/bucket=.
+    `SINGA_FLEET_HOST` overrides it for the MULTICHIP-style subprocess
+    harnesses, where workers are separate OS processes that never ran
+    jax.distributed.initialize (they would all report process 0)."""
+    env = os.environ.get("SINGA_FLEET_HOST")
+    if env:
+        return env
+    return f"host{topology()['process_index']}"
+
+
 def resume_mesh(n: int | None = None, axis: str = "data"):
     """A data mesh over the devices THIS incarnation of the job has —
     the elastic-restart hook: a run killed on 8 workers relaunches on

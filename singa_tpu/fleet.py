@@ -1,0 +1,1159 @@
+"""Fleet observability: cross-process telemetry, merged into one surface.
+
+PRs 1-6 made a single process fully legible — metrics registry, spans,
+compile blame, goodput ledger, live /statusz — and made multi-process
+training survivable, but every telemetry surface stayed strictly
+per-process: in the MULTICHIP/kill-resume harnesses each worker has its
+own registry, its own diag server, its own flight recorder, and nothing
+can answer "which host is slow?" or "what did the fleet do at step N?".
+This module is the cross-process layer over the `jax.distributed`
+topology (`distributed.topology()` / `host_label()`):
+
+  - **ShardWriter** (every worker): periodically serializes the process's
+    telemetry — metrics snapshot, goodput buckets, health verdict, and
+    the recent span-record ring (`observe.enable_span_records`) — to a
+    shared spool directory as `fleet_dir/worker_<pid>.shard.jsonl`.
+    Each publish rewrites the whole file via tmp + atomic `os.replace`
+    with a monotonic sequence number, so a reader never sees a torn
+    shard and can tell a fresh publish from a stalled one. The shard
+    header carries a paired `(time.time(), time.perf_counter())` clock
+    sample — the handshake the aggregator uses to align every worker's
+    monotonic span stamps onto one wall-clock timeline.
+
+  - **FleetAggregator** (the coordinator): scans the spool, merges
+    shards into fleet-level rollups — counters summed, histograms merged
+    bucket-wise, gauges kept per-host with min/max/mean — and tracks
+    per-worker staleness: a worker whose shard stops aging forward is
+    flagged dead-or-wedged after `stale_after_s`.
+
+  - **Straggler detector**: each worker's per-step (`model.step` span)
+    and per-collective (`singa_comm_host_seconds` stamps from
+    parallel/communicator.py) timings are scored as deviation from the
+    fleet median — `score = (host - median) / median`, floored at 0 —
+    exported as `singa_fleet_straggler_score{host=...}`. A host above
+    `threshold` for `sustain` consecutive polls is a SUSTAINED
+    straggler: the verdict feeds the active `health.HealthMonitor`
+    (its warn/halt policy applies, `note_external`) and, under the halt
+    policy, `check_straggler_halt()` raises `FleetStragglerError`
+    (a HealthError) out of `resilience.TrainController`'s loop so the
+    elastic restart can exclude the slow host (`report["exclude_hosts"]`).
+
+  - **Merged trace export**: every worker's span records (name, start,
+    duration, tid, pid) are aligned via the per-worker clock handshake
+    and emitted as one Chrome Trace Event Format JSON
+    (`export_trace(path)`) — loads in Perfetto with one track per host,
+    the first artifact where a cross-host stall is *visible* rather
+    than inferred.
+
+  - Diag endpoints: the coordinator's existing `diag.DiagServer` serves
+    `/fleetz` (per-host step rate, goodput ratio, straggler scores,
+    shard staleness) and `/fleetz/trace` (the merged trace, on demand).
+
+CLI: `python -m singa_tpu.fleet --ab --out FLEET_r01.json` runs the
+MULTICHIP-style subprocess A/B — N workers, one with a FaultPlan-injected
+delay on its collectives (`fault_point("comm.collective")`), a
+coordinator that must detect the straggler within K steps from /fleetz
+and export a schema-valid merged trace showing the injected gap.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import statistics
+import tempfile
+import threading
+import time
+
+from . import distributed, health, observe
+
+SHARD_VERSION = 1
+SHARD_SUFFIX = ".shard.jsonl"
+
+#: span-record leaf names the straggler detector treats as one train step
+STEP_SPAN_LEAF = "model.step"
+
+#: how many of a worker's most recent step/collective samples feed its
+#: straggler signal (older samples describe a previous regime)
+_SIGNAL_WINDOW = 32
+
+#: per-worker cap on span records retained for the merged trace
+_TRACE_SPANS_PER_WORKER = 20_000
+
+
+class FleetStragglerError(health.HealthError):
+    """Raised by `check_straggler_halt` once a sustained straggler
+    verdict lands under the halt policy. A HealthError on purpose:
+    `resilience.TrainController` already routes HealthError through its
+    save-then-stop path (final checkpoint, manifest status "halt") and
+    attaches the run report — this adds `.hosts`, the slow host(s) an
+    elastic restart should exclude."""
+
+    def __init__(self, msg, hosts=(), score=None):
+        super().__init__(msg)
+        self.hosts = tuple(hosts)
+        self.score = score
+
+
+# ---- metrics ---------------------------------------------------------------
+
+def _writer_metrics():
+    # observe.counter/gauge spelled out so the static lint sees them
+    return {
+        "publishes": observe.counter(
+            "singa_fleet_shard_publish_total",
+            "telemetry shard publishes by this worker"),
+        "errors": observe.counter(
+            "singa_fleet_shard_publish_errors_total",
+            "telemetry shard publishes that failed"),
+        "seq": observe.gauge(
+            "singa_fleet_shard_seq_last",
+            "sequence number of this worker's last published shard"),
+    }
+
+
+def _agg_metrics():
+    return {
+        "polls": observe.counter(
+            "singa_fleet_polls_total",
+            "aggregator spool scans"),
+        "workers": observe.gauge(
+            "singa_fleet_workers",
+            "worker shards the aggregator currently tracks"),
+        "stale": observe.gauge(
+            "singa_fleet_workers_stale",
+            "tracked workers whose shard stopped aging forward"),
+        "score": observe.gauge(
+            "singa_fleet_straggler_score",
+            "per-host deviation from the fleet-median step/collective "
+            "time ((host - median)/median, floored at 0)"),
+        "age": observe.gauge(
+            "singa_fleet_shard_age_seconds",
+            "seconds since each worker's last shard publish"),
+        "seq": observe.gauge(
+            "singa_fleet_shard_seq",
+            "per-host sequence number of the last shard seen"),
+        "rate": observe.gauge(
+            "singa_fleet_step_rate",
+            "per-host train steps per second (between shard publishes)"),
+        "goodput": observe.gauge(
+            "singa_fleet_goodput_ratio",
+            "per-host productive share of wall time, from each "
+            "worker's goodput snapshot"),
+        "sustained": observe.counter(
+            "singa_fleet_straggler_sustained_total",
+            "sustained-straggler verdicts by host"),
+    }
+
+
+# ---- shard writing ---------------------------------------------------------
+
+class ShardWriter:
+    """Publishes this process's telemetry to `fleet_dir` as an atomic
+    JSONL shard with a monotonic `seq`.
+
+    `interval_s > 0` starts a daemon publisher thread
+    (`singa-fleet-shard-<pid>`); `interval_s = 0` means manual-only
+    (`publish()`), which tests use. `fleet_dir=None` creates a temp
+    spool dir (owned by this module; `fleet.uninstall()` removes it).
+    Enables the observe span-record ring so recent spans and collective
+    stamps ride along in every shard.
+    """
+
+    def __init__(self, fleet_dir: "str | None" = None,
+                 interval_s: float = 0.5, host: "str | None" = None,
+                 name: "str | None" = None, span_capacity: int = 4096):
+        if fleet_dir is None:
+            fleet_dir = tempfile.mkdtemp(prefix="singa_fleet_")
+            _owned_dirs.append(fleet_dir)
+        self.fleet_dir = os.path.abspath(fleet_dir)
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        self.host = host or distributed.host_label()
+        self.pid = os.getpid()
+        self.interval_s = float(interval_s)
+        base = name or f"worker_{self.pid}"
+        self.path = os.path.join(self.fleet_dir, base + SHARD_SUFFIX)
+        self.seq = 0
+        self.started_ts = time.time()
+        self._plock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        observe.enable_span_records(span_capacity)
+        _writers.append(self)
+        if self.interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"singa-fleet-shard-{self.pid}")
+            self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.publish()
+            except Exception:
+                # a broken publish must never kill the publisher (the
+                # next tick retries); it is counted, not raised
+                try:
+                    _writer_metrics()["errors"].inc()
+                except Exception:
+                    pass
+
+    def _snapshot_lines(self):
+        header = {
+            "kind": "fleet_shard_header", "version": SHARD_VERSION,
+            "seq": self.seq, "host": self.host, "pid": self.pid,
+            # the clock handshake: one paired (epoch, monotonic) sample
+            # per publish — the aggregator maps this worker's span
+            # stamps onto the shared wall clock via ts - perf
+            "ts": round(time.time(), 6),
+            "perf": round(time.perf_counter(), 7),
+            "started_ts": round(self.started_ts, 6),
+            "steps": self._steps(),
+        }
+        lines = [header,
+                 {"kind": "fleet_metrics",
+                  "metrics": observe.get_registry().snapshot()}]
+        gp = None
+        try:
+            from . import goodput
+            tracker = goodput.get_tracker()
+            if tracker is not None:
+                gp = tracker.snapshot()
+        except Exception:
+            gp = None
+        lines.append({"kind": "fleet_goodput", "goodput": gp})
+        mon = health.active_monitor()
+        lines.append({"kind": "fleet_health",
+                      "verdict": mon.verdict() if mon is not None
+                      else None})
+        for rec in observe.span_records():
+            lines.append({"kind": "fleet_span", "name": rec["name"],
+                          "t0": rec["t0"], "dur": rec["dur"],
+                          "tid": rec["tid"],
+                          "span_kind": rec.get("kind", "span")})
+        return lines
+
+    @staticmethod
+    def _steps() -> int:
+        c = observe.get_registry().get("singa_steps_total")
+        return int(c.value()) if c is not None else 0
+
+    def publish(self) -> int:
+        """Serialize one shard and atomically replace the previous one.
+        Returns the published sequence number."""
+        with self._plock:
+            self.seq += 1
+            lines = self._snapshot_lines()
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                for rec in lines:
+                    f.write(json.dumps(rec, separators=(",", ":"),
+                                       default=str) + "\n")
+                f.flush()
+            os.replace(tmp, self.path)
+            m = _writer_metrics()
+            m["publishes"].inc()
+            m["seq"].set(float(self.seq))
+            return self.seq
+
+    def close(self, final_publish: bool = True):
+        """Stop the publisher thread (joined) and optionally publish one
+        last shard so the spool holds this worker's final state."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_publish:
+            try:
+                self.publish()
+            except Exception:
+                pass
+        if self in _writers:
+            _writers.remove(self)
+
+
+def read_shard(path: str) -> "dict | None":
+    """Parse one shard file back into {"header", "metrics", "goodput",
+    "health", "spans"} — None when the file is missing or carries no
+    valid header (an interrupted worker start; atomic replace means a
+    PUBLISHED shard is never torn)."""
+    rows = observe.EventLog.read(path)
+    header = next((r for r in rows
+                   if r.get("kind") == "fleet_shard_header"), None)
+    if header is None or not isinstance(header.get("seq"), int):
+        return None
+    return {
+        "header": header,
+        "metrics": next((r.get("metrics") for r in rows
+                         if r.get("kind") == "fleet_metrics"), None) or {},
+        "goodput": next((r.get("goodput") for r in rows
+                         if r.get("kind") == "fleet_goodput"), None),
+        "health": next((r.get("verdict") for r in rows
+                        if r.get("kind") == "fleet_health"), None),
+        "spans": [r for r in rows if r.get("kind") == "fleet_span"],
+    }
+
+
+# ---- merging ---------------------------------------------------------------
+
+def merge_metric_snapshots(snaps: dict) -> dict:
+    """Merge per-host registry snapshots ({host: snapshot}) into fleet
+    rollups: counters and histograms are SUMMED across hosts (bucket-wise
+    for histograms — cumulative counts sum to cumulative counts), gauges
+    are kept per-host and summarized as min/max/mean. Label sets within
+    a metric merge by their label key."""
+    merged = {}
+    for hostname, snap in sorted(snaps.items()):
+        for name, m in (snap or {}).items():
+            kind = m.get("type")
+            out = merged.setdefault(name, {"type": kind, "series": {}})
+            if out["type"] != kind:
+                continue  # conflicting types across hosts: first wins
+            for s in m.get("samples", []):
+                key = tuple(sorted((s.get("labels") or {}).items()))
+                row = out["series"].setdefault(
+                    key, {"labels": dict(key)})
+                if kind == "histogram":
+                    row["count"] = row.get("count", 0) + s.get("count", 0)
+                    row["sum"] = row.get("sum", 0.0) + s.get("sum", 0.0)
+                    buckets = row.setdefault("buckets", {})
+                    for ub, c in (s.get("buckets") or {}).items():
+                        buckets[ub] = buckets.get(ub, 0) + c
+                elif kind == "counter":
+                    row["value"] = row.get("value", 0.0) + s.get("value",
+                                                                 0.0)
+                else:  # gauge (and anything unknown): per-host detail
+                    per = row.setdefault("per_host", {})
+                    per[hostname] = s.get("value", 0.0)
+                    vals = list(per.values())
+                    row["min"] = min(vals)
+                    row["max"] = max(vals)
+                    row["mean"] = sum(vals) / len(vals)
+    return merged
+
+
+# ---- the aggregator --------------------------------------------------------
+
+class _WorkerState:
+    __slots__ = ("path", "host", "pid", "seq", "ts", "perf", "steps",
+                 "started_ts", "metrics", "goodput", "health", "spans",
+                 "prev_ts", "prev_steps", "step_rate", "over_since")
+
+    def __init__(self, path):
+        self.path = path
+        self.host = None
+        self.pid = None
+        self.seq = -1
+        self.ts = 0.0
+        self.perf = 0.0
+        self.steps = 0
+        self.started_ts = 0.0
+        self.metrics = {}
+        self.goodput = None
+        self.health = None
+        self.spans = {}   # (tid, t0, name) -> span rec, insertion-ordered
+        self.prev_ts = None
+        self.prev_steps = 0
+        self.step_rate = 0.0
+        self.over_since = 0  # consecutive polls above the threshold
+
+    @property
+    def clock_offset(self) -> float:
+        """epoch seconds corresponding to this worker's perf_counter 0 —
+        the handshake: ts and perf were sampled together at publish."""
+        return self.ts - self.perf
+
+
+class FleetAggregator:
+    """Coordinator-side merge of the spool directory's worker shards.
+
+    `poll()` re-scans the spool, updates per-worker state, recomputes
+    straggler scores and exports the `singa_fleet_*` gauges; `rollup()`
+    returns the last poll's fleet-level view. `policy` overrides the
+    active HealthMonitor's policy for the sustained-straggler verdict
+    (None = inherit the monitor's, default "warn"); under "halt" the
+    verdict is held sticky for `check_straggler_halt()` to raise from
+    the training loop.
+    """
+
+    def __init__(self, fleet_dir: str, stale_after_s: float = 5.0,
+                 threshold: float = 0.5, sustain: int = 3,
+                 policy: "str | None" = None,
+                 poll_interval_s: float = 0.5,
+                 background_poll: bool = False):
+        self.fleet_dir = os.path.abspath(fleet_dir)
+        self.stale_after_s = float(stale_after_s)
+        self.threshold = float(threshold)
+        self.sustain = int(sustain)
+        if policy is not None and policy not in health.POLICIES:
+            raise ValueError(
+                f"policy {policy!r} not in {health.POLICIES}")
+        self.policy = policy
+        self.poll_interval_s = float(poll_interval_s)
+        self._lock = threading.Lock()
+        self._workers: "dict[str, _WorkerState]" = {}
+        self._scores: "dict[str, float]" = {}
+        self._stale: "dict[str, float]" = {}  # host -> age seconds
+        self._halt: "dict | None" = None
+        self._sustained: "set[str]" = set()
+        self._last_poll = 0.0
+        self.started_mono = time.monotonic()
+        self._poll_stop = threading.Event()
+        self._poll_thread = None
+        if background_poll:
+            self.start_polling()
+
+    # -- polling -----------------------------------------------------------
+    def _scan(self):
+        try:
+            names = os.listdir(self.fleet_dir)
+        except OSError:
+            names = []
+        paths = [os.path.join(self.fleet_dir, n) for n in sorted(names)
+                 if n.endswith(SHARD_SUFFIX)]
+        # a worker whose shard file was removed (spool GC, relaunch
+        # cleanup) is forgotten — otherwise ghost incarnations inflate
+        # worker counts and keep feeding frozen signals forever
+        live = set(paths)
+        for path in list(self._workers):
+            if path not in live:
+                del self._workers[path]
+        for path in paths:
+            shard = read_shard(path)
+            if shard is None:
+                continue
+            h = shard["header"]
+            w = self._workers.get(path)
+            if w is None:
+                w = self._workers[path] = _WorkerState(path)
+            if h["seq"] < w.seq:
+                # a restarted worker reusing the shard path starts seq
+                # over: RESET the state and accept the new incarnation
+                # (skipping it would drop the restart's telemetry until
+                # its seq caught up with the dead one's)
+                w = self._workers[path] = _WorkerState(path)
+            fresh = h["seq"] > w.seq
+            if fresh:
+                w.prev_ts, w.prev_steps = w.ts or None, w.steps
+            w.seq = h["seq"]
+            w.host = h.get("host") or f"pid{h.get('pid')}"
+            w.pid = int(h.get("pid") or 0)
+            w.ts = float(h.get("ts") or 0.0)
+            w.perf = float(h.get("perf") or 0.0)
+            w.steps = int(h.get("steps") or 0)
+            w.started_ts = float(h.get("started_ts") or 0.0)
+            w.metrics = shard["metrics"]
+            w.goodput = shard["goodput"]
+            w.health = shard["health"]
+            if fresh and w.prev_ts and w.ts > w.prev_ts:
+                w.step_rate = max(
+                    0.0, (w.steps - w.prev_steps) / (w.ts - w.prev_ts))
+            for rec in shard["spans"]:
+                key = (rec.get("tid"), rec.get("t0"), rec.get("name"))
+                w.spans[key] = rec
+            if len(w.spans) > _TRACE_SPANS_PER_WORKER:
+                drop = len(w.spans) - _TRACE_SPANS_PER_WORKER
+                for key in list(w.spans)[:drop]:
+                    del w.spans[key]
+
+    @staticmethod
+    def _signal(w: "_WorkerState", want_comm: bool) -> "float | None":
+        """Mean duration of this worker's recent step or collective
+        records, or None when it has published none yet."""
+        durs = []
+        for rec in reversed(list(w.spans.values())):
+            if want_comm:
+                hit = rec.get("span_kind") == "comm"
+            else:
+                name = rec.get("name") or ""
+                hit = name.rsplit("/", 1)[-1] == STEP_SPAN_LEAF
+            if hit:
+                durs.append(float(rec.get("dur") or 0.0))
+                if len(durs) >= _SIGNAL_WINDOW:
+                    break
+        return (sum(durs) / len(durs)) if durs else None
+
+    def _score_locked(self):
+        """(host -> straggler score): per signal (step time, collective
+        time), deviation from the fleet median across hosts that have
+        the signal; a host's score is the worst of its signals."""
+        scores = {}
+        for want_comm in (False, True):
+            vals = {}
+            freshest = {}
+            for w in self._workers.values():
+                if w.host is None:
+                    continue
+                v = self._signal(w, want_comm)
+                if v is None:
+                    continue
+                # two shard files can carry the same host label (a dead
+                # incarnation's file next to its relaunch): the NEWEST
+                # publish owns the host's signal, regardless of scan
+                # order
+                if w.host not in freshest or w.ts > freshest[w.host]:
+                    freshest[w.host] = w.ts
+                    vals[w.host] = v
+            if len(vals) < 2:
+                continue  # a fleet of one has no median to deviate from
+            med = statistics.median(vals.values())
+            for hostname, v in vals.items():
+                s = max(0.0, (v - med) / max(med, 1e-9))
+                scores[hostname] = max(scores.get(hostname, 0.0), s)
+        # hosts with no signal at all still appear (score 0) so /fleetz
+        # lists every tracked worker
+        for w in self._workers.values():
+            if w.host is not None:
+                scores.setdefault(w.host, 0.0)
+        return scores
+
+    def _resolved_policy(self) -> str:
+        if self.policy is not None:
+            return self.policy
+        mon = health.active_monitor()
+        if mon is not None and mon.policy == "halt":
+            return "halt"
+        return "warn"
+
+    def _export_locked(self, now_epoch: float):
+        """Export the singa_fleet_* gauges. Every host= label value here
+        originates from distributed.host_label() on the worker that
+        published the shard; the coordinator's own label (host_label())
+        marks the local row in rollup()/fleet_report."""
+        local = distributed.host_label()
+        m = _agg_metrics()
+        m["workers"].set(float(len(self._workers)))
+        m["stale"].set(float(len(self._stale)))
+        # oldest-first so a host label shared by a dead incarnation and
+        # its relaunch gets the FRESHEST shard's values in the gauges
+        for w in sorted(self._workers.values(), key=lambda w: w.ts):
+            if w.host is None:
+                continue
+            m["age"].set(max(0.0, now_epoch - w.ts), host=w.host)
+            m["seq"].set(float(w.seq), host=w.host)
+            m["rate"].set(w.step_rate, host=w.host)
+            if isinstance(w.goodput, dict):
+                m["goodput"].set(
+                    float(w.goodput.get("goodput_ratio") or 0.0),
+                    host=w.host)
+        for hostname, score in self._scores.items():
+            m["score"].set(score, host=hostname)
+        return local
+
+    def _verdicts_locked(self):
+        """Advance per-host sustained-straggler state; fire policy
+        actions on the poll that crosses `sustain`."""
+        fired = []
+        for w in self._workers.values():
+            if w.host is None:
+                continue
+            if self._scores.get(w.host, 0.0) > self.threshold:
+                w.over_since += 1
+            else:
+                w.over_since = 0
+                self._sustained.discard(w.host)
+            if w.over_since >= self.sustain \
+                    and w.host not in self._sustained:
+                self._sustained.add(w.host)
+                fired.append((w.host, self._scores.get(w.host, 0.0)))
+        return fired
+
+    def _apply_policy(self, fired):
+        """Outside the lock: metric/emit/monitor plumbing for each new
+        sustained verdict (host values originate from host_label() on
+        the workers; see _export_locked)."""
+        if not fired:
+            return
+        policy = self._resolved_policy()
+        mon = health.active_monitor()
+        # every hostname below was minted by distributed.host_label()
+        # on the worker that published it; the coordinator's own label
+        # tags the verdict's origin
+        local = distributed.host_label()
+        for hostname, score in fired:
+            _agg_metrics()["sustained"].inc(host=hostname)
+            observe.get_registry().emit(
+                {"kind": "fleet", "event": "straggler_sustained",
+                 "host": hostname, "coordinator": local,
+                 "score": round(score, 4), "policy": policy})
+            if mon is not None:
+                try:
+                    # pass the RESOLVED action: the aggregator's policy
+                    # may override the monitor's, and /healthz must not
+                    # claim a halt that never happened (or vice versa)
+                    mon.note_external(
+                        health.KIND_STRAGGLER,
+                        detail={"host": hostname,
+                                "score": round(score, 4)},
+                        action="halt" if policy == "halt" else "warn")
+                except Exception:
+                    pass  # the monitor must not break the aggregator
+            if policy == "halt" and self._halt is None:
+                self._halt = {"host": hostname,
+                              "score": round(score, 4),
+                              "ts": round(time.time(), 6)}
+
+    def poll(self) -> dict:
+        """Re-scan the spool and return the fresh rollup."""
+        now_epoch = time.time()
+        with self._lock:
+            self._scan()
+            self._scores = self._score_locked()
+            self._stale = {
+                w.host: round(now_epoch - w.ts, 3)
+                for w in self._workers.values()
+                if w.host is not None
+                and now_epoch - w.ts > self.stale_after_s}
+            fired = self._verdicts_locked()
+            self._export_locked(now_epoch)
+            self._last_poll = time.monotonic()
+        _agg_metrics()["polls"].inc()
+        self._apply_policy(fired)
+        return self.rollup()
+
+    def poll_if_due(self):
+        if self._poll_thread is not None:
+            return  # the background thread owns the cadence
+        if time.monotonic() - self._last_poll >= self.poll_interval_s:
+            self.poll()
+
+    # -- background polling ------------------------------------------------
+    def start_polling(self):
+        """Run poll() on a daemon thread (`singa-fleet-agg`) instead of
+        the caller's cadence — for big fleets, where a synchronous spool
+        rescan (every shard read + parsed) inside the training loop's
+        `check_straggler_halt` would steal step time. The training hook
+        then only reads the sticky halt verdict. Idempotent;
+        `stop_polling` / `uninstall_aggregator` join the thread."""
+        if self._poll_thread is not None and self._poll_thread.is_alive():
+            return
+        self._poll_stop.clear()
+
+        def _loop():
+            while not self._poll_stop.wait(
+                    max(self.poll_interval_s, 0.05)):
+                try:
+                    self.poll()
+                except Exception:
+                    pass  # a bad shard scan must not kill the cadence
+
+        self._poll_thread = threading.Thread(
+            target=_loop, daemon=True, name="singa-fleet-agg")
+        self._poll_thread.start()
+
+    def stop_polling(self):
+        self._poll_stop.set()
+        t = self._poll_thread
+        self._poll_thread = None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    # -- reading -----------------------------------------------------------
+    def workers(self) -> list:
+        with self._lock:
+            return sorted((w for w in self._workers.values()
+                           if w.host is not None),
+                          key=lambda w: (w.host, w.pid))
+
+    def straggler_scores(self) -> dict:
+        with self._lock:
+            return dict(self._scores)
+
+    def halt_verdict(self) -> "dict | None":
+        return self._halt
+
+    def clear_halt(self):
+        self._halt = None
+
+    def rollup(self) -> dict:
+        """The fleet-level view of the last poll: per-host rows plus the
+        merged metric rollups."""
+        now_epoch = time.time()
+        with self._lock:
+            rows = []
+            for w in sorted(self._workers.values(),
+                            key=lambda w: (w.host or "", w.pid or 0)):
+                if w.host is None:
+                    continue
+                rows.append({
+                    "host": w.host, "pid": w.pid, "seq": w.seq,
+                    "age_s": round(max(0.0, now_epoch - w.ts), 3),
+                    "stale": w.host in self._stale,
+                    "steps": w.steps,
+                    "step_rate": round(w.step_rate, 3),
+                    "goodput_ratio":
+                        round(float(w.goodput.get("goodput_ratio")), 4)
+                        if isinstance(w.goodput, dict) else None,
+                    "straggler_score":
+                        round(self._scores.get(w.host, 0.0), 4),
+                    "sustained": w.host in self._sustained,
+                    "health": (w.health or {}).get("status")
+                        if isinstance(w.health, dict) else None,
+                })
+            merged = merge_metric_snapshots(
+                {w.host: w.metrics for w in self._workers.values()
+                 if w.host is not None})
+            return {
+                "fleet_dir": self.fleet_dir,
+                "n_workers": len(rows),
+                "n_stale": len(self._stale),
+                "threshold": self.threshold,
+                "sustain": self.sustain,
+                "policy": self._resolved_policy(),
+                "workers": rows,
+                "stragglers": sorted(self._sustained),
+                "halt": self._halt,
+                "metrics": merged,
+            }
+
+    # -- merged trace ------------------------------------------------------
+    def trace_events(self) -> dict:
+        """The merged Chrome Trace Event Format object: one process
+        (track) per worker, span + collective slices on it, clocks
+        aligned onto the shared wall timeline via each worker's
+        (epoch, perf_counter) handshake."""
+        events = []
+        with self._lock:
+            workers = [w for w in self._workers.values()
+                       if w.host is not None]
+            workers.sort(key=lambda w: (w.host, w.pid))
+            for i, w in enumerate(workers):
+                events.append({"ph": "M", "name": "process_name",
+                               "pid": w.pid, "tid": 0,
+                               "args": {"name": f"{w.host} "
+                                                f"(pid {w.pid})"}})
+                events.append({"ph": "M", "name": "process_sort_index",
+                               "pid": w.pid, "tid": 0,
+                               "args": {"sort_index": i}})
+                off = w.clock_offset
+                for rec in w.spans.values():
+                    t0 = rec.get("t0")
+                    dur = rec.get("dur")
+                    if t0 is None or dur is None:
+                        continue
+                    events.append({
+                        "name": (rec.get("name") or "?"
+                                 ).rsplit("/", 1)[-1],
+                        "cat": rec.get("span_kind") or "span",
+                        "ph": "X",
+                        "ts": round((float(t0) + off) * 1e6, 3),
+                        "dur": round(float(dur) * 1e6, 3),
+                        "pid": w.pid,
+                        "tid": int(rec.get("tid") or 0),
+                        "args": {"path": rec.get("name"),
+                                 "host": w.host},
+                    })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_trace(self, path: str) -> str:
+        """Write the merged trace JSON to `path` (open it in Perfetto /
+        chrome://tracing) and return the path."""
+        trace = self.trace_events()
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(trace, f, separators=(",", ":"))
+        return path
+
+
+# ---- module singletons -----------------------------------------------------
+
+_writers: "list[ShardWriter]" = []
+_owned_dirs: "list[str]" = []
+_shard_writer: "ShardWriter | None" = None
+_aggregator: "FleetAggregator | None" = None
+_lock = threading.Lock()
+
+
+def start_shard_writer(fleet_dir: "str | None" = None,
+                       **kwargs) -> ShardWriter:
+    """Start (or return) the process shard writer. A second call with a
+    DIFFERENT fleet_dir replaces the old writer (closed first)."""
+    global _shard_writer
+    with _lock:
+        w = _shard_writer
+        if w is not None:
+            if fleet_dir is None \
+                    or os.path.abspath(fleet_dir) == w.fleet_dir:
+                return w
+            w.close()
+        _shard_writer = ShardWriter(fleet_dir, **kwargs)
+        return _shard_writer
+
+
+def stop_shard_writer():
+    """Close the process shard writer (idempotent)."""
+    global _shard_writer
+    with _lock:
+        if _shard_writer is not None:
+            _shard_writer.close()
+            _shard_writer = None
+
+
+def get_shard_writer() -> "ShardWriter | None":
+    return _shard_writer
+
+
+def install_aggregator(fleet_dir: "str | None" = None,
+                       **kwargs) -> FleetAggregator:
+    """Install (or return) the process FleetAggregator — the object
+    /fleetz, check_straggler_halt and export_trace answer from. May be
+    passed a ready FleetAggregator via `fleet_dir=None, aggregator=`."""
+    global _aggregator
+    agg = kwargs.pop("aggregator", None)
+    with _lock:
+        if agg is not None:
+            if _aggregator is not None and _aggregator is not agg:
+                _aggregator.stop_polling()  # don't leak the old cadence
+            _aggregator = agg
+            return agg
+        if _aggregator is not None:
+            return _aggregator
+        if fleet_dir is None:
+            raise ValueError("install_aggregator needs a fleet_dir "
+                             "(or aggregator=)")
+        _aggregator = FleetAggregator(fleet_dir, **kwargs)
+        return _aggregator
+
+
+def uninstall_aggregator():
+    global _aggregator
+    with _lock:
+        agg = _aggregator
+        _aggregator = None
+    if agg is not None:
+        agg.stop_polling()
+
+
+def get_aggregator() -> "FleetAggregator | None":
+    return _aggregator
+
+
+def uninstall():
+    """Full fleet teardown (the conftest contract): every shard writer
+    closed (threads joined), the aggregator dropped, the span-record
+    ring disabled, and spool temp dirs this module created removed."""
+    stop_shard_writer()
+    for w in list(_writers):
+        w.close(final_publish=False)
+    uninstall_aggregator()
+    observe.disable_span_records()
+    for d in list(_owned_dirs):
+        shutil.rmtree(d, ignore_errors=True)
+        _owned_dirs.remove(d)
+
+
+def export_trace(path: str) -> str:
+    """Poll the installed aggregator and write the merged trace JSON."""
+    agg = _aggregator
+    if agg is None:
+        raise RuntimeError("no FleetAggregator installed "
+                           "(fleet.install_aggregator(fleet_dir))")
+    agg.poll()
+    return agg.export_trace(path)
+
+
+def check_straggler_halt(step: "int | None" = None):
+    """Training-loop hook (resilience.TrainController calls it every
+    step): no-op without an aggregator; otherwise polls on the
+    aggregator's cadence and raises FleetStragglerError once a sustained
+    straggler verdict landed under the halt policy. Raising from the
+    LOOP (not the aggregator's caller) is the point — the controller's
+    HealthError path saves a final checkpoint and attaches the report."""
+    agg = _aggregator
+    if agg is None:
+        return
+    agg.poll_if_due()
+    h = agg.halt_verdict()
+    if h is not None:
+        raise FleetStragglerError(
+            f"sustained straggler {h['host']} "
+            f"(score {h['score']:.2f} > {agg.threshold:.2f} for "
+            f"{agg.sustain} polls); elastic restart should exclude it"
+            + (f" [step {step}]" if step is not None else ""),
+            hosts=(h["host"],), score=h["score"])
+
+
+def fleet_report() -> str:
+    """Text block for /fleetz: one row per worker plus fleet rollups."""
+    agg = _aggregator
+    if agg is None:
+        return ("no FleetAggregator installed "
+                "(singa_tpu.fleet.install_aggregator(fleet_dir))")
+    roll = agg.poll()
+    local = distributed.host_label()
+    lines = [
+        f"== fleet ==  coordinator pid {os.getpid()}  "
+        f"spool {roll['fleet_dir']}",
+        f"workers: {roll['n_workers']} ({roll['n_stale']} stale)   "
+        f"policy: {roll['policy']}   "
+        f"straggler threshold: {roll['threshold']:.2f} "
+        f"(sustain {roll['sustain']} polls)",
+        f"{'host':<12} {'pid':>7} {'seq':>5} {'age_s':>7} {'steps':>7} "
+        f"{'step/s':>8} {'goodput':>8} {'straggler':>10} state",
+    ]
+    for r in roll["workers"]:
+        state = "STALE" if r["stale"] else (
+            "STRAGGLER" if r["sustained"] else (r["health"] or "ok"))
+        mark = "*" if r["host"] == local else " "
+        gp = f"{r['goodput_ratio']:.2f}" \
+            if r["goodput_ratio"] is not None else "-"
+        lines.append(
+            f"{r['host']:<11}{mark} {r['pid']:>7} {r['seq']:>5} "
+            f"{r['age_s']:>7.2f} {r['steps']:>7} "
+            f"{r['step_rate']:>8.2f} {gp:>8} "
+            f"{r['straggler_score']:>10.3f} {state}")
+    steps_total = 0
+    for s in (roll["metrics"].get("singa_steps_total") or
+              {}).get("series", {}).values():
+        steps_total += int(s.get("value", 0.0))
+    lines.append(f"fleet steps: {steps_total}   "
+                 f"sustained stragglers: "
+                 f"{','.join(roll['stragglers']) or 'none'}   "
+                 f"halt: {roll['halt'] or 'none'}")
+    return "\n".join(lines)
+
+
+# ---- CLI: the multi-process straggler A/B ----------------------------------
+# `--worker` runs one telemetry-publishing training leg (a tiny real
+# model, or --synthetic for a model-free span/collective loop); `--ab`
+# spawns N workers, injects a FaultPlan delay into ONE worker's
+# collectives (`fault_point("comm.collective")`), and asserts from the
+# COORDINATOR side — via /fleetz and the exported merged trace — that
+# the slow host is detected within K steps and visibly slow on its
+# trace track. Writes FLEET_r*.json.
+
+def _worker_main(args) -> int:
+    if args.host:
+        os.environ["SINGA_FLEET_HOST"] = args.host
+    if args.delay_collectives > 0:
+        from . import resilience
+        plan = resilience.FaultPlan()
+        plan.delay("comm.collective", args.delay_collectives,
+                   times=10 ** 9)
+        resilience.install_fault_plan(plan)
+    writer = start_shard_writer(args.fleet_dir,
+                                interval_s=args.publish_interval)
+    from .parallel.communicator import Communicator
+    import jax.numpy as jnp
+    comm = Communicator()  # world 1: the eager per-step host collective
+    tick = jnp.ones(())
+    model = tx = ty = None
+    if not args.synthetic:
+        from .resilience import _worker_build
+        model, tx, ty = _worker_build(args.mesh_devices, args.batch,
+                                      args.seed)
+    for _ in range(args.steps):
+        t0 = time.perf_counter()
+        if args.synthetic:
+            with observe.span(STEP_SPAN_LEAF):
+                if args.step_sleep:
+                    time.sleep(args.step_sleep)
+                comm.all_reduce(tick)
+            observe.record_step(time.perf_counter() - t0)
+        else:
+            model(tx, ty)  # spans model.step + records the step itself
+            comm.all_reduce(tick)
+            if args.step_sleep:
+                time.sleep(args.step_sleep)
+        writer.publish()
+    stop_shard_writer()
+    print(json.dumps({"host": distributed.host_label(),
+                      "steps": args.steps,
+                      "mode": "synthetic" if args.synthetic else "model"}))
+    return 0
+
+
+def _spawn_fleet_worker(py, root, args, idx, delay):
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SINGA_FLEET_HOST=f"host{idx}")
+    env.pop("SINGA_TPU_DIAG_PORT", None)
+    if not args.synthetic:
+        env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                            f"{args.mesh_devices}")
+    cmd = [py, "-m", "singa_tpu.fleet", "--worker",
+           "--fleet-dir", args.fleet_dir,
+           "--steps", str(args.steps),
+           "--step-sleep", str(args.step_sleep),
+           "--publish-interval", str(args.publish_interval),
+           "--mesh-devices", str(args.mesh_devices),
+           "--batch", str(args.batch), "--seed", str(args.seed),
+           "--delay-collectives", str(delay)]
+    if args.synthetic:
+        cmd.append("--synthetic")
+    return subprocess.Popen(cmd, cwd=root, env=env,
+                            stdout=sys.stderr, stderr=sys.stderr)
+
+
+def _http_get(url: str) -> bytes:
+    from urllib.request import urlopen
+    with urlopen(url, timeout=30) as r:
+        return r.read()
+
+
+def _ab_main(args) -> int:
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    work = tempfile.mkdtemp(prefix="singa_fleet_ab_")
+    args.fleet_dir = os.path.join(work, "spool")
+    os.makedirs(args.fleet_dir, exist_ok=True)
+    slow_idx = args.workers - 1
+    slow_host = f"host{slow_idx}"
+    rec = {"workers": args.workers, "steps": args.steps,
+           "delay_s": args.delay, "threshold": args.threshold,
+           "detect_steps": args.detect_steps, "slow_host": slow_host,
+           "mode": "synthetic" if args.synthetic else "model",
+           "ok": False}
+    agg = install_aggregator(args.fleet_dir, threshold=args.threshold,
+                             stale_after_s=30.0,
+                             poll_interval_s=0.05)
+    from . import diag
+    srv = diag.start_diag_server(port=0)
+    procs = [_spawn_fleet_worker(sys.executable, root, args, i,
+                                 args.delay if i == slow_idx else 0.0)
+             for i in range(args.workers)]
+    detected = False
+    detect_steps = None
+    detect_scores = None
+    deadline = time.monotonic() + args.timeout
+    try:
+        while time.monotonic() < deadline:
+            agg.poll()
+            scores = agg.straggler_scores()
+            if len(scores) == args.workers and not detected:
+                slow = scores.get(slow_host, 0.0)
+                others = [v for h, v in scores.items() if h != slow_host]
+                if slow > args.threshold \
+                        and all(v <= args.threshold for v in others):
+                    detected = True
+                    detect_scores = {h: round(v, 3)
+                                     for h, v in scores.items()}
+                    detect_steps = max(
+                        (w.steps for w in agg.workers()
+                         if w.host == slow_host), default=None)
+            if all(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.05)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait()
+        rec["worker_rcs"] = [p.returncode for p in procs]
+        agg.poll()
+        # the acceptance surface is the COORDINATOR's HTTP endpoints
+        fleetz = _http_get(srv.url + "/fleetz").decode("utf-8")
+        rec["fleetz_lists_all_hosts"] = all(
+            f"host{i}" in fleetz for i in range(args.workers))
+        rec["detected"] = detected
+        rec["steps_at_detection"] = detect_steps
+        rec["scores_at_detection"] = detect_scores
+        rec["final_scores"] = {h: round(v, 3) for h, v
+                               in agg.straggler_scores().items()}
+        trace_bytes = _http_get(srv.url + "/fleetz/trace")
+        trace = json.loads(trace_bytes)
+        events = trace.get("traceEvents", [])
+        tracks = {e["pid"] for e in events
+                  if e.get("ph") == "M"
+                  and e.get("name") == "process_name"}
+        slow_pids = {e["pid"] for e in events
+                     if e.get("ph") == "M"
+                     and e.get("name") == "process_name"
+                     and slow_host in str(e.get("args", {}).get("name"))}
+        gap_us = max((e.get("dur", 0.0) for e in events
+                      if e.get("ph") == "X" and e.get("cat") == "comm"
+                      and e.get("pid") in slow_pids), default=0.0)
+        schema_ok = (isinstance(events, list) and events
+                     and all(isinstance(e.get("name"), str)
+                             and "ph" in e and "pid" in e
+                             for e in events)
+                     and all("ts" in e and "dur" in e and "tid" in e
+                             for e in events if e.get("ph") == "X"))
+        rec["trace_schema_ok"] = bool(schema_ok)
+        rec["trace_tracks"] = len(tracks)
+        rec["trace_events"] = len(events)
+        rec["slow_gap_ms"] = round(gap_us / 1000.0, 3)
+        out_trace = os.path.abspath(args.trace_out) \
+            if args.trace_out else None
+        if out_trace:
+            with open(out_trace, "wb") as f:
+                f.write(trace_bytes)  # the body already fetched above
+            rec["trace_path"] = out_trace
+        rec["ok"] = bool(
+            all(rc == 0 for rc in rec["worker_rcs"])
+            and detected
+            and (detect_steps is not None
+                 and detect_steps <= args.detect_steps)
+            and rec["fleetz_lists_all_hosts"]
+            and schema_ok
+            and len(tracks) == args.workers
+            and gap_us >= args.delay * 1e6 * 0.8)
+    finally:
+        diag.stop_diag_server()
+        uninstall()
+        shutil.rmtree(work, ignore_errors=True)
+    out = os.path.abspath(args.out)
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(json.dumps(rec, indent=1))
+    return 0 if rec["ok"] else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m singa_tpu.fleet",
+        description="fleet observability harness (worker + straggler A/B)")
+    p.add_argument("--worker", action="store_true",
+                   help="run one shard-publishing training leg")
+    p.add_argument("--ab", action="store_true",
+                   help="run the multi-process straggler A/B")
+    p.add_argument("--fleet-dir", default=None)
+    p.add_argument("--workers", type=int, default=3)
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--step-sleep", type=float, default=0.03)
+    p.add_argument("--publish-interval", type=float, default=0.1)
+    p.add_argument("--mesh-devices", type=int, default=2)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--host", default=None)
+    p.add_argument("--synthetic", action="store_true",
+                   help="no model: span + eager-collective loop only")
+    p.add_argument("--delay-collectives", type=float, default=0.0,
+                   help="FaultPlan delay injected at comm.collective")
+    p.add_argument("--delay", type=float, default=0.05,
+                   help="A/B: collective delay on the slow worker")
+    p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--detect-steps", type=int, default=5)
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--trace-out", default=None)
+    p.add_argument("--out", default="FLEET_r01.json")
+    args = p.parse_args(argv)
+    if args.worker:
+        if not args.fleet_dir:
+            p.error("--worker requires --fleet-dir")
+        return _worker_main(args)
+    if args.ab:
+        return _ab_main(args)
+    p.error("pass --worker or --ab")
+    return 2
+
+
+__all__ = [
+    "ShardWriter", "FleetAggregator", "FleetStragglerError",
+    "read_shard", "merge_metric_snapshots",
+    "start_shard_writer", "stop_shard_writer", "get_shard_writer",
+    "install_aggregator", "uninstall_aggregator", "get_aggregator",
+    "uninstall", "export_trace", "check_straggler_halt", "fleet_report",
+    "SHARD_VERSION", "SHARD_SUFFIX", "STEP_SPAN_LEAF",
+]
+
+if __name__ == "__main__":
+    import sys
+    # run under the CANONICAL module, not this __main__ alias: the CLI
+    # installs module singletons (the aggregator, the shard writer) that
+    # the diag server's handlers reach via `import singa_tpu.fleet` —
+    # under runpy those are two different module objects otherwise
+    from singa_tpu.fleet import main as _main
+    sys.exit(_main())

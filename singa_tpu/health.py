@@ -61,6 +61,7 @@ KIND_NONFINITE_GRAD = "nonfinite_grad"
 KIND_NONFINITE_LOSS = "nonfinite_loss"
 KIND_LOSS_SPIKE = "loss_spike"
 KIND_GRAD_NORM = "grad_norm_limit"
+KIND_STRAGGLER = "straggler"  # fleet sustained-straggler verdict
 
 
 class HealthError(RuntimeError):
@@ -465,6 +466,41 @@ class HealthMonitor:
             "last_step": last,
             "last_bundle": self.recorder.last_bundle,
         }
+
+    def note_external(self, kind: str, detail=None, step=None,
+                      action=None) -> str:
+        """An out-of-band anomaly from OUTSIDE the step path — the fleet
+        aggregator's sustained-straggler verdict (KIND_STRAGGLER) is the
+        first producer. Counted, ring-recorded and policy-mapped like a
+        step anomaly, but it never raises here: the producer usually
+        runs off the training thread, where a raise would vanish. Under
+        the halt policy this sets the monitor's status to "halt" (so
+        /healthz flips to 503) and the TRAINING-LOOP side hook —
+        `fleet.check_straggler_halt`, called by TrainController every
+        step — does the raising. Returns the mapped action
+        ("warn" | "halt"); skip_step has no meaning for an anomaly that
+        is not a pending update, so it maps to warn. `action` overrides
+        the policy mapping when the PRODUCER already resolved one — the
+        fleet aggregator's own policy may differ from the monitor's,
+        and the two surfaces must not disagree about whether a halt
+        happened."""
+        if action is not None and action not in ("warn", "halt"):
+            raise ValueError(f"action {action!r} not in ('warn','halt')")
+        m = self._metrics()
+        m["anomaly"].inc(kind=kind)
+        rec = {"external": kind, "detail": detail,
+               "step": int(step) if step is not None else None,
+               "anomaly_kinds": [kind]}
+        self.recorder.record(rec)
+        if action is None:
+            action = "halt" if self.policy == "halt" else "warn"
+        if action == "halt":
+            m["halt"].inc()
+        self.last_action = action
+        observe.get_registry().emit(
+            {"kind": "health", "external": kind, "detail": detail,
+             "policy": self.policy, "action": action})
+        return action
 
     def _spike_score(self, loss: float) -> float:
         import math

@@ -218,10 +218,15 @@ class EventLog:
     """
 
     def __init__(self, path: str, max_bytes: int = 10_000_000,
-                 backups: int = 3):
+                 backups: int = 3, fsync: bool = False):
         self.path = str(path)
         self.max_bytes = int(max_bytes)
         self.backups = int(backups)
+        # fsync=True makes every write durable against POWER LOSS, not
+        # just process death (write() already flush()es to the kernel,
+        # which survives a SIGKILL'd worker) — the kill-resume path's
+        # post-mortem log must not end before its last logged step
+        self.fsync = bool(fsync)
         self._lock = threading.Lock()
         self._fh = None
 
@@ -259,6 +264,19 @@ class EventLog:
                 fh = self._open()
             fh.write(line)
             fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+
+    def flush(self, fsync: "bool | None" = None):
+        """Push buffered lines to the OS (and with `fsync` — defaulting
+        to the log's own mode — to stable storage). write() already
+        flushes per line, so this exists for callers that need an
+        explicit durability point (a worker about to be killed)."""
+        with self._lock:
+            if self._fh is not None and not self._fh.closed:
+                self._fh.flush()
+                if self.fsync if fsync is None else fsync:
+                    os.fsync(self._fh.fileno())
 
     def close(self):
         with self._lock:
@@ -482,6 +500,47 @@ def dump(path: str | None = None) -> dict:
 
 SPAN_TRACE_PREFIX = "singa.span/"
 
+# Span-record ring: bounded deque of finished span/collective regions as
+# {"name", "t0" (perf_counter at enter), "dur", "tid", "kind"} dicts —
+# the raw material singa_tpu.fleet serializes into per-worker telemetry
+# shards and the merged Perfetto trace. Off (None) by default: the ring
+# costs one dict per span exit, which only a fleet shard writer needs.
+_span_records: "deque | None" = None
+
+
+def enable_span_records(capacity: int = 4096) -> None:
+    """Start buffering finished spans (and collective host stamps) into
+    a bounded in-memory ring of `capacity` records. Idempotent; a second
+    call resizes the ring, keeping the newest records."""
+    global _span_records
+    old = _span_records
+    ring = deque(old or (), maxlen=int(capacity))
+    _span_records = ring
+
+
+def disable_span_records() -> None:
+    """Drop the ring and stop buffering (fleet teardown)."""
+    global _span_records
+    _span_records = None
+
+
+def span_records_enabled() -> bool:
+    return _span_records is not None
+
+
+def span_records() -> list:
+    """A snapshot (copy) of the current ring, oldest first."""
+    ring = _span_records
+    return list(ring) if ring is not None else []
+
+
+def _record_span_entry(name, t0, dur, kind="span"):
+    ring = _span_records
+    if ring is not None:
+        ring.append({"name": name, "t0": round(float(t0), 7),
+                     "dur": round(float(dur), 7),
+                     "tid": threading.get_ident(), "kind": kind})
+
 
 def current_span() -> "str | None":
     stack = getattr(_tls, "span_stack", None)
@@ -579,6 +638,7 @@ class span:
                 "singa_span_seconds",
                 "wall seconds per span() region (label: slash-joined "
                 "span path)").observe(dt, span=self.path)
+        _record_span_entry(self.path, self._t0, dt)
         for cb, _enter_cb in tuple(_span_listeners):
             try:
                 cb(self.path, dt, self.attrs)
@@ -714,6 +774,23 @@ def record_comm(op: str, nbytes: int, world_size: int = 1):
                 ).inc(float(nbytes), op=op)
 
 
+def record_comm_host(op: str, start: float, seconds: float):
+    """Host-side entry/exit stamp of one collective CALL SITE
+    (parallel.communicator wraps every collective body in one). Under
+    jit this fires at trace time and measures trace cost; on the eager
+    path (and in the fleet harness's per-step host collective) it is
+    real per-call wall time — the per-host timing the fleet straggler
+    detector scores. Also lands in the span-record ring (kind "comm")
+    when one is enabled, so collectives appear on the merged trace."""
+    if not _enabled:
+        return
+    histogram("singa_comm_host_seconds",
+              "host wall seconds per collective call site (trace cost "
+              "under jit, per-call on the eager path)"
+              ).observe(seconds, op=op)
+    _record_span_entry(f"comm.{op}", start, seconds, kind="comm")
+
+
 def record_decode(kind: str, seconds: float, new_tokens: int, batch: int,
                   ttft: float | None = None, prompt_tokens: int = 0):
     """One serving decode call (end-to-end, fenced)."""
@@ -814,8 +891,11 @@ __all__ = [
     "to_prometheus_text", "dump", "DEFAULT_BUCKETS", "SPAN_TRACE_PREFIX",
     "set_step_callback", "add_span_listener", "remove_span_listener",
     "start_diag_server",
+    "enable_span_records", "disable_span_records", "span_records",
+    "span_records_enabled",
     "record_step", "record_step_build", "record_step_fenced",
     "record_compile", "record_hbm", "record_opt_update", "record_comm",
+    "record_comm_host",
     "record_decode", "record_bench", "record_checkpoint_bytes",
     "record_prefetch", "record_ckpt_async",
 ]

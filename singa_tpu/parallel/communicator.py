@@ -16,6 +16,9 @@ lets the reference's `test_dist.py` pattern pass without a cluster.
 
 from __future__ import annotations
 
+import time
+from contextlib import contextmanager
+
 import numpy as np
 
 import jax
@@ -24,6 +27,29 @@ from jax import lax
 
 from .. import observe
 from .mesh import data_parallel_mesh
+
+
+@contextmanager
+def _comm_stamp(op: str):
+    """Per-host entry/exit stamp around one collective call site, the
+    raw signal behind the fleet straggler detector: the wall interval
+    lands in `singa_comm_host_seconds{op=...}` and (when a fleet shard
+    writer enabled the ring) the span-record buffer, so each process's
+    collective timing is visible in its telemetry shard and on the
+    merged trace. Under jit this measures the TRACE of the collective
+    (fires once per compile); on the eager path — including the fleet
+    harness's per-step host-side collective — it is real per-call time.
+    Also the `fault_point("comm.collective", op=...)` hook: a FaultPlan
+    delay here simulates one slow host's collectives deterministically
+    (tests + the fleet A/B), inside the stamped interval so the injected
+    gap is visible in the very telemetry that must detect it."""
+    from .. import resilience
+    t0 = time.perf_counter()
+    resilience.fault_point("comm.collective", op=op)
+    try:
+        yield
+    finally:
+        observe.record_comm_host(op, t0, time.perf_counter() - t0)
 
 
 def _payload_bytes(x) -> int:
@@ -76,10 +102,11 @@ class Communicator:
         XLA's all-reduce combiner; no manual buffer packing needed."""
         observe.record_comm("all_reduce", _payload_bytes(x),
                             self.world_size)
-        if self.world_size == 1:
-            return x
-        with jax.named_scope("singa_comm_all_reduce"):
-            return lax.psum(x, self.axis)
+        with _comm_stamp("all_reduce"):
+            if self.world_size == 1:
+                return x
+            with jax.named_scope("singa_comm_all_reduce"):
+                return lax.psum(x, self.axis)
 
     # -- synchHalf (communicator.cc:330-467) -------------------------------
     def all_reduce_half(self, x):
@@ -91,19 +118,21 @@ class Communicator:
         except Exception:
             n_el = 0
         observe.record_comm("all_reduce_half", 2 * n_el, self.world_size)
-        if self.world_size == 1:
-            return x
-        with jax.named_scope("singa_comm_all_reduce_half"):
-            return lax.psum(x.astype(jnp.bfloat16), self.axis) \
-                .astype(x.dtype)
+        with _comm_stamp("all_reduce_half"):
+            if self.world_size == 1:
+                return x
+            with jax.named_scope("singa_comm_all_reduce_half"):
+                return lax.psum(x.astype(jnp.bfloat16), self.axis) \
+                    .astype(x.dtype)
 
     def all_gather(self, x, tiled=True):
         observe.record_comm("all_gather", _payload_bytes(x),
                             self.world_size)
-        if self.world_size == 1:
-            return x
-        with jax.named_scope("singa_comm_all_gather"):
-            return lax.all_gather(x, self.axis, axis=0, tiled=tiled)
+        with _comm_stamp("all_gather"):
+            if self.world_size == 1:
+                return x
+            with jax.named_scope("singa_comm_all_gather"):
+                return lax.all_gather(x, self.axis, axis=0, tiled=tiled)
 
     def broadcast(self, x, root=0):
         """Tree broadcast via ppermute (binomial doubling): ceil(log2 n)
@@ -112,33 +141,35 @@ class Communicator:
         root's value is consumed; every other device's x is ignored."""
         observe.record_comm("broadcast", _payload_bytes(x),
                             self.world_size)
-        if self.world_size == 1:
-            return x
-        assert not isinstance(self.axis, tuple), \
-            "broadcast over a tuple axis is ambiguous; pick one axis"
-        n = self.world_size
-        rel = (self.rank() - root) % n        # root-relative index
-        val = x
-        k = 1
-        with jax.named_scope("singa_comm_broadcast"):
-            while k < n:
-                # relative devices [0, k) send to [k, 2k)
-                pairs = [((i + root) % n, (i + k + root) % n)
-                         for i in range(min(k, n - k))]
-                recv = lax.ppermute(val, self.axis, pairs)
-                adopt = (rel >= k) & (rel < 2 * k)
-                val = jnp.where(adopt, recv, val)
-                k *= 2
-        return val
+        with _comm_stamp("broadcast"):
+            if self.world_size == 1:
+                return x
+            assert not isinstance(self.axis, tuple), \
+                "broadcast over a tuple axis is ambiguous; pick one axis"
+            n = self.world_size
+            rel = (self.rank() - root) % n        # root-relative index
+            val = x
+            k = 1
+            with jax.named_scope("singa_comm_broadcast"):
+                while k < n:
+                    # relative devices [0, k) send to [k, 2k)
+                    pairs = [((i + root) % n, (i + k + root) % n)
+                             for i in range(min(k, n - k))]
+                    recv = lax.ppermute(val, self.axis, pairs)
+                    adopt = (rel >= k) & (rel < 2 * k)
+                    val = jnp.where(adopt, recv, val)
+                    k *= 2
+            return val
 
     def reduce_scatter(self, x):
         observe.record_comm("reduce_scatter", _payload_bytes(x),
                             self.world_size)
-        if self.world_size == 1:
-            return x
-        with jax.named_scope("singa_comm_reduce_scatter"):
-            return lax.psum_scatter(x, self.axis, scatter_dimension=0,
-                                    tiled=True)
+        with _comm_stamp("reduce_scatter"):
+            if self.world_size == 1:
+                return x
+            with jax.named_scope("singa_comm_reduce_scatter"):
+                return lax.psum_scatter(x, self.axis, scatter_dimension=0,
+                                        tiled=True)
 
     def all_reduce_max(self, x):
         """Max over the axis. Used by the health layer for non-finite
@@ -149,10 +180,11 @@ class Communicator:
         agreed on every shard either way."""
         observe.record_comm("all_reduce_max", _payload_bytes(x),
                             self.world_size)
-        if self.world_size == 1:
-            return x
-        with jax.named_scope("singa_comm_all_reduce_max"):
-            return lax.pmax(x, self.axis)
+        with _comm_stamp("all_reduce_max"):
+            if self.world_size == 1:
+                return x
+            with jax.named_scope("singa_comm_all_reduce_max"):
+                return lax.pmax(x, self.axis)
 
     def agree_any(self, flag):
         """Cross-host anomaly agreement: boolean OR over the axis group,
@@ -161,11 +193,12 @@ class Communicator:
         all hosts in the same step — no shard ever commits an update the
         others discarded. 4 bytes on the wire; identity at world_size 1."""
         observe.record_comm("agree_any", 4, self.world_size)
-        f = jnp.asarray(flag).astype(jnp.int32)
-        if self.world_size == 1:
-            return f > 0
-        with jax.named_scope("singa_comm_agree_any"):
-            return lax.psum(f, self.axis) > 0
+        with _comm_stamp("agree_any"):
+            f = jnp.asarray(flag).astype(jnp.int32)
+            if self.world_size == 1:
+                return f > 0
+            with jax.named_scope("singa_comm_agree_any"):
+                return lax.psum(f, self.axis) > 0
 
     def wait(self):
         """Stream fence (communicator.cc:169-186): nothing to do — XLA's
@@ -188,17 +221,18 @@ class Communicator:
         observe.record_comm(
             "sparse_all_reduce_topk",
             k * (4 + np.dtype(x.dtype).itemsize), self.world_size)
-        _, idx = lax.top_k(jnp.abs(flat), k)
-        vals = jnp.take(flat, idx)
-        residual = flat.at[idx].set(0.0).reshape(x.shape)
-        if self.world_size == 1:
-            out = jnp.zeros_like(flat).at[idx].add(vals)
+        with _comm_stamp("sparse_all_reduce_topk"):
+            _, idx = lax.top_k(jnp.abs(flat), k)
+            vals = jnp.take(flat, idx)
+            residual = flat.at[idx].set(0.0).reshape(x.shape)
+            if self.world_size == 1:
+                out = jnp.zeros_like(flat).at[idx].add(vals)
+                return out.reshape(x.shape), residual
+            with jax.named_scope("singa_comm_sparse_all_reduce_topk"):
+                gidx = lax.all_gather(idx, self.axis)    # (world, k)
+                gvals = lax.all_gather(vals, self.axis)  # (world, k)
+            out = jnp.zeros_like(flat).at[gidx.ravel()].add(gvals.ravel())
             return out.reshape(x.shape), residual
-        with jax.named_scope("singa_comm_sparse_all_reduce_topk"):
-            gidx = lax.all_gather(idx, self.axis)    # (world, k)
-            gvals = lax.all_gather(vals, self.axis)  # (world, k)
-        out = jnp.zeros_like(flat).at[gidx.ravel()].add(gvals.ravel())
-        return out.reshape(x.shape), residual
 
     def sparse_all_reduce_threshold(self, x, threshold: float,
                                     capacity_frac: float = 0.1):
@@ -222,19 +256,20 @@ class Communicator:
         observe.record_comm(
             "sparse_all_reduce_threshold",
             cap * (4 + np.dtype(x.dtype).itemsize), self.world_size)
-        absx = jnp.abs(flat)
-        score = jnp.where(absx >= threshold, absx, -jnp.inf)
-        _, idx = lax.top_k(score, cap)
-        taken = jnp.take(score, idx) > -jnp.inf   # really above threshold
-        vals = jnp.where(taken, jnp.take(flat, idx), 0.0)
-        idx_safe = jnp.where(taken, idx, 0)       # 0-adds land on index 0
-        sent = jnp.zeros_like(flat).at[idx_safe].add(vals)
-        residual = (flat - sent).reshape(x.shape)
-        if self.world_size == 1:
-            return sent.reshape(x.shape), residual
-        # wire payload: 2 * cap elements per rank (idx + val), NOT n
-        with jax.named_scope("singa_comm_sparse_all_reduce_threshold"):
-            gidx = lax.all_gather(idx_safe, self.axis)   # (world, cap)
-            gvals = lax.all_gather(vals, self.axis)      # (world, cap)
-        out = jnp.zeros_like(flat).at[gidx.ravel()].add(gvals.ravel())
-        return out.reshape(x.shape), residual
+        with _comm_stamp("sparse_all_reduce_threshold"):
+            absx = jnp.abs(flat)
+            score = jnp.where(absx >= threshold, absx, -jnp.inf)
+            _, idx = lax.top_k(score, cap)
+            taken = jnp.take(score, idx) > -jnp.inf  # really above threshold
+            vals = jnp.where(taken, jnp.take(flat, idx), 0.0)
+            idx_safe = jnp.where(taken, idx, 0)      # 0-adds land on index 0
+            sent = jnp.zeros_like(flat).at[idx_safe].add(vals)
+            residual = (flat - sent).reshape(x.shape)
+            if self.world_size == 1:
+                return sent.reshape(x.shape), residual
+            # wire payload: 2 * cap elements per rank (idx + val), NOT n
+            with jax.named_scope("singa_comm_sparse_all_reduce_threshold"):
+                gidx = lax.all_gather(idx_safe, self.axis)   # (world, cap)
+                gvals = lax.all_gather(vals, self.axis)      # (world, cap)
+            out = jnp.zeros_like(flat).at[gidx.ravel()].add(gvals.ravel())
+            return out.reshape(x.shape), residual

@@ -816,6 +816,13 @@ class TrainController:
                 fault_point("step", step=self._step)
                 if self._preempt is not None:  # a signal-injecting fault
                     return self._preempt_exit()
+                # fleet hook: a sustained-straggler verdict under the
+                # halt policy raises FleetStragglerError (a HealthError)
+                # HERE, on the training thread, so the halt path below
+                # saves a final checkpoint and the report names the
+                # host(s) an elastic restart should exclude
+                from . import fleet
+                fleet.check_straggler_halt(step=self._step)
                 with observe.span("data.wait"):
                     batch = next(it, _end)
                 if batch is _end:
@@ -884,6 +891,11 @@ class TrainController:
                         self._emit("halt_save_failed",
                                    error=str(save_err))
                     e.resilience = self._report()
+                    hosts = getattr(e, "hosts", None)
+                    if hosts:
+                        # a fleet straggler halt: tell the relauncher
+                        # which host(s) to exclude from the next mesh
+                        e.resilience["exclude_hosts"] = list(hosts)
                     raise
                 except (KeyboardInterrupt, SystemExit):
                     raise

@@ -52,9 +52,10 @@ def _metrics_isolation():
     HTTP ports, server threads, or span listeners — and (ISSUE-5)
     asserts the test left no async checkpoint pending, no prefetcher
     thread alive, and no stray non-daemon thread behind."""
-    from singa_tpu import diag, goodput, health, introspect, observe
+    from singa_tpu import diag, fleet, goodput, health, introspect, observe
     diag.stop_diag_server()
     goodput.uninstall()
+    fleet.uninstall()
     health.set_active_monitor(None)
     observe.get_registry().reset()
     observe.set_event_log(None)
@@ -63,6 +64,19 @@ def _metrics_isolation():
     yield
     diag.stop_diag_server()
     goodput.uninstall()
+    # fleet teardown (ISSUE-7): every shard-writer thread joined, the
+    # aggregator dropped, the span-record ring disabled, and any spool
+    # temp dir the fleet module created removed. Like the async-ckpt
+    # check below, the leak is CAPTURED first and cleaned regardless,
+    # so one leaky test fails itself without cascading into the suite.
+    leaked_fleet = [t.name for t in threading.enumerate()
+                    if t.is_alive()
+                    and t.name.startswith("singa-fleet")]
+    fleet.uninstall()
+    assert not leaked_fleet, (
+        f"fleet shard-writer thread(s) left running: {leaked_fleet} — "
+        "close() the ShardWriter / stop_shard_writer() before the test "
+        "ends")
     from singa_tpu import overlap
     pending = overlap.pending_checkpoints()
     # drain regardless so ONE leaky test doesn't cascade into the rest

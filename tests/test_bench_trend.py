@@ -1,0 +1,115 @@
+"""tools/bench_trend.py (ISSUE-7 satellite): the BENCH_*/BENCHDEC_*/
+MULTICHIP_* round artifacts finally have a reader — aggregated into a
+metric x round trend table, with regressions beyond a threshold vs the
+best prior round flagged and turned into a non-zero exit. Driven by
+checked-in fixture records so the tier-1 pass exercises exactly the
+formats the repo's real artifacts use."""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import bench_trend  # noqa: E402
+
+_FIX = os.path.join(_ROOT, "tests", "fixtures", "bench_trend")
+CLEAN = os.path.join(_FIX, "clean")
+REGRESS = os.path.join(_FIX, "regress")
+
+
+def test_collect_tolerates_every_artifact_format():
+    rounds = bench_trend.collect([CLEAN])
+    # single record, JSONL, wrapper {rc, parsed}, harness {ok} formats
+    assert ("TOY", 1) in rounds and ("TOY", 2) in rounds
+    assert ("WRAP", 1) in rounds and ("HARN", 1) in rounds
+    by_metric = bench_trend.trend_table(rounds)
+    assert by_metric["toy_train_tok_s"]["by_round"] == {
+        1: 100.0, 2: 104.0, 3: 101.0}
+    assert by_metric["toy_step_ms"]["by_round"] == {2: 10.0, 3: 10.2}
+    # wrapper with parsed=null degrades to a run_ok 0/1 metric
+    assert by_metric["wrap_run_ok"]["by_round"] == {1: 1.0}
+    assert by_metric["harn_ok"]["by_round"] == {1: 1.0, 2: 1.0}
+
+
+def test_wrapper_with_non_record_parsed_keeps_rc_fallback(tmp_path):
+    """Review fix: a wrapper whose `parsed` dict is NOT a metric record
+    must still degrade to the rc-based <family>_run_ok metric instead
+    of vanishing from the trend."""
+    p = tmp_path / "WRAP_r01.json"
+    p.write_text('{"n":1,"cmd":"x","rc":0,"tail":"",'
+                 '"parsed":{"tail":"not a record"}}')
+    recs = bench_trend.parse_records(str(p), "WRAP")
+    assert recs == [{"metric": "wrap_run_ok", "value": 1.0,
+                     "unit": "bool"}]
+    # and a parsed dict that IS a record still wins over the rc
+    p2 = tmp_path / "WRAP_r02.json"
+    p2.write_text('{"n":2,"cmd":"x","rc":1,"tail":"",'
+                  '"parsed":{"metric":"m","value":7.0,"unit":"x/s"}}')
+    recs = bench_trend.parse_records(str(p2), "WRAP")
+    assert recs == [{"metric": "m", "value": 7.0, "unit": "x/s"}]
+
+
+def test_direction_inference():
+    assert bench_trend.lower_is_better("toy_step_ms", "ms")
+    assert bench_trend.lower_is_better("resume_restore_s", "")
+    assert not bench_trend.lower_is_better("toy_train_tok_s", "tokens/s")
+    assert not bench_trend.lower_is_better("goodput_ratio", "")
+
+
+def test_clean_fixtures_have_no_regressions():
+    table = bench_trend.trend_table(bench_trend.collect([CLEAN]))
+    assert bench_trend.find_regressions(table, threshold=0.05) == []
+
+
+def test_regressions_flagged_against_best_prior_round():
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = bench_trend.find_regressions(table, threshold=0.05)
+    by_metric = {m: (rnd, v, best_r, best, delta)
+                 for m, rnd, v, best_r, best, delta in regs}
+    # throughput: r03=90 vs BEST prior r02=110 (not r01=100) -> ~18%
+    rnd, v, best_r, best, delta = by_metric["toy_train_tok_s"]
+    assert (rnd, v, best_r, best) == (3, 90.0, 2, 110.0)
+    assert abs(delta - 20.0 / 110.0) < 1e-9
+    # latency regresses UP: r03=13ms vs best prior 10ms -> 30%
+    rnd, v, best_r, best, delta = by_metric["toy_step_ms"]
+    assert (rnd, v, best) == (3, 13.0, 10.0) and delta > 0.25
+    # a harness flipping ok->not-ok is a regression too
+    assert "harn_ok" in by_metric
+    # a looser threshold forgives the throughput slide but not the
+    # ok-flag collapse
+    loose = bench_trend.find_regressions(table, threshold=0.5)
+    assert {m for m, *_ in loose} == {"harn_ok"}
+
+
+def test_cli_exit_codes(capsys):
+    assert bench_trend.main([CLEAN]) == 0
+    out = capsys.readouterr()
+    assert "toy_train_tok_s" in out.out and "no regressions" in out.out
+    assert bench_trend.main([REGRESS]) == 1
+    out = capsys.readouterr()
+    assert "REGRESSION" in out.err
+    assert "toy_train_tok_s" in out.err
+
+
+def test_latest_only_mode():
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = bench_trend.find_regressions(table, threshold=0.05,
+                                        latest_only=True)
+    # same verdicts here (the regressions ARE in the latest rounds),
+    # but each metric is judged at most once
+    metrics = [m for m, *_ in regs]
+    assert len(metrics) == len(set(metrics))
+    assert "toy_train_tok_s" in metrics
+
+
+def test_smoke_on_repo_artifacts():
+    """The tool parses every real committed round artifact without
+    raising (exit code not pinned: future rounds may legitimately
+    regress and that is the tool's job to report)."""
+    rounds = bench_trend.collect([bench_trend.ROOT])
+    assert rounds  # BENCH_r01..: the repo always carries artifacts
+    table = bench_trend.trend_table(rounds)
+    assert "multichip_ok" in table
+    assert bench_trend.format_table(table)
+    bench_trend.find_regressions(table)

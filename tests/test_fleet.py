@@ -1,0 +1,540 @@
+"""Fleet observability layer (ISSUE-7): shard writer round-trip, the
+aggregator's merge/staleness/straggler verdicts, the merged Perfetto
+trace, the /fleetz endpoints, and the multi-process straggler A/B —
+the fault-injected slow worker must be detected within K steps and
+attributed to the correct host."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import jax.numpy as jnp  # noqa: E402
+
+from singa_tpu import (diag, fleet, health, observe,  # noqa: E402
+                       resilience)
+from singa_tpu.parallel.communicator import Communicator  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fleet_hygiene():
+    yield
+    resilience.clear_fault_plan()
+    fleet.uninstall()
+
+
+def _write_fake_shard(fleet_dir, host, pid, seq=1, ts=None, perf=0.0,
+                      spans=(), steps=0, metrics=None, goodput=None,
+                      name=None):
+    """Hand-build one shard file in the documented format — the unit
+    tests' stand-in for another process's ShardWriter (the writer end
+    is covered by the round-trip test and the subprocess A/B)."""
+    os.makedirs(fleet_dir, exist_ok=True)
+    header = {"kind": "fleet_shard_header", "version": 1, "seq": seq,
+              "host": host, "pid": pid,
+              "ts": time.time() if ts is None else ts, "perf": perf,
+              "started_ts": 0.0, "steps": steps}
+    lines = [header,
+             {"kind": "fleet_metrics", "metrics": metrics or {}},
+             {"kind": "fleet_goodput", "goodput": goodput},
+             {"kind": "fleet_health", "verdict": None}]
+    for nm, t0, dur, tid, kind in spans:
+        lines.append({"kind": "fleet_span", "name": nm, "t0": t0,
+                      "dur": dur, "tid": tid, "span_kind": kind})
+    path = os.path.join(fleet_dir, (name or f"worker_{pid}")
+                        + fleet.SHARD_SUFFIX)
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def _step_spans(dur, n=6, t0=100.0):
+    return [("model.step", t0 + i, dur, 1, "span") for i in range(n)]
+
+
+# ---- shard writer ----------------------------------------------------------
+
+def test_shard_writer_publish_roundtrip(tmp_path):
+    w = fleet.ShardWriter(str(tmp_path), interval_s=0, host="hostA",
+                          name="worker_a")
+    comm = Communicator()
+    for _ in range(3):
+        with observe.span("model.step"):
+            comm.all_reduce(jnp.ones(()))
+        observe.record_step(0.001)
+    seq1 = w.publish()
+    shard = fleet.read_shard(w.path)
+    assert shard is not None
+    h = shard["header"]
+    assert h["seq"] == seq1 == 1 and h["host"] == "hostA"
+    assert h["steps"] == 3
+    # the clock handshake: paired epoch + monotonic samples
+    assert h["ts"] > 0 and h["perf"] > 0
+    kinds = {s["span_kind"] for s in shard["spans"]}
+    assert kinds == {"span", "comm"}
+    step_spans = [s for s in shard["spans"]
+                  if s["name"].rsplit("/", 1)[-1] == "model.step"]
+    assert len(step_spans) == 3
+    assert "singa_steps_total" in shard["metrics"]
+    # monotonic sequence + atomicity: a publish replaces, never appends
+    assert w.publish() == 2
+    assert fleet.read_shard(w.path)["header"]["seq"] == 2
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    w.close(final_publish=False)
+
+
+def test_shard_writer_thread_publishes_and_uninstall_joins(tmp_path):
+    w = fleet.start_shard_writer(str(tmp_path), interval_s=0.02,
+                                 host="hostA")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        shard = fleet.read_shard(w.path)
+        if shard is not None and shard["header"]["seq"] >= 2:
+            break
+        time.sleep(0.01)
+    assert fleet.read_shard(w.path)["header"]["seq"] >= 2
+    assert any(t.name.startswith("singa-fleet-shard")
+               for t in threading.enumerate())
+    fleet.uninstall()
+    assert not any(t.name.startswith("singa-fleet-shard")
+                   for t in threading.enumerate() if t.is_alive())
+    assert fleet.get_shard_writer() is None
+    assert not observe.span_records_enabled()
+
+
+def test_owned_temp_spool_dir_removed_on_uninstall():
+    w = fleet.ShardWriter(None, interval_s=0)  # module-owned temp dir
+    d = w.fleet_dir
+    assert os.path.isdir(d)
+    w.publish()
+    fleet.uninstall()
+    assert not os.path.exists(d)
+
+
+# ---- merging ---------------------------------------------------------------
+
+def test_merge_metric_snapshots_counters_histograms_gauges():
+    def snap(ctr, gval, hcount, hsum):
+        return {
+            "singa_steps_total": {"type": "counter", "help": "",
+                                  "samples": [{"labels": {},
+                                               "value": ctr}]},
+            "singa_hbm_bytes_in_use": {"type": "gauge", "help": "",
+                                       "samples": [{"labels": {},
+                                                    "value": gval}]},
+            "singa_step_seconds": {"type": "histogram", "help": "",
+                                   "samples": [{"labels": {},
+                                                "count": hcount,
+                                                "sum": hsum,
+                                                "buckets": {"1": hcount,
+                                                            "+Inf":
+                                                                hcount}}]},
+        }
+
+    merged = fleet.merge_metric_snapshots(
+        {"host0": snap(10, 100.0, 4, 0.4),
+         "host1": snap(32, 300.0, 6, 1.2)})
+    ctr = merged["singa_steps_total"]["series"][()]
+    assert ctr["value"] == 42.0
+    g = merged["singa_hbm_bytes_in_use"]["series"][()]
+    assert g["per_host"] == {"host0": 100.0, "host1": 300.0}
+    assert g["min"] == 100.0 and g["max"] == 300.0 and g["mean"] == 200.0
+    h = merged["singa_step_seconds"]["series"][()]
+    assert h["count"] == 10 and abs(h["sum"] - 1.6) < 1e-9
+    assert h["buckets"]["+Inf"] == 10 and h["buckets"]["1"] == 10
+
+
+# ---- straggler detection ---------------------------------------------------
+
+def test_straggler_scored_against_fleet_median_and_attributed(tmp_path):
+    d = str(tmp_path)
+    _write_fake_shard(d, "host0", 100, spans=_step_spans(0.005), steps=6)
+    _write_fake_shard(d, "host1", 101, spans=_step_spans(0.005), steps=6)
+    _write_fake_shard(d, "host2", 102, spans=_step_spans(0.060), steps=6)
+    agg = fleet.FleetAggregator(d, threshold=0.5)
+    agg.poll()
+    scores = agg.straggler_scores()
+    assert set(scores) == {"host0", "host1", "host2"}
+    # the slow host — and ONLY the slow host — scores above threshold
+    assert scores["host2"] > 0.5
+    assert scores["host0"] <= 0.5 and scores["host1"] <= 0.5
+    # exported as singa_fleet_straggler_score{host=...}
+    g = observe.get_registry().get("singa_fleet_straggler_score")
+    assert g is not None and g.value(host="host2") > 0.5
+    assert g.value(host="host0") <= 0.5
+
+
+def test_straggler_scores_on_collective_signal_too(tmp_path):
+    d = str(tmp_path)
+    comm = [("comm.all_reduce", 100.0 + i, 0.001, 1, "comm")
+            for i in range(6)]
+    slow = [("comm.all_reduce", 100.0 + i, 0.055, 1, "comm")
+            for i in range(6)]
+    _write_fake_shard(d, "host0", 100, spans=comm)
+    _write_fake_shard(d, "host1", 101, spans=slow)
+    agg = fleet.FleetAggregator(d, threshold=0.5)
+    agg.poll()
+    scores = agg.straggler_scores()
+    assert scores["host1"] > 0.5 and scores["host0"] <= 0.5
+
+
+def test_sustained_straggler_warn_feeds_health_monitor(tmp_path):
+    d = str(tmp_path)
+    _write_fake_shard(d, "host0", 100, spans=_step_spans(0.005))
+    _write_fake_shard(d, "host1", 101, spans=_step_spans(0.080))
+    mon = health.HealthMonitor(policy="warn", out_dir=str(tmp_path))
+    health.set_active_monitor(mon)
+    agg = fleet.FleetAggregator(d, threshold=0.5, sustain=3)
+    agg.poll()
+    agg.poll()
+    c = observe.get_registry().get("singa_health_anomaly_total")
+    assert c is None or c.value(kind=health.KIND_STRAGGLER) == 0
+    agg.poll()  # third consecutive poll above threshold -> sustained
+    c = observe.get_registry().get("singa_health_anomaly_total")
+    assert c.value(kind=health.KIND_STRAGGLER) == 1
+    assert mon.last_action == "warn"
+    assert agg.halt_verdict() is None  # warn policy: no halt
+    sus = observe.get_registry().get(
+        "singa_fleet_straggler_sustained_total")
+    assert sus.value(host="host1") == 1
+    # the verdict is attributed in the rollup too
+    assert agg.rollup()["stragglers"] == ["host1"]
+
+
+def test_sustained_straggler_halt_raises_from_training_hook(tmp_path):
+    d = str(tmp_path)
+    _write_fake_shard(d, "host0", 100, spans=_step_spans(0.005))
+    _write_fake_shard(d, "host1", 101, spans=_step_spans(0.080))
+    agg = fleet.FleetAggregator(d, threshold=0.5, sustain=1,
+                                policy="halt", poll_interval_s=0.0)
+    fleet.install_aggregator(aggregator=agg)
+    with pytest.raises(fleet.FleetStragglerError) as ei:
+        fleet.check_straggler_halt(step=4)
+    assert ei.value.hosts == ("host1",)
+    assert isinstance(ei.value, health.HealthError)
+    assert "host1" in str(ei.value)
+
+
+def test_restarted_worker_with_reset_seq_is_accepted(tmp_path):
+    """Review fix: a relaunched worker reusing the shard path starts
+    seq over at 1 — the aggregator must reset its state and accept the
+    new incarnation, not ignore it until seq catches up."""
+    d = str(tmp_path)
+    _write_fake_shard(d, "host0", 100, seq=40, steps=40,
+                      spans=_step_spans(0.005))
+    agg = fleet.FleetAggregator(d)
+    agg.poll()
+    assert agg.workers()[0].seq == 40
+    # the restart: same path, seq back to 1, fresh (slow) spans
+    _write_fake_shard(d, "host0", 100, seq=1, steps=2,
+                      spans=_step_spans(0.050))
+    roll = agg.poll()
+    w = agg.workers()[0]
+    assert w.seq == 1 and w.steps == 2
+    assert roll["workers"][0]["steps"] == 2
+
+
+def test_removed_shard_file_prunes_ghost_worker(tmp_path):
+    """Review fix: a shard file deleted from the spool (relaunch
+    cleanup) must drop its worker from tracking instead of inflating
+    counts and staleness forever."""
+    d = str(tmp_path)
+    p0 = _write_fake_shard(d, "host0", 100, spans=_step_spans(0.005))
+    _write_fake_shard(d, "host1", 101, spans=_step_spans(0.005))
+    agg = fleet.FleetAggregator(d)
+    assert agg.poll()["n_workers"] == 2
+    os.remove(p0)
+    roll = agg.poll()
+    assert roll["n_workers"] == 1
+    assert [r["host"] for r in roll["workers"]] == ["host1"]
+
+
+def test_host_collision_freshest_shard_owns_signal(tmp_path):
+    """Review fix: a dead incarnation's lingering shard sharing a host
+    label with its relaunch must not override the live signal — the
+    newest publish wins regardless of scan order."""
+    d = str(tmp_path)
+    now = time.time()
+    # "worker_99" sorts AFTER "worker_100": the stale-slow file is
+    # scanned last but must not own host0's score
+    _write_fake_shard(d, "host0", 100, ts=now,
+                      spans=_step_spans(0.005), name="worker_100")
+    _write_fake_shard(d, "host0", 99, ts=now - 120.0,
+                      spans=_step_spans(0.200), name="worker_99")
+    _write_fake_shard(d, "host1", 101, ts=now,
+                      spans=_step_spans(0.005), name="worker_101")
+    agg = fleet.FleetAggregator(d, threshold=0.5)
+    agg.poll()
+    scores = agg.straggler_scores()
+    assert scores["host0"] <= 0.5, scores  # live (fast) shard won
+
+
+def test_aggregator_policy_overrides_monitor_in_note_external(tmp_path):
+    """Review fix: FleetAggregator(policy="warn") with an active
+    HealthMonitor(policy="halt") — the sustained verdict must NOT flip
+    the monitor (and /healthz) to halt: the resolved action is passed
+    through note_external."""
+    d = str(tmp_path)
+    _write_fake_shard(d, "host0", 100, spans=_step_spans(0.005))
+    _write_fake_shard(d, "host1", 101, spans=_step_spans(0.080))
+    mon = health.HealthMonitor(policy="halt", out_dir=str(tmp_path))
+    health.set_active_monitor(mon)
+    agg = fleet.FleetAggregator(d, threshold=0.5, sustain=1,
+                                policy="warn")
+    agg.poll()
+    assert mon.last_action == "warn"  # not "halt"
+    c = observe.get_registry().get("singa_health_halt_total")
+    assert c is None or c.value() == 0
+    assert agg.halt_verdict() is None
+    # anomaly still counted under its kind
+    a = observe.get_registry().get("singa_health_anomaly_total")
+    assert a.value(kind=health.KIND_STRAGGLER) == 1
+
+
+def test_background_polling_thread_lifecycle(tmp_path):
+    """Review fix: background_poll=True moves the spool rescans off the
+    caller's thread; check_straggler_halt then only reads the sticky
+    verdict, and uninstall joins the thread."""
+    d = str(tmp_path)
+    _write_fake_shard(d, "host0", 100, spans=_step_spans(0.005))
+    _write_fake_shard(d, "host1", 101, spans=_step_spans(0.080))
+    agg = fleet.FleetAggregator(d, threshold=0.5, sustain=1,
+                                policy="halt", poll_interval_s=0.02,
+                                background_poll=True)
+    fleet.install_aggregator(aggregator=agg)
+    assert any(t.name == "singa-fleet-agg" for t in threading.enumerate())
+    deadline = time.monotonic() + 5.0
+    while agg.halt_verdict() is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(fleet.FleetStragglerError):
+        fleet.check_straggler_halt()
+    fleet.uninstall()
+    assert not any(t.name == "singa-fleet-agg"
+                   for t in threading.enumerate() if t.is_alive())
+
+
+def test_staleness_flags_dead_or_wedged_worker(tmp_path):
+    d = str(tmp_path)
+    _write_fake_shard(d, "host0", 100, ts=time.time())
+    _write_fake_shard(d, "host1", 101, ts=time.time() - 60.0)  # wedged
+    agg = fleet.FleetAggregator(d, stale_after_s=5.0)
+    roll = agg.poll()
+    assert roll["n_workers"] == 2 and roll["n_stale"] == 1
+    by_host = {r["host"]: r for r in roll["workers"]}
+    assert by_host["host1"]["stale"] and not by_host["host0"]["stale"]
+    g = observe.get_registry().get("singa_fleet_shard_age_seconds")
+    assert g.value(host="host1") > 5.0
+
+
+# ---- merged trace ----------------------------------------------------------
+
+def test_trace_export_schema_and_clock_alignment(tmp_path):
+    d = str(tmp_path)
+    # two workers observing the SAME wall-clock moment from different
+    # monotonic clock bases: the handshake (ts, perf) must align them
+    wall = 1_700_000_000.0
+    _write_fake_shard(d, "host0", 100, ts=wall, perf=100.0,
+                      spans=[("model.step", 101.0, 0.01, 7, "span")])
+    _write_fake_shard(d, "host1", 101, ts=wall, perf=50.0,
+                      spans=[("model.step", 51.0, 0.01, 8, "span"),
+                             ("comm.all_reduce", 51.002, 0.05, 8,
+                              "comm")])
+    agg = fleet.FleetAggregator(d)
+    agg.poll()
+    out = str(tmp_path / "trace.json")
+    fleet.install_aggregator(aggregator=agg)
+    assert fleet.export_trace(out) == out
+    with open(out, encoding="utf-8") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    names = [e for e in events if e.get("ph") == "M"
+             and e.get("name") == "process_name"]
+    assert len(names) == 2  # one track per worker
+    assert {n["args"]["name"].split(" ")[0] for n in names} \
+        == {"host0", "host1"}
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert all(isinstance(e["name"], str) and "ts" in e and "dur" in e
+               and "pid" in e and "tid" in e for e in xs)
+    # both model.step slices started 1s after the handshake sample on
+    # their OWN clocks -> identical aligned wall timestamps
+    steps = [e for e in xs if e["name"] == "model.step"]
+    assert len(steps) == 2
+    assert abs(steps[0]["ts"] - steps[1]["ts"]) < 1.0  # us
+    assert abs(steps[0]["ts"] - (wall + 1.0) * 1e6) < 1.0
+    comm = [e for e in xs if e["cat"] == "comm"]
+    assert comm and comm[0]["dur"] == pytest.approx(50_000.0)
+
+
+# ---- /fleetz endpoints -----------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def test_fleetz_endpoints(tmp_path):
+    d = str(tmp_path)
+    _write_fake_shard(d, "host0", 100, spans=_step_spans(0.005), steps=9)
+    _write_fake_shard(d, "host1", 101, spans=_step_spans(0.070), steps=4)
+    agg = fleet.FleetAggregator(d, threshold=0.5, sustain=1)
+    agg.poll()
+    fleet.install_aggregator(aggregator=agg)
+    srv = observe.start_diag_server(port=0)
+    try:
+        status, text = _get(srv.url + "/fleetz")
+        assert status == 200
+        assert "host0" in text and "host1" in text
+        assert "STRAGGLER" in text  # host1 sustained after poll #1+#2
+        assert "straggler" in text  # the score column header
+        status, body = _get(srv.url + "/fleetz/trace")
+        assert status == 200
+        trace = json.loads(body)
+        assert len([e for e in trace["traceEvents"]
+                    if e.get("ph") == "M"
+                    and e.get("name") == "process_name"]) == 2
+        # the index page advertises the new endpoints
+        _status, idx = _get(srv.url + "/")
+        assert "/fleetz" in idx and "/fleetz/trace" in idx
+    finally:
+        diag.stop_diag_server()
+
+
+def test_fleetz_without_aggregator_is_503(tmp_path):
+    srv = observe.start_diag_server(port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/fleetz")
+        assert ei.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/fleetz/trace")
+        assert ei.value.code == 503
+    finally:
+        diag.stop_diag_server()
+
+
+# ---- collective stamps + fault hook ----------------------------------------
+
+def test_comm_stamp_records_and_fault_hook():
+    observe.enable_span_records()
+    plan = resilience.FaultPlan()
+    plan.delay("comm.collective", 0.03, times=1)
+    resilience.install_fault_plan(plan)
+    comm = Communicator()  # world_size 1: identity, but stamped
+    t0 = time.perf_counter()
+    comm.all_reduce(jnp.ones(()))
+    assert time.perf_counter() - t0 >= 0.03  # the injected delay landed
+    assert plan.fired and plan.fired[0][0] == "comm.collective"
+    h = observe.get_registry().get("singa_comm_host_seconds")
+    assert h is not None and h.count(op="all_reduce") == 1
+    assert h.sum(op="all_reduce") >= 0.03  # delay INSIDE the stamp
+    recs = [r for r in observe.span_records() if r["kind"] == "comm"]
+    assert recs and recs[-1]["name"] == "comm.all_reduce"
+    assert recs[-1]["dur"] >= 0.03
+
+
+# ---- controller integration ------------------------------------------------
+
+def test_controller_surfaces_straggler_halt_with_exclude_hosts(tmp_path):
+    from singa_tpu import layer, model as model_mod, opt, tensor
+    from singa_tpu.device import get_default_device
+    import numpy as np
+
+    class Net(model_mod.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc = layer.Linear(4)
+            self.sce = layer.SoftMaxCrossEntropy()
+
+        def forward(self, x):
+            return self.fc(x)
+
+        def train_one_batch(self, x, y):
+            loss = self.sce(self.forward(x), y)
+            self.optimizer(loss)
+            return loss
+
+    dev = get_default_device()
+    rng = np.random.RandomState(0)
+    tx = tensor.from_numpy(rng.randn(8, 6).astype(np.float32), dev)
+    ty = tensor.from_numpy(rng.randint(0, 4, 8).astype(np.int32), dev)
+    m = Net()
+    m.set_optimizer(opt.SGD(lr=0.1))
+    m.compile([tx], is_train=True, use_graph=True)
+
+    spool = tmp_path / "spool"
+    _write_fake_shard(str(spool), "host0", 100,
+                      spans=_step_spans(0.005))
+    _write_fake_shard(str(spool), "hostS", 101,
+                      spans=_step_spans(0.080))
+    agg = fleet.FleetAggregator(str(spool), threshold=0.5, sustain=1,
+                                policy="halt", poll_interval_s=0.0)
+    fleet.install_aggregator(aggregator=agg)
+
+    ctrl = resilience.TrainController(
+        m, str(tmp_path / "ck"), save_every_steps=2,
+        handle_signals=False)
+    with pytest.raises(fleet.FleetStragglerError) as ei:
+        ctrl.fit([(tx, ty)] * 6, epochs=1)
+    rep = ei.value.resilience
+    # the elastic-restart contract: the report names the host to exclude
+    assert rep["exclude_hosts"] == ["hostS"]
+    # the halt rode the HealthError save-then-stop path: a final
+    # checkpoint exists and its manifest records the halt
+    latest = resilience.latest_checkpoint(str(tmp_path / "ck"))
+    assert latest is not None
+    assert latest[1]["status"] == "halt"
+    from singa_tpu import overlap
+    overlap.wait_for_checkpoints()
+
+
+# ---- the multi-process A/B -------------------------------------------------
+
+def test_multiprocess_straggler_ab_detects_and_attributes(tmp_path):
+    """ISSUE-7 acceptance (lean leg): MULTICHIP-style subprocess workers
+    with a 50 ms FaultPlan delay on ONE worker's collectives; the
+    coordinator must see that host's straggler score above threshold
+    within 5 steps (others below), list every host on /fleetz, and
+    export a schema-valid merged trace with one track per worker and
+    the injected gap visible on the slow track."""
+    out = str(tmp_path / "FLEET_test.json")
+    rc = fleet.main(["--ab", "--synthetic", "--workers", "2",
+                     "--steps", "6", "--step-sleep", "0.02",
+                     "--delay", "0.05", "--timeout", "300",
+                     "--out", out])
+    with open(out, encoding="utf-8") as f:
+        rec = json.load(f)
+    assert rc == 0, rec
+    assert rec["ok"] is True
+    assert rec["detected"] is True
+    assert rec["steps_at_detection"] <= 5
+    assert rec["slow_host"] == "host1"
+    assert rec["scores_at_detection"]["host1"] > rec["threshold"]
+    assert rec["scores_at_detection"]["host0"] <= rec["threshold"]
+    assert rec["fleetz_lists_all_hosts"] is True
+    assert rec["trace_schema_ok"] is True
+    assert rec["trace_tracks"] == 2
+    assert rec["slow_gap_ms"] >= 40.0  # the injected 50 ms, visible
+
+
+@pytest.mark.slow
+def test_multiprocess_fleet_ab_full_model(tmp_path):
+    """The full A/B (real tiny models on per-worker meshes), the leg
+    that produces the committed FLEET_r01.json artifact."""
+    out = str(tmp_path / "FLEET_full.json")
+    rc = fleet.main(["--ab", "--workers", "3", "--steps", "10",
+                     "--step-sleep", "0.03", "--delay", "0.05",
+                     "--timeout", "500", "--out", out])
+    with open(out, encoding="utf-8") as f:
+        rec = json.load(f)
+    assert rc == 0, rec
+    assert rec["ok"] is True and rec["trace_tracks"] == 3

@@ -206,6 +206,63 @@ def test_lint_introspect_enum_usage_clean():
     assert problems == []
 
 
+def test_lint_host_label_rule(tmp_path):
+    """ISSUE-7 satellite (rule 6): `host=` label values are bounded by
+    the cluster topology — literals are free-form and rejected
+    outright; dynamic values pass only inside a function that
+    references distributed.topology()/host_label()."""
+    f = tmp_path / "hosts.py"
+    f.write_text(
+        "from singa_tpu import distributed, observe\n"
+        # free-form literal: violation (no literal is ever a real host)
+        "observe.gauge('singa_h', 'a').set(1.0, host='tpu-worker-3')\n"
+        # dynamic, unguarded: violation
+        "def unguarded(h):\n"
+        "    observe.gauge('singa_h', 'a').set(1.0, host=h)\n"
+        # dynamic inside a function referencing the topology minters:
+        # fine (attribute access...)
+        "def guarded_attr(rows):\n"
+        "    local = distributed.host_label()\n"
+        "    for h, v in rows:\n"
+        "        observe.gauge('singa_h', 'a').set(v, host=h)\n"
+        # ...and bare-name reference both count
+        "from singa_tpu.distributed import topology\n"
+        "def guarded_name(h):\n"
+        "    assert h.startswith('host'), topology()\n"
+        "    observe.gauge('singa_h', 'a').set(1.0, host=h)\n"
+        # other label kwargs stay un-checked by rule 6
+        "observe.gauge('singa_k', 'b').set(1.0, kind='whatever')\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 2, problems
+    assert any("'tpu-worker-3'" in p and "free-form" in p
+               for p in problems)
+    assert any("dynamic" in p and "topology" in p for p in problems)
+
+
+def test_lint_covers_fleet_metric_names():
+    """ISSUE-7: the singa_fleet_* registrations in singa_tpu/fleet.py
+    are inside the default scan and pass every rule — including rule 6
+    (every host= record site references distributed.host_label())."""
+    fleet_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                            "fleet.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(fleet_py)}
+    assert "singa_fleet_shard_publish_total" in names
+    assert "singa_fleet_straggler_score" in names
+    assert "singa_fleet_shard_age_seconds" in names
+    assert "singa_fleet_step_rate" in names
+    assert "singa_fleet_straggler_sustained_total" in names
+    assert "singa_fleet_workers" in names
+    assert check_metrics_names.check([fleet_py]) == []
+    # singa_comm_host_seconds (the straggler detector's raw signal)
+    # rides observe.py, also in the default scan
+    obs_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                          "observe.py")
+    obs_names = {n for n, _t, _h, _l
+                 in check_metrics_names.registrations_in(obs_py)}
+    assert "singa_comm_host_seconds" in obs_names
+
+
 def test_lint_covers_resilience_metric_names():
     """ISSUE-6 satellite: the singa_resilience_* registrations in
     singa_tpu/resilience.py are inside the default scan and pass every

@@ -217,6 +217,49 @@ def test_eventlog_skips_torn_line(tmp_path):
     assert EventLog.read(p) == [{"a": 1}, {"b": 2}]
 
 
+def test_eventlog_explicit_flush_and_fsync_mode(tmp_path):
+    """ISSUE-7 satellite: EventLog grows flush() and an fsync=True mode
+    so a worker killed mid-run keeps the tail of its event log."""
+    p = str(tmp_path / "fsync.jsonl")
+    log = EventLog(p, fsync=True)
+    log.write({"step": 1})
+    # every write is already durable in fsync mode; flush() is the
+    # explicit durability point (both signatures must be callable)
+    log.flush()
+    log.flush(fsync=True)
+    rows = EventLog.read(p)
+    assert len(rows) == 1 and rows[0]["step"] == 1
+    log.close()
+    log2 = EventLog(str(tmp_path / "plain.jsonl"))
+    log2.write({"step": 2})
+    log2.flush(fsync=True)  # opt-in fsync on a non-fsync log
+    log2.flush()            # and the cheap flavor
+    log2.close()
+
+
+def test_eventlog_survives_sigkill(tmp_path):
+    """Kill -9 a subprocess immediately after it logs step N: the last
+    logged step must survive on disk (the PR-6 kill-resume post-mortem
+    contract). The child imports only singa_tpu.observe — no jax."""
+    import subprocess
+    import sys
+    p = str(tmp_path / "killed.jsonl")
+    script = (
+        "import os, signal, sys\n"
+        f"sys.path.insert(0, {repr(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))})\n"
+        "from singa_tpu.observe import EventLog\n"
+        f"log = EventLog({p!r}, fsync=True)\n"
+        "for i in range(20):\n"
+        "    log.write({'kind': 'step', 'step': i})\n"
+        "log.flush(fsync=True)\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n")
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, timeout=60)
+    assert proc.returncode == -9  # really SIGKILLed, no atexit ran
+    rows = EventLog.read(p)
+    assert rows and rows[-1]["step"] == 19
+
+
 # ---- train-loop integration ------------------------------------------------
 
 class _MLP(model.Model):

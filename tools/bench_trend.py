@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Bench trend: the reader the BENCH_*.json trajectory never had.
+
+Every round leaves `BENCH_rNN.json` / `BENCHDEC_rNN.json` /
+`MULTICHIP_rNN.json` / `RESILIENCE_rNN.json` / `FLEET_rNN.json`
+artifacts in the repo root, but nothing reads them ACROSS rounds — a
+regression between round N and N+1 is invisible unless a human diffs
+JSON by hand. This tool aggregates them into one trend table
+(metric x round) and flags regressions beyond a threshold against the
+BEST prior round, exiting non-zero so a CI step (or the tier-1 wrapper
+test) fails on a measured slide.
+
+Record formats tolerated (all of which exist in the repo today):
+  - a single JSON object with "metric"/"value" (BENCH_r06 style),
+  - JSONL, one such record per line (BENCHDEC style),
+  - the early wrapper format {"n", "cmd", "rc", "tail", "parsed"} —
+    `parsed` is used when it is a record; otherwise the round degrades
+    to a synthetic `<family>_run_ok` 0/1 metric from `rc`,
+  - harness records with an "ok" bool and no "metric"
+    (MULTICHIP/RESILIENCE/FLEET style) -> `<family>_ok` 0/1.
+
+Direction is inferred from the record's `unit` (or the metric name):
+times ("s", "ms", "seconds", `*_ms`/`*_s` suffixes) regress UP,
+everything else (throughput, ratios, ok-flags) regresses DOWN.
+
+Usage: `python tools/bench_trend.py [DIR|FILES...] [--threshold 0.05]`
+(default DIR = the repo root). `--latest-only` restricts regression
+checks to metrics present in the newest round (default: any round may
+regress against its best predecessor).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(_HERE)
+
+ROUND_RE = re.compile(r"^([A-Z]+)_r(\d+)\.json$")
+
+#: units whose metrics regress by going UP (latency-like)
+LOWER_BETTER_UNITS = ("s", "ms", "us", "seconds", "sec")
+LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_latency")
+
+
+def parse_records(path: str, family: str):
+    """Best-effort (round-tolerant) record extraction from one artifact.
+    Returns a list of {"metric", "value", "unit"} dicts; unreadable
+    files yield an empty list rather than raising — one corrupt round
+    must not blind the whole trend."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return []
+    raws = []
+    try:
+        raws = [json.loads(text)]
+    except ValueError:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                raws.append(json.loads(line))
+            except ValueError:
+                continue
+    out = []
+    for raw in raws:
+        if not isinstance(raw, dict):
+            continue
+        parsed = raw.get("parsed")
+        if isinstance(parsed, dict) \
+                and isinstance(parsed.get("metric"), str) \
+                and isinstance(parsed.get("value"), (int, float)):
+            # only adopt `parsed` when it IS a metric record; a wrapper
+            # whose parsed dict holds something else must keep its own
+            # rc so the round still degrades to <family>_run_ok below
+            raw = dict(parsed)
+        if isinstance(raw.get("metric"), str) \
+                and isinstance(raw.get("value"), (int, float)) \
+                and not isinstance(raw.get("value"), bool):
+            out.append({"metric": raw["metric"],
+                        "value": float(raw["value"]),
+                        "unit": str(raw.get("unit") or "")})
+        elif "ok" in raw:
+            out.append({"metric": f"{family.lower()}_ok",
+                        "value": 1.0 if raw.get("ok") else 0.0,
+                        "unit": "bool"})
+        elif "rc" in raw:
+            out.append({"metric": f"{family.lower()}_run_ok",
+                        "value": 1.0 if raw.get("rc") == 0 else 0.0,
+                        "unit": "bool"})
+    return out
+
+
+def collect(paths):
+    """{(family, round) -> [records]} from artifact files/directories."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                if ROUND_RE.match(name):
+                    files.append(os.path.join(p, name))
+        elif ROUND_RE.match(os.path.basename(p)):
+            files.append(p)
+    rounds = {}
+    for path in files:
+        m = ROUND_RE.match(os.path.basename(path))
+        family, rnd = m.group(1), int(m.group(2))
+        rounds.setdefault((family, rnd), []).extend(
+            parse_records(path, family))
+    return rounds
+
+
+def trend_table(rounds):
+    """{metric -> {"unit", "by_round": {round -> value}}} — rounds are
+    namespaced per family so BENCH r06 and BENCHDEC r05 don't collide
+    (metric names already differ; the round axis is per family)."""
+    table = {}
+    for (family, rnd), recs in sorted(rounds.items()):
+        for rec in recs:
+            row = table.setdefault(
+                rec["metric"], {"family": family, "unit": rec["unit"],
+                                "by_round": {}})
+            row["by_round"][rnd] = rec["value"]
+    return table
+
+
+def lower_is_better(metric: str, unit: str) -> bool:
+    u = (unit or "").strip().lower()
+    if "/" in u:
+        # a rate (tokens/s, items/s): higher is better — and this must
+        # win over the name-suffix heuristic, or a `*_tok_s` throughput
+        # metric would be misread as a latency
+        return False
+    if u in LOWER_BETTER_UNITS:
+        return True
+    return any(metric.endswith(sfx) for sfx in LOWER_BETTER_SUFFIXES)
+
+
+def find_regressions(table, threshold: float = 0.05,
+                     latest_only: bool = False):
+    """[(metric, round, value, best_prior_round, best_prior, delta_frac)]
+    — a round regresses when it is worse than the BEST prior round by
+    more than `threshold` (fractional). With latest_only, only each
+    metric's newest round is judged."""
+    out = []
+    for metric, row in sorted(table.items()):
+        lb = lower_is_better(metric, row["unit"])
+        rnds = sorted(row["by_round"])
+        judge = rnds[-1:] if latest_only else rnds[1:]
+        for rnd in judge:
+            prior = [r for r in rnds if r < rnd]
+            if not prior:
+                continue
+            vals = {r: row["by_round"][r] for r in prior}
+            best_r = min(vals, key=lambda r: vals[r]) if lb \
+                else max(vals, key=lambda r: vals[r])
+            best = vals[best_r]
+            v = row["by_round"][rnd]
+            if best == 0:
+                worse = (v > 0) if lb else (v < 0)
+                delta = float("inf") if worse else 0.0
+            else:
+                delta = (v - best) / abs(best) if lb \
+                    else (best - v) / abs(best)
+            if delta > threshold:
+                out.append((metric, rnd, v, best_r, best, delta))
+    return out
+
+
+def format_table(table, max_rounds: int = 8) -> str:
+    """Human-readable metric x round table (newest `max_rounds`)."""
+    all_rounds = sorted({r for row in table.values()
+                         for r in row["by_round"]})[-max_rounds:]
+    width = max([len(m) for m in table] or [6])
+    lines = [" ".join([f"{'metric':<{width}}"]
+                      + [f"{'r%02d' % r:>12}" for r in all_rounds])]
+    for metric, row in sorted(table.items()):
+        cells = []
+        for r in all_rounds:
+            v = row["by_round"].get(r)
+            cells.append(f"{v:>12.4g}" if v is not None else f"{'-':>12}")
+        lines.append(" ".join([f"{metric:<{width}}"] + cells))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python tools/bench_trend.py",
+        description="aggregate BENCH_*/BENCHDEC_*/MULTICHIP_*/... round "
+                    "artifacts into a trend table and fail on regression")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="artifact files or directories (default: repo "
+                        "root)")
+    p.add_argument("--threshold", type=float, default=0.05,
+                   help="fractional regression tolerance vs the best "
+                        "prior round (default 0.05)")
+    p.add_argument("--latest-only", action="store_true",
+                   help="judge only each metric's newest round")
+    args = p.parse_args(argv)
+    rounds = collect(args.paths or [ROOT])
+    if not rounds:
+        print("no *_rNN.json artifacts found", file=sys.stderr)
+        return 0
+    table = trend_table(rounds)
+    print(format_table(table))
+    regs = find_regressions(table, threshold=args.threshold,
+                            latest_only=args.latest_only)
+    for metric, rnd, v, best_r, best, delta in regs:
+        print(f"REGRESSION {metric}: r{rnd:02d}={v:.6g} is "
+              f"{delta * 100.0:.1f}% worse than best prior "
+              f"r{best_r:02d}={best:.6g}", file=sys.stderr)
+    if regs:
+        print(f"{len(regs)} regression(s) beyond "
+              f"{args.threshold * 100.0:.0f}%", file=sys.stderr)
+        return 1
+    print(f"no regressions beyond {args.threshold * 100.0:.0f}% "
+          f"across {len(rounds)} round artifact(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

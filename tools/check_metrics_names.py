@@ -26,7 +26,13 @@ literal, then fails if
      must be a module-level constant whose value is a member, and a
      dynamic expression is allowed only inside a function that references
      the enum tuple (i.e. guards membership against it) — anything else
-     could mint unbounded label values.
+     could mint unbounded label values, or
+  6. a `host=` label value on a metric record call is free-form: the
+     fleet layer's host labels are CONTRACTUALLY bounded by the cluster
+     topology, so a string literal is rejected outright and a dynamic
+     value is allowed only inside a function that references
+     `distributed.topology()` or `distributed.host_label()` (the only
+     minters of host identities — same enclosing-guard style as rule 5).
 
 Dynamic names (f-strings, e.g. bench.py's singa_bench_* gauges) cannot be
 checked statically; the runtime ValueError in observe._Metric covers
@@ -110,6 +116,13 @@ def registrations_in(path, tree=None):
 ENUM_LABEL_KWARGS = ("reason", "phase", "bucket")
 RECORD_FUNCS = {"inc", "set", "observe", "dec"}
 
+# Rule 6: `host=` label values must originate in the cluster topology.
+# These are the blessed minters (singa_tpu/distributed.py); a recording
+# function must reference one of them (as a bare name or an attribute)
+# to prove its host values came from there.
+HOST_LABEL_KWARG = "host"
+HOST_SOURCE_NAMES = ("host_label", "topology")
+
 
 def _module_enum_info(tree):
     """(enums, consts): module-level ALL-CAPS `NAME = ("a", "b", ...)`
@@ -135,9 +148,10 @@ def _module_enum_info(tree):
 
 
 def label_enum_problems(tree):
-    """Yield (lineno, message) for reason=/phase= label values on metric
-    record calls that cannot be traced to a declared enum tuple (rule 5
-    in the module docstring)."""
+    """Yield (lineno, message) for reason=/phase=/bucket= label values on
+    metric record calls that cannot be traced to a declared enum tuple
+    (rule 5 in the module docstring), and for `host=` label values that
+    cannot be traced to the cluster topology (rule 6)."""
     enums, consts = _module_enum_info(tree)
     allowed = {v for vals in enums.values() for v in vals}
     out = []
@@ -146,13 +160,42 @@ def label_enum_problems(tree):
         return any(isinstance(n, ast.Name) and n.id in enums
                    for n in ast.walk(fn))
 
-    def visit(node, guarded):
+    def fn_host_guards(fn):
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and n.id in HOST_SOURCE_NAMES:
+                return True
+            if isinstance(n, ast.Attribute) \
+                    and n.attr in HOST_SOURCE_NAMES:
+                return True
+        return False
+
+    def visit(node, guarded, host_guarded=False):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             guarded = guarded or fn_guards(node)
+            host_guarded = host_guarded or fn_host_guards(node)
         if (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
                 and node.func.attr in RECORD_FUNCS):
             for kw in node.keywords:
+                if kw.arg == HOST_LABEL_KWARG:
+                    v = kw.value
+                    if isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        out.append((
+                            v.lineno,
+                            f"host= label value {v.value!r} is a "
+                            "free-form literal; host labels must come "
+                            "from distributed.topology() / "
+                            "host_label()"))
+                    elif not host_guarded:
+                        out.append((
+                            v.lineno,
+                            "host= label value is dynamic and the "
+                            "enclosing function does not reference "
+                            "distributed.topology()/host_label() — "
+                            "derive host identities from the cluster "
+                            "topology"))
+                    continue
                 if kw.arg not in ENUM_LABEL_KWARGS:
                     continue
                 v = kw.value
@@ -178,7 +221,7 @@ def label_enum_problems(tree):
                         "declared enum tuple (guard membership against "
                         "it, e.g. `assert x in COMPILE_PHASES`)"))
         for child in ast.iter_child_nodes(node):
-            visit(child, guarded)
+            visit(child, guarded, host_guarded)
 
     visit(tree, False)
     return out
