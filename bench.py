@@ -130,6 +130,21 @@ def main():
                         "vs the no-health run into the JSON "
                         "(health_ms_per_step / health_overhead_pct), so "
                         "regressions in the stats cost show in BENCH_*.json")
+    p.add_argument("--mem", action="store_true",
+                   help="after the main run, install the device-memory "
+                        "ledger (singa_tpu.memory) and A/B the fenced "
+                        "step time with per-step snapshots on vs off "
+                        "(paired, alternating order — same protocol as "
+                        "--health), then record the overhead "
+                        "(mem_ms_per_step / mem_overhead_pct), the "
+                        "region breakdown, the reconciliation check, "
+                        "the compile-count delta (must be 0: snapshots "
+                        "are host-side only) and the pre-flight fit "
+                        "estimate into the JSON record")
+    p.add_argument("--mem-out", default=None, metavar="FILE",
+                   help="with --mem: also write the focused memory "
+                        "records as JSONL (the MEM_r*.json artifact "
+                        "tools/bench_trend.py aggregates)")
     p.add_argument("--explain", action="store_true",
                    help="add the AOT introspection fields to the JSON "
                         "record (singa_tpu.introspect): mfu_pct, "
@@ -346,6 +361,96 @@ def main():
         base_ms = float(np.median(np.asarray(bases)))
         health_ms_per_step = base_ms + float(np.median(deltas))
         health_overhead_pct = 100.0 * float(np.median(deltas)) / base_ms
+
+    # ---- device-memory ledger overhead + breakdown (--mem) ---------------
+    # Same paired-alternating protocol as --health: the delta is the
+    # host-side cost of one jax.live_arrays() enumeration + attribution
+    # per step. The compile-count delta is asserted into the record —
+    # the ledger never traces, so installing it must not retrace.
+    mem_fields = {}
+    if args.mem:
+        from singa_tpu import memory as memory_mod
+
+        led = memory_mod.install_ledger()
+
+        def fenced_mem_ms():
+            t1 = time.perf_counter()
+            _o, ls = m(tx, ty)
+            np.asarray(jax.device_get(ls.data))
+            return (time.perf_counter() - t1) * 1e3
+
+        cc = observe.get_registry().get("singa_model_compile_total")
+        compiles_before = sum(v for _n, _k, v in cc.samples()) if cc else 0
+        fenced_mem_ms()  # both arms warm (the first snapshot builds
+        fenced_mem_ms()  # the provider id sets)
+        offs, ons = [], []
+        for i in range(2 * args.step_samples):
+            if i % 2 == 0:
+                led.enabled = False
+                offs.append(fenced_mem_ms())
+                led.enabled = True
+                ons.append(fenced_mem_ms())
+            else:
+                led.enabled = True
+                ons.append(fenced_mem_ms())
+                led.enabled = False
+                offs.append(fenced_mem_ms())
+        led.enabled = True
+        deltas = np.asarray(ons) - np.asarray(offs)
+        mem_base_ms = float(np.median(np.asarray(offs)))
+        mem_ms_per_step = mem_base_ms + float(np.median(deltas))
+        mem_overhead_pct = 100.0 * float(np.median(deltas)) / mem_base_ms
+        cc = observe.get_registry().get("singa_model_compile_total")
+        compiles_after = sum(v for _n, _k, v in cc.samples()) if cc else 0
+        snap = led.snapshot()
+        # reconciliation against an INDEPENDENT enumeration (snapshot
+        # accumulates regions and total in one pass, so comparing
+        # those two against each other would be a tautology)
+        reconciled = (sum(snap["regions"].values())
+                      == snap["total_bytes"]
+                      == memory_mod.total_live_bytes())
+        fit = memory_mod.estimate_fit(model=m, device=dev)
+        mem_fields = {
+            "mem_ms_per_step": round(mem_ms_per_step, 3),
+            "mem_overhead_pct": round(mem_overhead_pct, 2),
+            "mem_compile_delta": int(compiles_after - compiles_before),
+            "mem_reconciled": bool(reconciled),
+            "mem_total_bytes": snap["total_bytes"],
+            "mem_live_arrays": snap["n_arrays"],
+            "mem_params_bytes": snap["regions"]["params"],
+            "mem_opt_state_bytes": snap["regions"]["opt_state"],
+            "mem_unattributed_bytes": snap["regions"]["unattributed"],
+            "mem_est_peak_bytes": fit["estimated_peak_bytes"],
+            "mem_limit_bytes": fit["limit_bytes"],
+        }
+        if args.mem_out:
+            mem_ok = bool(reconciled
+                          and compiles_after == compiles_before)
+            with open(args.mem_out, "w", encoding="utf-8") as f:
+                for metric, value, mu in (
+                        # the overhead as an ms delta, so bench_trend's
+                        # direction inference (lower-is-better on ms /
+                        # _bytes) judges every record correctly
+                        ("mem_overhead_ms", float(np.median(deltas)),
+                         "ms"),
+                        ("mem_ms_per_step", mem_ms_per_step, "ms"),
+                        ("mem_total_bytes", snap["total_bytes"],
+                         "bytes"),
+                        ("mem_params_bytes", snap["regions"]["params"],
+                         "bytes"),
+                        ("mem_est_peak_bytes",
+                         fit["estimated_peak_bytes"], "bytes")):
+                    f.write(json.dumps(
+                        {"metric": metric, "value": round(float(value), 4),
+                         "unit": mu, "model": args.model}) + "\n")
+                f.write(json.dumps({
+                    "ok": mem_ok, "reconciled": bool(reconciled),
+                    "compile_delta": int(compiles_after
+                                         - compiles_before),
+                    "overhead_pct": round(mem_overhead_pct, 2),
+                    "regions": snap["regions"],
+                    "model": args.model}) + "\n")
+        memory_mod.uninstall_ledger()
 
     # ---- overlap layer A/B (--overlap / --ckpt-async) --------------------
     # the record's goodput_* fields must describe the REAL benchmarked
@@ -651,6 +756,8 @@ def main():
         rec["goodput_wall_s"] = round(snap["wall_s"], 3)
         for bucket_name, seconds in snap["buckets"].items():
             rec[f"goodput_{bucket_name}_s"] = round(seconds, 4)
+    if mem_fields:
+        rec.update(mem_fields)  # mirrored into singa_bench_* below
     if overlap_fields:
         rec.update(overlap_fields)  # mirrored into singa_bench_* below
     if args.explain:

@@ -17,6 +17,9 @@ the wall-clock go" without touching the training process:
              breakdown + recompile blame history + health line
   /flightz   flight-bundle index; ?name=<bundle> streams one bundle's
              JSONL (round-trips through health.load_flight_bundle)
+  /memz      the live device-memory ledger (singa_tpu.memory): region
+             breakdown + reconciliation + estimate-vs-actual drift +
+             leak state; ?json=1 returns the timeline JSON
   /profilez  on-demand xplane capture: ?steps=N waits for N more train
              steps (or ?seconds=S), stops the trace, returns the top
              ops as JSON
@@ -83,6 +86,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "/flightz": self._flightz,
                 "/fleetz": self._fleetz,
                 "/fleetz/trace": self._fleetz_trace,
+                "/memz": self._memz,
                 "/profilez": self._profilez,
             }.get(url.path.rstrip("/") or "/")
             if route is None:
@@ -105,6 +109,8 @@ class _Handler(BaseHTTPRequestHandler):
             "  /flightz      flight-bundle index; ?name=<bundle> fetches\n"
             "  /fleetz       aggregated per-host fleet status (text)\n"
             "  /fleetz/trace merged Perfetto/Chrome trace (JSON)\n"
+            "  /memz         live device-memory ledger breakdown; "
+            "?json=1 for the timeline JSON\n"
             "  /profilez     ?steps=N[&seconds=S] on-demand xplane "
             "capture\n")
 
@@ -210,6 +216,23 @@ class _Handler(BaseHTTPRequestHandler):
             return
         agg.poll()
         self._send_json(agg.trace_events())
+
+    def _memz(self, q):
+        """Live device-memory breakdown from the installed
+        memory.MemoryLedger: region table + reconciliation + the
+        static introspect HBM view side-by-side (estimate-vs-actual
+        drift) + leak state + timeline tail. `?json=1` returns the
+        full timeline as JSON. 503 until a ledger is installed."""
+        from . import memory
+        led = memory.get_ledger()
+        if led is None:
+            body = memory.memz_report()  # the "not installed" text
+            self._send(body + "\n", status=503)
+            return
+        if (q.get("json") or ["0"])[0] not in ("0", "", "false"):
+            self._send_json(memory.memz_json())
+            return
+        self._send(memory.memz_report() + "\n")
 
     def _profilez(self, q):
         import tempfile

@@ -141,6 +141,10 @@ def _agg_metrics():
             "singa_fleet_goodput_ratio",
             "per-host productive share of wall time, from each "
             "worker's goodput snapshot"),
+        "mem": observe.gauge(
+            "singa_fleet_mem_bytes",
+            "per-host total live device bytes, from each worker's "
+            "memory-ledger region snapshot"),
         "sustained": observe.counter(
             "singa_fleet_straggler_sustained_total",
             "sustained-straggler verdicts by host"),
@@ -227,6 +231,15 @@ class ShardWriter:
         lines.append({"kind": "fleet_health",
                       "verdict": mon.verdict() if mon is not None
                       else None})
+        mem = None
+        try:
+            from . import memory
+            led = memory.get_ledger()
+            if led is not None:
+                mem = led.region_bytes()  # per-host region snapshot
+        except Exception:
+            mem = None
+        lines.append({"kind": "fleet_mem", "mem": mem})
         for rec in observe.span_records():
             lines.append({"kind": "fleet_span", "name": rec["name"],
                           "t0": rec["t0"], "dur": rec["dur"],
@@ -291,6 +304,8 @@ def read_shard(path: str) -> "dict | None":
                          if r.get("kind") == "fleet_goodput"), None),
         "health": next((r.get("verdict") for r in rows
                         if r.get("kind") == "fleet_health"), None),
+        "mem": next((r.get("mem") for r in rows
+                     if r.get("kind") == "fleet_mem"), None),
         "spans": [r for r in rows if r.get("kind") == "fleet_span"],
     }
 
@@ -337,8 +352,9 @@ def merge_metric_snapshots(snaps: dict) -> dict:
 
 class _WorkerState:
     __slots__ = ("path", "host", "pid", "seq", "ts", "perf", "steps",
-                 "started_ts", "metrics", "goodput", "health", "spans",
-                 "prev_ts", "prev_steps", "step_rate", "over_since")
+                 "started_ts", "metrics", "goodput", "health", "mem",
+                 "spans", "prev_ts", "prev_steps", "step_rate",
+                 "over_since")
 
     def __init__(self, path):
         self.path = path
@@ -352,6 +368,7 @@ class _WorkerState:
         self.metrics = {}
         self.goodput = None
         self.health = None
+        self.mem = None   # per-host memory-ledger region snapshot
         self.spans = {}   # (tid, t0, name) -> span rec, insertion-ordered
         self.prev_ts = None
         self.prev_steps = 0
@@ -446,6 +463,7 @@ class FleetAggregator:
             w.metrics = shard["metrics"]
             w.goodput = shard["goodput"]
             w.health = shard["health"]
+            w.mem = shard.get("mem")
             if fresh and w.prev_ts and w.ts > w.prev_ts:
                 w.step_rate = max(
                     0.0, (w.steps - w.prev_steps) / (w.ts - w.prev_ts))
@@ -537,6 +555,9 @@ class FleetAggregator:
                 m["goodput"].set(
                     float(w.goodput.get("goodput_ratio") or 0.0),
                     host=w.host)
+            if isinstance(w.mem, dict):
+                m["mem"].set(float(w.mem.get("total_bytes") or 0.0),
+                             host=w.host)
         for hostname, score in self._scores.items():
             m["score"].set(score, host=hostname)
         return local
@@ -690,7 +711,17 @@ class FleetAggregator:
                     "sustained": w.host in self._sustained,
                     "health": (w.health or {}).get("status")
                         if isinstance(w.health, dict) else None,
+                    "mem_bytes": int(w.mem.get("total_bytes") or 0)
+                        if isinstance(w.mem, dict) else None,
+                    "mem_regions": dict(w.mem.get("regions") or {})
+                        if isinstance(w.mem, dict) else None,
                 })
+            # worst-HBM host: max live bytes across workers that
+            # published a memory snapshot (freshest shard per host
+            # already won above)
+            with_mem = [r for r in rows if r["mem_bytes"] is not None]
+            worst = max(with_mem, key=lambda r: r["mem_bytes"]) \
+                if with_mem else None
             merged = merge_metric_snapshots(
                 {w.host: w.metrics for w in self._workers.values()
                  if w.host is not None})
@@ -704,6 +735,8 @@ class FleetAggregator:
                 "workers": rows,
                 "stragglers": sorted(self._sustained),
                 "halt": self._halt,
+                "worst_mem_host": worst["host"] if worst else None,
+                "worst_mem_bytes": worst["mem_bytes"] if worst else None,
                 "metrics": merged,
             }
 
@@ -889,7 +922,8 @@ def fleet_report() -> str:
         f"straggler threshold: {roll['threshold']:.2f} "
         f"(sustain {roll['sustain']} polls)",
         f"{'host':<12} {'pid':>7} {'seq':>5} {'age_s':>7} {'steps':>7} "
-        f"{'step/s':>8} {'goodput':>8} {'straggler':>10} state",
+        f"{'step/s':>8} {'goodput':>8} {'mem_mb':>8} {'straggler':>10} "
+        f"state",
     ]
     for r in roll["workers"]:
         state = "STALE" if r["stale"] else (
@@ -897,19 +931,25 @@ def fleet_report() -> str:
         mark = "*" if r["host"] == local else " "
         gp = f"{r['goodput_ratio']:.2f}" \
             if r["goodput_ratio"] is not None else "-"
+        mem = f"{r['mem_bytes'] / 1e6:.1f}" \
+            if r.get("mem_bytes") is not None else "-"
         lines.append(
             f"{r['host']:<11}{mark} {r['pid']:>7} {r['seq']:>5} "
             f"{r['age_s']:>7.2f} {r['steps']:>7} "
-            f"{r['step_rate']:>8.2f} {gp:>8} "
+            f"{r['step_rate']:>8.2f} {gp:>8} {mem:>8} "
             f"{r['straggler_score']:>10.3f} {state}")
     steps_total = 0
     for s in (roll["metrics"].get("singa_steps_total") or
               {}).get("series", {}).values():
         steps_total += int(s.get("value", 0.0))
+    worst = roll.get("worst_mem_host")
     lines.append(f"fleet steps: {steps_total}   "
                  f"sustained stragglers: "
                  f"{','.join(roll['stragglers']) or 'none'}   "
-                 f"halt: {roll['halt'] or 'none'}")
+                 f"halt: {roll['halt'] or 'none'}   "
+                 f"worst-HBM host: "
+                 + (f"{worst} ({roll['worst_mem_bytes'] / 1e6:.1f} MB)"
+                    if worst else "none (no memory shards)"))
     return "\n".join(lines)
 
 

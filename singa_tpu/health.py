@@ -62,6 +62,7 @@ KIND_NONFINITE_LOSS = "nonfinite_loss"
 KIND_LOSS_SPIKE = "loss_spike"
 KIND_GRAD_NORM = "grad_norm_limit"
 KIND_STRAGGLER = "straggler"  # fleet sustained-straggler verdict
+KIND_MEM_LEAK = "mem_leak"    # memory-ledger sustained-growth verdict
 
 
 class HealthError(RuntimeError):
@@ -308,6 +309,15 @@ class FlightRecorder:
         if batch_arrays:
             import numpy as np
             from .snapshot import Snapshot
+            try:
+                # memory-ledger birth-site hook: device buffers held
+                # for this snapshot attribute to `flight_snapshot`
+                # while they stay alive (host copies are ignored)
+                from . import memory
+                memory.note_arrays(memory.REGION_FLIGHT_SNAPSHOT,
+                                   list(batch_arrays))
+            except Exception:
+                pass
             snap_prefix = os.path.splitext(path)[0] + "_batch"
             with Snapshot(snap_prefix, mode_write=True) as s:
                 for i, a in enumerate(batch_arrays):

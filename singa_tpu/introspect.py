@@ -582,7 +582,14 @@ class AotExecutor:
             return self.fn(*args)
         try:
             return ex(*args)
-        except Exception:
+        except Exception as exec_exc:
+            from . import memory
+            if memory.is_resource_exhausted(exec_exc):
+                # device allocator exhausted: the jit fallback would
+                # re-pay the same allocation and die the same way —
+                # dump the OOM forensics bundle and let it propagate
+                memory.handle_oom(exec_exc, key=self.key)
+                raise
             self._execs[k] = None
             with observe.span("model.jit_fallback"):
                 return self.fn(*args)
@@ -638,6 +645,20 @@ def explain(model=None, device=None, xplane=None, top=10) -> dict:
              "total_ms": round(r["total_ms"], 3),
              "pct": round(r["pct"], 1)}
             for r in xprof.top_ops(xplane, top)]
+    # the dynamic half of the memory model (singa_tpu.memory): live
+    # region breakdown when a ledger is installed, and the pre-flight
+    # fit estimate combining this module's static analysis with the
+    # ledger's measured param+opt bytes
+    try:
+        from . import memory
+        led = memory.get_ledger()
+        if led is not None and led.timeline:
+            rep["mem_regions"] = dict(led.timeline[-1]["regions"])
+        if model is not None:
+            rep["memory_fit"] = memory.estimate_fit(model=model,
+                                                    device=device)
+    except Exception:
+        pass
     return rep
 
 
@@ -678,6 +699,19 @@ def format_explain(rep: dict) -> str:
         lines.append(f"{key} executable [{r['fingerprint']}]: "
                      f"{fl / 1e9:.4f} GFLOP, compile "
                      f"{r['phases'].get('compile', 0.0):.3f}s")
+    mr = rep.get("mem_regions")
+    if mr:
+        live = " | ".join(f"{k} {_mb(v)}" for k, v in sorted(mr.items())
+                          if v)
+        lines.append(f"  live memory (ledger): {live or 'empty'}")
+    fit = rep.get("memory_fit")
+    if fit:
+        lim = fit.get("limit_bytes")
+        lines.append(
+            f"  memory fit: est peak {_mb(fit['estimated_peak_bytes'])}"
+            + (f" vs limit {_mb(lim)} -> "
+               f"{'fits' if fit['fits'] else 'DOES NOT FIT'}"
+               if lim else " (device limit unknown)"))
     blames = rep.get("recompiles", [])
     lines.append(f"recompile history ({len(blames)}):")
     for b in blames:

@@ -33,6 +33,7 @@ from . import autograd
 from . import goodput
 from . import health
 from . import introspect
+from . import memory
 from . import observe
 from .layer import Layer, LayerMeta
 from .tensor import Tensor
@@ -270,6 +271,10 @@ class Model(Layer, metaclass=ModelMeta):
         opt = self._optimizer
         if opt is not None:
             opt.setup(self.get_params().values())
+        # memory-ledger birth-site hook: params (re-read per snapshot —
+        # donation replaces the buffers every step) and the retained
+        # step inputs the flight recorder would snapshot
+        memory.track_model(self)
         # shard_map whenever a multi-device mesh is attached — the data
         # axis may be size 1 when the mesh is carved for tp/pp only
         dist = (isinstance(opt, DistOpt)
@@ -647,7 +652,14 @@ class Model(Layer, metaclass=ModelMeta):
                 else:
                     new_states, new_opt, new_rng, outs, hstats = step_fn(
                         state_arrs, opt_arrs, rng, input_arrs)
-            except Exception:
+            except Exception as step_exc:
+                if memory.is_resource_exhausted(step_exc):
+                    # the device allocator ran out: re-dispatching via
+                    # the jit fallback would just OOM again — dump the
+                    # forensics bundle (timeline, region breakdown,
+                    # top-K arrays, executable manifest) and re-raise
+                    memory.handle_oom(step_exc, key="step")
+                    raise
                 if step_fn is fn:
                     raise
                 # the AOT executable rejected the call (e.g. an optimizer

@@ -397,6 +397,7 @@ _enabled = True
 _tls = threading.local()
 _step_cb = None
 _span_listeners: list = []  # (exit_cb, enter_cb | None) pairs
+_step_listeners: list = []  # post-step callbacks (memory ledger)
 
 
 def add_span_listener(cb, on_enter=None):
@@ -418,6 +419,23 @@ def remove_span_listener(cb):
     if it was never registered). Equality, not identity: bound methods
     compare equal across attribute accesses but are distinct objects."""
     _span_listeners[:] = [p for p in _span_listeners if p[0] != cb]
+
+
+def add_step_listener(cb):
+    """Register `cb(seconds)` to run at the END of record_step — i.e.
+    after the model committed the step's new state buffers, unlike the
+    model.step SPAN exit, which fires while the donated pre-step
+    buffers are already freed but the new ones not yet assigned. The
+    memory ledger snapshots from here so params attribute to live
+    arrays. Exceptions are swallowed; unlike `set_step_callback`
+    (single slot, introspect's MFU hook), this is a listener list."""
+    _step_listeners.append(cb)
+    return cb
+
+
+def remove_step_listener(cb):
+    """Unregister a step listener (equality match, like spans)."""
+    _step_listeners[:] = [c for c in _step_listeners if c != cb]
 
 
 def start_diag_server(port=None, **kwargs):
@@ -683,8 +701,11 @@ def record_compile(batch_class, recompile: bool = False,
 
 def record_hbm(device):
     """Per-step HBM gauges via jax.Device.memory_stats (the hook
-    device.get_gpu_mem_size reads); silently absent on backends without
-    memory stats (host CPU)."""
+    device.get_gpu_mem_size reads). On backends without allocator
+    stats (the tier-1 CPU path, where memory_stats() is None) the
+    in-use gauge falls back to the memory ledger's live-array byte
+    total, so `singa_hbm_bytes_in_use` ALWAYS exists instead of the
+    gauges silently vanishing."""
     if not _enabled:
         return
     try:
@@ -692,6 +713,15 @@ def record_hbm(device):
     except Exception:
         stats = None
     if not stats:
+        try:
+            from . import memory
+            # O(1) from the ledger's latest snapshot when installed,
+            # else a throttled enumeration — this hook runs per step
+            total = memory.hbm_fallback_bytes()
+        except Exception:
+            return
+        gauge("singa_hbm_bytes_in_use",
+              "device bytes in use").set(float(total))
         return
     if "bytes_in_use" in stats:
         gauge("singa_hbm_bytes_in_use",
@@ -722,6 +752,11 @@ def record_step(seconds: float, batch=None, tag=0, device=None):
             _step_cb(seconds)
         except Exception:
             pass  # a derived-metric hook must never break the step
+    for listener in tuple(_step_listeners):
+        try:
+            listener(seconds)
+        except Exception:
+            pass  # a listener must never break the step
     _default.emit({"kind": "step", "step": int(c.value()),
                    "seconds": round(seconds, 9),
                    "batch": batch, "tag": tag})
@@ -890,6 +925,7 @@ __all__ = [
     "counter", "gauge", "histogram", "set_event_log", "get_event_log",
     "to_prometheus_text", "dump", "DEFAULT_BUCKETS", "SPAN_TRACE_PREFIX",
     "set_step_callback", "add_span_listener", "remove_span_listener",
+    "add_step_listener", "remove_step_listener",
     "start_diag_server",
     "enable_span_records", "disable_span_records", "span_records",
     "span_records_enabled",

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from . import autograd
 from . import health
+from . import memory
 from . import observe
 from .tensor import Tensor
 
@@ -140,6 +141,10 @@ class Optimizer:
         self._params_by_id = {id(p): p for p in params}
         for p in params:
             self._state(p)
+        # memory-ledger birth-site hook: slot buffers + step counter,
+        # re-read per snapshot (lazily growing sparse residuals stay
+        # covered)
+        memory.track_optimizer(self)
 
     def state_specs(self):
         """PartitionSpec per state_arrays() entry: optimizer state for a

@@ -49,7 +49,7 @@ from collections import deque
 
 import jax
 
-from . import observe
+from . import memory, observe
 from .tensor import Tensor
 
 _END = object()  # ring sentinel: the source iterator is exhausted
@@ -98,6 +98,9 @@ class DevicePrefetcher:
         self._closed = False
         with DevicePrefetcher._ids_lock:
             n = next(DevicePrefetcher._ids)
+        # memory-ledger birth-site hook: the on-device batches parked
+        # in the ring attribute to the `prefetch_ring` region
+        memory.track_prefetcher(self)
         self._thread = threading.Thread(
             target=self._produce, name=f"singa-prefetch-{n}", daemon=True)
         self._thread.start()
@@ -221,6 +224,7 @@ class DevicePrefetcher:
         with self._cond:
             self._ring.clear()
             observe.record_prefetch(depth=0)
+        memory.untrack(memory.REGION_PREFETCH_RING, self)
 
     def __enter__(self):
         return self

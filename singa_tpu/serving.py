@@ -602,6 +602,15 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
                 if obs:
                     jax.block_until_ready(tok0)
                     ttft = _time.perf_counter() - t0
+            # memory-ledger birth-site hook: the per-block KV caches
+            # are live host-visible buffers only at this seam (the
+            # fused beam program never surfaces its caches) — the
+            # ledger's serving.decode snapshot attributes them here.
+            # Gated on an installed ledger: without a consumer, the
+            # per-array weakref churn would tax every decode call
+            from . import memory
+            if memory.get_ledger() is not None:
+                memory.note_arrays(memory.REGION_KV_CACHE, caches)
             if max_new > 1:
                 with observe.span("serving.decode_scan", batch=B,
                                   new_tokens=max_new):

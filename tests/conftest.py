@@ -52,10 +52,12 @@ def _metrics_isolation():
     HTTP ports, server threads, or span listeners — and (ISSUE-5)
     asserts the test left no async checkpoint pending, no prefetcher
     thread alive, and no stray non-daemon thread behind."""
-    from singa_tpu import diag, fleet, goodput, health, introspect, observe
+    from singa_tpu import (diag, fleet, goodput, health, introspect,
+                           memory, observe)
     diag.stop_diag_server()
     goodput.uninstall()
     fleet.uninstall()
+    memory.reset()
     health.set_active_monitor(None)
     observe.get_registry().reset()
     observe.set_event_log(None)
@@ -64,6 +66,19 @@ def _metrics_isolation():
     yield
     diag.stop_diag_server()
     goodput.uninstall()
+    # memory-ledger teardown (ISSUE-9): the ledger uninstalled (its
+    # step/span listeners detached, the sampler thread joined) and all
+    # region providers/transient notes dropped. Leaked sampler threads
+    # are CAPTURED first and cleaned regardless, matching the
+    # fleet/overlap pattern, so one leaky test fails itself without
+    # cascading into the suite.
+    leaked_mem = [t.name for t in threading.enumerate()
+                  if t.is_alive() and t.name.startswith("singa-mem")]
+    memory.reset()
+    assert not leaked_mem, (
+        f"memory-ledger sampler thread(s) left running: {leaked_mem} — "
+        "memory.uninstall_ledger() (or ledger.close()) before the test "
+        "ends")
     # fleet teardown (ISSUE-7): every shard-writer thread joined, the
     # aggregator dropped, the span-record ring disabled, and any spool
     # temp dir the fleet module created removed. Like the async-ckpt

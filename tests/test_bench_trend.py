@@ -113,3 +113,31 @@ def test_smoke_on_repo_artifacts():
     assert "multichip_ok" in table
     assert bench_trend.format_table(table)
     bench_trend.find_regressions(table)
+
+
+def test_bytes_metrics_default_to_lower_is_better():
+    """ISSUE-9 satellite: memory footprints regress UP — both via the
+    "bytes" unit and the `_bytes` name suffix (MEM_r*.json records);
+    rate units still win over the name heuristic."""
+    assert bench_trend.lower_is_better("mem_total_bytes", "bytes")
+    assert bench_trend.lower_is_better("toy_hbm_bytes", "")
+    assert bench_trend.lower_is_better("mem_est_peak_bytes", "bytes")
+    assert not bench_trend.lower_is_better("kv_bytes", "bytes/s")
+
+
+def test_bytes_fixture_regression_flagged():
+    """The checked-in fixtures carry a toy_hbm_bytes series: flat in
+    clean/ (no flag), +50% in regress/ (flagged UP against the best —
+    i.e. smallest — prior round)."""
+    clean = bench_trend.trend_table(bench_trend.collect([CLEAN]))
+    assert clean["toy_hbm_bytes"]["by_round"] == {2: 1000000.0,
+                                                 3: 990000.0}
+    assert not [r for r in bench_trend.find_regressions(clean)
+                if r[0] == "toy_hbm_bytes"]
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = {m: (rnd, v, best_r, best, delta)
+            for m, rnd, v, best_r, best, delta
+            in bench_trend.find_regressions(table, threshold=0.05)}
+    rnd, v, best_r, best, delta = regs["toy_hbm_bytes"]
+    assert (rnd, v, best_r, best) == (3, 1500000.0, 2, 1000000.0)
+    assert abs(delta - 0.5) < 1e-9

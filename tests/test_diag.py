@@ -356,3 +356,46 @@ def test_start_installs_goodput_tracker():
         assert "== goodput ==" in body
     finally:
         diag.stop_diag_server()
+
+
+def test_memz_without_ledger_is_503():
+    srv = observe.start_diag_server(port=0)
+    try:
+        st, _h, body = _get(srv, "/memz")
+        assert st == 503
+        assert "no MemoryLedger installed" in body
+    finally:
+        diag.stop_diag_server()
+
+
+def test_memz_serves_breakdown_live_mid_run(served):
+    """Acceptance: /memz serves the live region breakdown mid-run —
+    golden sections in the text view, reconciled totals and the
+    timeline in the JSON view, the static introspect HBM estimate
+    side-by-side, and the index advertising the endpoint."""
+    from singa_tpu import memory
+    from singa_tpu.memory import MEM_REGIONS
+    srv, m, tx, ty, _mon = served
+    memory.install_ledger()
+    for _ in range(2):
+        m(tx, ty)
+    st, _h, body = _get(srv, "/memz")
+    assert st == 200
+    assert "== memory ==" in body
+    for region in MEM_REGIONS:
+        assert region in body, region
+    assert "reconciliation" in body and "(OK)" in body
+    assert "static estimate" in body          # the introspect view...
+    assert "estimate-vs-actual" in body       # ...and the drift line
+    assert "leak: slope" in body
+    assert "timeline (newest last):" in body
+    st, _h, body = _get(srv, "/memz?json=1")
+    assert st == 200
+    rep = json.loads(body)
+    assert rep["installed"] is True
+    assert sum(rep["regions"].values()) == rep["total_bytes"]
+    assert rep["regions"]["params"] > 0       # the live params attribute
+    assert len(rep["timeline"]) >= 2          # breakdown evolved mid-run
+    assert rep["top_arrays"] and rep["static_hbm"]
+    _st, _h, idx = _get(srv, "/")
+    assert "/memz" in idx

@@ -33,7 +33,7 @@ def _fleet_hygiene():
 
 def _write_fake_shard(fleet_dir, host, pid, seq=1, ts=None, perf=0.0,
                       spans=(), steps=0, metrics=None, goodput=None,
-                      name=None):
+                      name=None, mem=None):
     """Hand-build one shard file in the documented format — the unit
     tests' stand-in for another process's ShardWriter (the writer end
     is covered by the round-trip test and the subprocess A/B)."""
@@ -45,7 +45,8 @@ def _write_fake_shard(fleet_dir, host, pid, seq=1, ts=None, perf=0.0,
     lines = [header,
              {"kind": "fleet_metrics", "metrics": metrics or {}},
              {"kind": "fleet_goodput", "goodput": goodput},
-             {"kind": "fleet_health", "verdict": None}]
+             {"kind": "fleet_health", "verdict": None},
+             {"kind": "fleet_mem", "mem": mem}]
     for nm, t0, dur, tid, kind in spans:
         lines.append({"kind": "fleet_span", "name": nm, "t0": t0,
                       "dur": dur, "tid": tid, "span_kind": kind})
@@ -538,3 +539,65 @@ def test_multiprocess_fleet_ab_full_model(tmp_path):
         rec = json.load(f)
     assert rc == 0, rec
     assert rec["ok"] is True and rec["trace_tracks"] == 3
+
+
+# ---- per-host memory (ISSUE-9) ---------------------------------------------
+
+def test_shard_carries_memory_and_worst_hbm_host(tmp_path):
+    """Shards carry the worker's memory-ledger region snapshot; the
+    aggregator grows a per-host memory column and flags the worst-HBM
+    host in the rollup, /fleetz and the singa_fleet_mem_bytes gauge."""
+    from singa_tpu import memory
+    d = str(tmp_path)
+    # writer side: a real ledger snapshot rides the shard
+    memory.install_ledger()
+    pin = jnp.ones((256,), jnp.float32)  # something definitely live
+    memory.get_ledger().snapshot()
+    w = fleet.ShardWriter(d, interval_s=0, host="hostA", name="worker_a")
+    try:
+        w.publish()
+        shard = fleet.read_shard(w.path)
+        assert shard["mem"] is not None
+        assert shard["mem"]["total_bytes"] >= pin.nbytes > 0
+        assert set(shard["mem"]["regions"]) == set(memory.MEM_REGIONS)
+    finally:
+        w.close(final_publish=False)
+    # aggregator side: a fatter fake host must win the worst-HBM flag
+    _write_fake_shard(d, "hostB", 200, steps=5,
+                      mem={"regions": {"params": 10 ** 9},
+                           "total_bytes": 10 ** 9, "n_arrays": 3,
+                           "step": 5})
+    agg = fleet.FleetAggregator(d)
+    roll = agg.poll()
+    by_host = {r["host"]: r for r in roll["workers"]}
+    assert by_host["hostB"]["mem_bytes"] == 10 ** 9
+    assert by_host["hostA"]["mem_bytes"] > 0
+    assert by_host["hostB"]["mem_regions"]["params"] == 10 ** 9
+    assert roll["worst_mem_host"] == "hostB"
+    assert roll["worst_mem_bytes"] == 10 ** 9
+    g = observe.get_registry().get("singa_fleet_mem_bytes")
+    assert g.value(host="hostB") == 10 ** 9
+    fleet.install_aggregator(aggregator=agg)
+    rep = fleet.fleet_report()
+    assert "mem_mb" in rep                      # the new column
+    assert "worst-HBM host: hostB (1000.0 MB)" in rep
+
+
+def test_shard_without_ledger_and_report_without_mem(tmp_path):
+    """No ledger installed: the shard's mem record is None, the rollup
+    column is None, and /fleetz says so instead of inventing a worst
+    host."""
+    d = str(tmp_path)
+    w = fleet.ShardWriter(d, interval_s=0, host="hostA", name="worker_a")
+    try:
+        w.publish()
+        assert fleet.read_shard(w.path)["mem"] is None
+    finally:
+        w.close(final_publish=False)
+    agg = fleet.FleetAggregator(d)
+    roll = agg.poll()
+    assert roll["workers"][0]["mem_bytes"] is None
+    assert roll["worst_mem_host"] is None
+    fleet.install_aggregator(aggregator=agg)
+    assert "worst-HBM host: none (no memory shards)" \
+        in fleet.fleet_report()

@@ -282,3 +282,56 @@ def test_lint_covers_resilience_metric_names():
     assert "singa_resilience_resumed_step" in names
     assert "singa_resilience_last_save_age_seconds" in names
     assert check_metrics_names.check([res_py]) == []
+
+
+def test_lint_region_label_values(tmp_path):
+    """ISSUE-9 satellite: rule 5 covers the memory ledger's `region=`
+    label with the same declared-tuple proof as reason=/phase=/bucket=
+    (memory.py's MEM_REGIONS)."""
+    f = tmp_path / "regions.py"
+    f.write_text(
+        "from singa_tpu import observe\n"
+        "MEM_REGIONS = ('params', 'kv_cache')\n"
+        "REGION_PARAMS = 'params'\n"
+        # literal member: fine
+        "observe.gauge('singa_m', 'a').set(1.0, region='params')\n"
+        # module constant member: fine
+        "observe.gauge('singa_m', 'a').set(1.0, region=REGION_PARAMS)\n"
+        # literal NON-member: violation
+        "observe.gauge('singa_m', 'a').set(1.0, region='heap')\n"
+        # dynamic, unguarded: violation
+        "def unguarded(r):\n"
+        "    observe.gauge('singa_m', 'a').set(1.0, region=r)\n"
+        # dynamic behind a membership guard: fine
+        "def guarded(r):\n"
+        "    assert r in MEM_REGIONS\n"
+        "    observe.gauge('singa_m', 'a').set(1.0, region=r)\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 2, problems
+    assert any("'heap'" in p for p in problems)
+    assert any("dynamic" in p for p in problems)
+
+
+def test_lint_covers_memory_metric_names():
+    """ISSUE-9: every singa_mem_* registration in singa_tpu/memory.py is
+    inside the default scan and passes the linter end to end — name
+    pattern, counter _total suffix, unique helps, and rule 5 for the
+    region= label (MEM_REGIONS is the declared enum tuple)."""
+    mem_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                          "memory.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(mem_py)}
+    assert {"singa_mem_region_bytes", "singa_mem_total_bytes",
+            "singa_mem_live_arrays", "singa_mem_snapshots_total",
+            "singa_mem_leak_slope_bytes", "singa_mem_leak_verdicts_total",
+            "singa_mem_oom_dumps_total"} <= names
+    # every singa_mem_* name the module registers passes the lint
+    assert all(n.startswith("singa_mem_") for n in names)
+    assert check_metrics_names.check([mem_py]) == []
+    # the fleet-side per-host memory gauge rides fleet.py, also clean
+    fleet_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                            "fleet.py")
+    fleet_names = {n for n, _t, _h, _l
+                   in check_metrics_names.registrations_in(fleet_py)}
+    assert "singa_fleet_mem_bytes" in fleet_names
+    assert check_metrics_names.check([fleet_py]) == []

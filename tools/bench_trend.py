@@ -20,8 +20,11 @@ Record formats tolerated (all of which exist in the repo today):
     (MULTICHIP/RESILIENCE/FLEET style) -> `<family>_ok` 0/1.
 
 Direction is inferred from the record's `unit` (or the metric name):
-times ("s", "ms", "seconds", `*_ms`/`*_s` suffixes) regress UP,
-everything else (throughput, ratios, ok-flags) regresses DOWN.
+times ("s", "ms", "seconds", `*_ms`/`*_s` suffixes) and memory
+footprints ("bytes" unit, `*_bytes` suffix — MEM_r*.json's region
+records) regress UP, everything else (throughput, ratios, ok-flags)
+regresses DOWN. Rate units ("tokens/s") always win over the name
+heuristics.
 
 Usage: `python tools/bench_trend.py [DIR|FILES...] [--threshold 0.05]`
 (default DIR = the repo root). `--latest-only` restricts regression
@@ -41,9 +44,9 @@ ROOT = os.path.dirname(_HERE)
 
 ROUND_RE = re.compile(r"^([A-Z]+)_r(\d+)\.json$")
 
-#: units whose metrics regress by going UP (latency-like)
-LOWER_BETTER_UNITS = ("s", "ms", "us", "seconds", "sec")
-LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_latency")
+#: units whose metrics regress by going UP (latency- and footprint-like)
+LOWER_BETTER_UNITS = ("s", "ms", "us", "seconds", "sec", "bytes")
+LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_latency", "_bytes")
 
 
 def parse_records(path: str, family: str):
