@@ -33,7 +33,7 @@ from multiprocessing import Event, Process, Queue
 
 import numpy as np
 
-from . import observe
+from . import observe, watchdog
 
 
 def _record_consumer_wait(kind: str, seconds: float, depth=None):
@@ -119,9 +119,13 @@ class ImageBatchIter:
             # serve it, the iteration is over
             raise StopIteration
         # blocking get (no 10ms poll spin): wake as soon as a batch
-        # lands, and notice a dead worker instead of hanging forever
+        # lands, and notice a dead worker instead of hanging forever.
+        # The watchdog arms its data_wait deadline over the same wait
+        # (`data.next` is the deterministic FaultPlan hook).
         t0 = time.perf_counter()
-        with observe.span("data.wait"):
+        from . import resilience
+        with observe.span("data.wait"), watchdog.guard("data_wait"):
+            resilience.fault_point("data.next")
             while True:
                 try:
                     item = self.queue.get(timeout=0.2)
@@ -287,7 +291,10 @@ class NumpyBatchIter:
         try:
             for b in range(self.num_batches):
                 t0 = time.perf_counter()
-                with observe.span("data.wait"):
+                with observe.span("data.wait"), \
+                        watchdog.guard("data_wait"):
+                    from . import resilience
+                    resilience.fault_point("data.next")
                     with lock:
                         while b not in nxt:
                             # same dead-producer guard as ImageBatchIter:

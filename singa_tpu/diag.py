@@ -20,6 +20,9 @@ the wall-clock go" without touching the training process:
   /memz      the live device-memory ledger (singa_tpu.memory): region
              breakdown + reconciliation + estimate-vs-actual drift +
              leak state; ?json=1 returns the timeline JSON
+  /stackz    on-demand all-thread Python stack dump (names + daemon
+             flags + frames, the same capture the watchdog's hang
+             bundle embeds); ?json=1 returns the structured form
   /profilez  on-demand xplane capture: ?steps=N waits for N more train
              steps (or ?seconds=S), stops the trace, returns the top
              ops as JSON
@@ -87,6 +90,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "/fleetz": self._fleetz,
                 "/fleetz/trace": self._fleetz_trace,
                 "/memz": self._memz,
+                "/stackz": self._stackz,
                 "/profilez": self._profilez,
             }.get(url.path.rstrip("/") or "/")
             if route is None:
@@ -111,6 +115,8 @@ class _Handler(BaseHTTPRequestHandler):
             "  /fleetz/trace merged Perfetto/Chrome trace (JSON)\n"
             "  /memz         live device-memory ledger breakdown; "
             "?json=1 for the timeline JSON\n"
+            "  /stackz       all-thread Python stack dump; "
+            "?json=1 for the structured form\n"
             "  /profilez     ?steps=N[&seconds=S] on-demand xplane "
             "capture\n")
 
@@ -157,6 +163,11 @@ class _Handler(BaseHTTPRequestHandler):
             parts.append(resilience.resilience_report())
         except Exception as e:
             parts.append(f"(resilience unavailable: {e})")
+        try:
+            from . import watchdog
+            parts.append(watchdog.watchdog_report())
+        except Exception as e:
+            parts.append(f"(watchdog unavailable: {e})")
         mon = self._monitor()
         if mon is None:
             parts.append("== health ==\nno HealthMonitor attached")
@@ -233,6 +244,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(memory.memz_json())
             return
         self._send(memory.memz_report() + "\n")
+
+    def _stackz(self, q):
+        """On-demand all-thread stack dump — the hang-forensics capture
+        (`watchdog.thread_stacks`, `sys._current_frames` joined against
+        `threading.enumerate`) served live: when a run LOOKS wedged,
+        this names the frame every thread is parked in without
+        attaching a debugger or waiting for the watchdog's own dump
+        stage. `?json=1` returns the structured form."""
+        from . import watchdog
+        stacks = watchdog.thread_stacks()
+        if (q.get("json") or ["0"])[0] not in ("0", "", "false"):
+            self._send_json(stacks)
+            return
+        self._send(watchdog.format_stacks(stacks) + "\n")
 
     def _profilez(self, q):
         import tempfile

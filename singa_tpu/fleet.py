@@ -240,6 +240,18 @@ class ShardWriter:
         except Exception:
             mem = None
         lines.append({"kind": "fleet_mem", "mem": mem})
+        hang = None
+        try:
+            # the watchdog's hang verdict rides every shard: this is
+            # how a WEDGED worker (one that cannot step, let alone be
+            # merely slow) becomes visible to the rest of the fleet —
+            # the aggregator escalates a peer's abort-stage verdict
+            # fleet-wide (check_straggler_halt)
+            from . import watchdog
+            hang = watchdog.hang_report()
+        except Exception:
+            hang = None
+        lines.append({"kind": "fleet_hang", "hang": hang})
         for rec in observe.span_records():
             lines.append({"kind": "fleet_span", "name": rec["name"],
                           "t0": rec["t0"], "dur": rec["dur"],
@@ -254,8 +266,14 @@ class ShardWriter:
 
     def publish(self) -> int:
         """Serialize one shard and atomically replace the previous one.
-        Returns the published sequence number."""
-        with self._plock:
+        Returns the published sequence number. The watchdog arms its
+        `fleet_publish` deadline over the write (a wedged spool — dead
+        NFS, full disk blocking forever — must not silently turn this
+        worker invisible to the fleet); `fleet.publish` is the
+        deterministic FaultPlan hook."""
+        from . import resilience, watchdog
+        with self._plock, watchdog.guard("fleet_publish"):
+            resilience.fault_point("fleet.publish")
             self.seq += 1
             lines = self._snapshot_lines()
             tmp = self.path + ".tmp"
@@ -306,6 +324,8 @@ def read_shard(path: str) -> "dict | None":
                         if r.get("kind") == "fleet_health"), None),
         "mem": next((r.get("mem") for r in rows
                      if r.get("kind") == "fleet_mem"), None),
+        "hang": next((r.get("hang") for r in rows
+                      if r.get("kind") == "fleet_hang"), None),
         "spans": [r for r in rows if r.get("kind") == "fleet_span"],
     }
 
@@ -353,7 +373,7 @@ def merge_metric_snapshots(snaps: dict) -> dict:
 class _WorkerState:
     __slots__ = ("path", "host", "pid", "seq", "ts", "perf", "steps",
                  "started_ts", "metrics", "goodput", "health", "mem",
-                 "spans", "prev_ts", "prev_steps", "step_rate",
+                 "hang", "spans", "prev_ts", "prev_steps", "step_rate",
                  "over_since")
 
     def __init__(self, path):
@@ -369,6 +389,7 @@ class _WorkerState:
         self.goodput = None
         self.health = None
         self.mem = None   # per-host memory-ledger region snapshot
+        self.hang = None  # per-host watchdog hang verdict (sticky)
         self.spans = {}   # (tid, t0, name) -> span rec, insertion-ordered
         self.prev_ts = None
         self.prev_steps = 0
@@ -414,6 +435,12 @@ class FleetAggregator:
         self._stale: "dict[str, float]" = {}  # host -> age seconds
         self._halt: "dict | None" = None
         self._sustained: "set[str]" = set()
+        # hang escalation: a peer's abort-stage watchdog verdict, held
+        # sticky until the training loop consumes it (take_peer_hang).
+        # `_hang_seen` de-duplicates by (host, verdict id) so one hang
+        # episode triggers exactly ONE coordinated abort-and-restore.
+        self._peer_hang: "dict | None" = None
+        self._hang_seen: "set[tuple]" = set()
         self._last_poll = 0.0
         self.started_mono = time.monotonic()
         self._poll_stop = threading.Event()
@@ -464,6 +491,7 @@ class FleetAggregator:
             w.goodput = shard["goodput"]
             w.health = shard["health"]
             w.mem = shard.get("mem")
+            w.hang = shard.get("hang")
             if fresh and w.prev_ts and w.ts > w.prev_ts:
                 w.step_rate = max(
                     0.0, (w.steps - w.prev_steps) / (w.ts - w.prev_ts))
@@ -615,11 +643,45 @@ class FleetAggregator:
                               "score": round(score, 4),
                               "ts": round(time.time(), 6)}
 
+    def _hangs_locked(self):
+        """Advance peer-hang state: a worker whose shard carries an
+        abort-stage watchdog verdict is WEDGED (it could not step at
+        all — a different failure class from a straggler, which is
+        merely slow). A peer's verdict (host != this process's label)
+        is held for the training loop, which raises it as a HangError
+        so every worker aborts-and-restores together — the only
+        recovery that works when a collective is missing a
+        participant. Each (host, id) escalates exactly once."""
+        local = distributed.host_label()
+        for w in self._workers.values():
+            h = w.hang
+            if not isinstance(h, dict) or h.get("stage") != "abort":
+                continue
+            key = (w.host, h.get("id"))
+            if w.host == local or key in self._hang_seen:
+                continue
+            self._hang_seen.add(key)
+            if self._peer_hang is None:
+                self._peer_hang = {"host": w.host, **h}
+
+    def peer_hang(self) -> "dict | None":
+        """The pending (unconsumed) peer-hang verdict, or None."""
+        return self._peer_hang
+
+    def take_peer_hang(self) -> "dict | None":
+        """Consume the pending peer-hang verdict (one coordinated
+        abort per hang episode)."""
+        with self._lock:
+            h = self._peer_hang
+            self._peer_hang = None
+            return h
+
     def poll(self) -> dict:
         """Re-scan the spool and return the fresh rollup."""
         now_epoch = time.time()
         with self._lock:
             self._scan()
+            self._hangs_locked()
             self._scores = self._score_locked()
             self._stale = {
                 w.host: round(now_epoch - w.ts, 3)
@@ -711,6 +773,8 @@ class FleetAggregator:
                     "sustained": w.host in self._sustained,
                     "health": (w.health or {}).get("status")
                         if isinstance(w.health, dict) else None,
+                    "hang": dict(w.hang)
+                        if isinstance(w.hang, dict) else None,
                     "mem_bytes": int(w.mem.get("total_bytes") or 0)
                         if isinstance(w.mem, dict) else None,
                     "mem_regions": dict(w.mem.get("regions") or {})
@@ -734,7 +798,11 @@ class FleetAggregator:
                 "policy": self._resolved_policy(),
                 "workers": rows,
                 "stragglers": sorted(self._sustained),
+                "wedged": sorted(r["host"] for r in rows
+                                 if r["hang"] is not None
+                                 and r["hang"].get("stage") == "abort"),
                 "halt": self._halt,
+                "peer_hang": self._peer_hang,
                 "worst_mem_host": worst["host"] if worst else None,
                 "worst_mem_bytes": worst["mem_bytes"] if worst else None,
                 "metrics": merged,
@@ -889,9 +957,14 @@ def check_straggler_halt(step: "int | None" = None):
     """Training-loop hook (resilience.TrainController calls it every
     step): no-op without an aggregator; otherwise polls on the
     aggregator's cadence and raises FleetStragglerError once a sustained
-    straggler verdict landed under the halt policy. Raising from the
-    LOOP (not the aggregator's caller) is the point — the controller's
-    HealthError path saves a final checkpoint and attaches the report."""
+    straggler verdict landed under the halt policy — or, when a PEER
+    published an abort-stage watchdog hang verdict, raises
+    `watchdog.HangError` so this worker aborts-and-restores in lockstep
+    with the wedged one (the coordinated recovery a missing-participant
+    collective requires; consumed once per hang episode). Raising from
+    the LOOP (not the aggregator's caller) is the point — the
+    controller's HealthError path saves a final checkpoint and attaches
+    the report, and its HangError path restores-and-restarts."""
     agg = _aggregator
     if agg is None:
         return
@@ -904,6 +977,20 @@ def check_straggler_halt(step: "int | None" = None):
             f"{agg.sustain} polls); elastic restart should exclude it"
             + (f" [step {step}]" if step is not None else ""),
             hosts=(h["host"],), score=h["score"])
+    ph = agg.take_peer_hang()
+    if ph is not None:
+        from . import watchdog
+        observe.get_registry().emit(
+            {"kind": "fleet", "event": "peer_hang",
+             "host": ph.get("host"), "op": ph.get("op"),
+             "seconds": ph.get("seconds"), "step": step})
+        raise watchdog.HangError(
+            f"peer {ph.get('host')} wedged in {ph.get('op')!r} "
+            f"({ph.get('seconds')}s past its deadline): coordinated "
+            "abort-and-restore"
+            + (f" [step {step}]" if step is not None else ""),
+            op=ph.get("op"), seconds=ph.get("seconds"),
+            hosts=(ph.get("host"),))
 
 
 def fleet_report() -> str:
@@ -926,8 +1013,12 @@ def fleet_report() -> str:
         f"state",
     ]
     for r in roll["workers"]:
-        state = "STALE" if r["stale"] else (
-            "STRAGGLER" if r["sustained"] else (r["health"] or "ok"))
+        # wedged outranks everything: a worker with an abort-stage hang
+        # verdict could not step AT ALL (vs. a straggler, merely slow)
+        state = "WEDGED" if (r.get("hang") or {}).get("stage") \
+            == "abort" else (
+            "STALE" if r["stale"] else (
+                "STRAGGLER" if r["sustained"] else (r["health"] or "ok")))
         mark = "*" if r["host"] == local else " "
         gp = f"{r['goodput_ratio']:.2f}" \
             if r["goodput_ratio"] is not None else "-"
@@ -946,6 +1037,7 @@ def fleet_report() -> str:
     lines.append(f"fleet steps: {steps_total}   "
                  f"sustained stragglers: "
                  f"{','.join(roll['stragglers']) or 'none'}   "
+                 f"wedged: {','.join(roll['wedged']) or 'none'}   "
                  f"halt: {roll['halt'] or 'none'}   "
                  f"worst-HBM host: "
                  + (f"{worst} ({roll['worst_mem_bytes'] / 1e6:.1f} MB)"

@@ -35,6 +35,7 @@ from . import health
 from . import introspect
 from . import memory
 from . import observe
+from . import watchdog
 from .layer import Layer, LayerMeta
 from .tensor import Tensor
 
@@ -640,8 +641,12 @@ class Model(Layer, metaclass=ModelMeta):
             t0 = time.perf_counter()
         # span -> the goodput `step` bucket (held pending until the
         # health verdict below, so a discarded update reclassifies to
-        # `health_skip`); covers dispatch and, when profiling, the fence
-        with observe.span("model.step"):
+        # `health_skip`); covers dispatch and, when profiling, the fence.
+        # The watchdog guard arms the `step` deadline over the same
+        # region (nested no-op when a TrainController's outer guard is
+        # already armed); a cold jit fallback's build span taints the
+        # entry, so first-compile time neither breaches nor calibrates
+        with watchdog.guard("step"), observe.span("model.step"):
             try:
                 if cold_jit:
                     # nested mapped span: the fresh trace+compile nets
@@ -855,8 +860,14 @@ class Model(Layer, metaclass=ModelMeta):
                     while True:
                         # fetch wait measured per batch: the host-side
                         # pipeline stall signal (goodput `data_wait`; an
-                        # iterator's own data.wait span nests, nets out)
-                        with observe.span("data.wait"):
+                        # iterator's own data.wait span nests, nets
+                        # out). The watchdog arms the `data_wait`
+                        # deadline over the same wait; `data.next` is
+                        # its deterministic FaultPlan hook.
+                        with observe.span("data.wait"), \
+                                watchdog.guard("data_wait"):
+                            from . import resilience
+                            resilience.fault_point("data.next")
                             batch = next(it, _end)
                         if batch is _end:
                             break
@@ -1218,8 +1229,10 @@ class Model(Layer, metaclass=ModelMeta):
             observe.record_checkpoint_bytes(nbytes)
             return path
         ck = ocp.StandardCheckpointer()
-        # span -> the goodput `checkpoint` bucket
-        with observe.span("checkpoint.save"):
+        # span -> the goodput `checkpoint` bucket; the watchdog arms
+        # the ckpt_save deadline over the blocking write
+        with observe.span("checkpoint.save"), \
+                watchdog.guard("ckpt_save"):
             ck.save(path, tree, force=overwrite)
             ck.wait_until_finished()
         # this blocking write is durable here: it supersedes any

@@ -36,6 +36,16 @@ from collections import deque
 
 _NAME_RE = re.compile(r"^singa_[a-z0-9_]+$")
 
+#: the collective vocabulary (parallel.communicator's call sites). The
+#: `op=` label is contractually low-cardinality (lint rule 5): values
+#: recorded by record_comm/record_comm_host are proven members of this
+#: tuple, with unknown callers coerced to the trailing "other" bucket
+#: rather than minting unbounded label values.
+COMM_OPS = ("all_reduce", "all_reduce_half", "all_gather", "broadcast",
+            "reduce_scatter", "all_reduce_max", "agree_any",
+            "sparse_all_reduce_topk", "sparse_all_reduce_threshold",
+            "other")
+
 # Log-scale bucket boundaries (seconds): 1e-6 .. 1e3, ratio sqrt(10).
 # Wide enough for a 2us collective and a 15-minute XLA compile alike.
 DEFAULT_BUCKETS = tuple(10.0 ** (e / 2.0) for e in range(-12, 7))
@@ -801,6 +811,8 @@ def record_comm(op: str, nbytes: int, world_size: int = 1):
     xprof tables (the collectives are wrapped in named scopes)."""
     if not _enabled:
         return
+    if op not in COMM_OPS:
+        op = COMM_OPS[-1]  # "other": never mint unbounded op= values
     counter("singa_comm_calls_total",
             "collectives in traced/eager programs").inc(op=op)
     if world_size > 1:
@@ -819,10 +831,11 @@ def record_comm_host(op: str, start: float, seconds: float):
     when one is enabled, so collectives appear on the merged trace."""
     if not _enabled:
         return
+    label = op if op in COMM_OPS else COMM_OPS[-1]
     histogram("singa_comm_host_seconds",
               "host wall seconds per collective call site (trace cost "
               "under jit, per-call on the eager path)"
-              ).observe(seconds, op=op)
+              ).observe(seconds, op=label)
     _record_span_entry(f"comm.{op}", start, seconds, kind="comm")
 
 
@@ -921,7 +934,7 @@ def record_bench(rec: dict):
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "EventLog",
     "span", "suppress_spans", "spans_suppressed", "current_span",
-    "get_registry", "enable", "is_enabled",
+    "get_registry", "enable", "is_enabled", "COMM_OPS",
     "counter", "gauge", "histogram", "set_event_log", "get_event_log",
     "to_prometheus_text", "dump", "DEFAULT_BUCKETS", "SPAN_TRACE_PREFIX",
     "set_step_callback", "add_span_listener", "remove_span_listener",

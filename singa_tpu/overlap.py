@@ -49,7 +49,7 @@ from collections import deque
 
 import jax
 
-from . import memory, observe
+from . import memory, observe, watchdog
 from .tensor import Tensor
 
 _END = object()  # ring sentinel: the source iterator is exhausted
@@ -179,9 +179,13 @@ class DevicePrefetcher:
 
     def __next__(self):
         t0 = time.perf_counter()
+        from . import resilience
         # the ring wait IS host data-stall time: span -> goodput
-        # `data_wait` (nets out under Model.fit's own fetch span)
-        with observe.span("data.wait"):
+        # `data_wait` (nets out under Model.fit's own fetch span); the
+        # watchdog arms the data_wait deadline over it, and `data.next`
+        # is the deterministic FaultPlan hook for a wedged fetch
+        with observe.span("data.wait"), watchdog.guard("data_wait"):
+            resilience.fault_point("data.next")
             with self._cond:
                 while not self._ring:
                     if self._closed:
@@ -189,6 +193,18 @@ class DevicePrefetcher:
                         # sentinel with it): the iteration is over, not
                         # a wait-forever
                         raise StopIteration
+                    t = self._thread
+                    if t is not None and not t.is_alive():
+                        # the producer died WITHOUT posting its _END
+                        # sentinel (interpreter-level death: its
+                        # try/finally never ran). Checked under the
+                        # ring lock, so a sentinel posted just before
+                        # death was already seen — an unbounded wait
+                        # here would park the training loop forever.
+                        raise RuntimeError(
+                            f"prefetch producer thread {t.name!r} died "
+                            "without posting a sentinel; the ring will "
+                            "never fill — see its traceback on stderr")
                     self._cond.wait(0.2)
                 item = self._ring[0]
                 if item is _END:
@@ -371,7 +387,10 @@ def wait_for_checkpoints():
     # the barrier wait is the checkpoint path's only remaining blocking
     # portion: span -> goodput `checkpoint`
     from . import resilience  # lazy: no module-level cycle
-    with observe.span("checkpoint.wait"):
+    # the watchdog arms the ckpt_wait deadline over the whole barrier:
+    # a write that will never land (dead filesystem, wedged orbax
+    # thread) breaches here instead of blocking the caller forever
+    with observe.span("checkpoint.wait"), watchdog.guard("ckpt_wait"):
         for e in entries:
             try:
                 # deterministic stand-in for a deferred write failure /
@@ -420,8 +439,10 @@ def start_async_save(path: str, tree, force: bool = False) -> bool:
     t0 = time.perf_counter()
     # a fresh write supersedes any recorded failure for this path
     clear_write_failed(path)
-    # span -> goodput `checkpoint`: ONLY the blocking snapshot portion
-    with observe.span("checkpoint.save"):
+    # span -> goodput `checkpoint`: ONLY the blocking snapshot portion;
+    # the watchdog's ckpt_save deadline arms over it (a wedged
+    # device->host snapshot is a hang like any other)
+    with observe.span("checkpoint.save"), watchdog.guard("ckpt_save"):
         ck.save(path, args=save_args, force=force)
     _register_pending(_PendingSave(ck, path),
                       blocking_s=time.perf_counter() - t0)

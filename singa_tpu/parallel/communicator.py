@@ -43,13 +43,19 @@ def _comm_stamp(op: str):
     delay here simulates one slow host's collectives deterministically
     (tests + the fleet A/B), inside the stamped interval so the injected
     gap is visible in the very telemetry that must detect it."""
-    from .. import resilience
-    t0 = time.perf_counter()
-    resilience.fault_point("comm.collective", op=op)
-    try:
-        yield
-    finally:
-        observe.record_comm_host(op, t0, time.perf_counter() - t0)
+    from .. import resilience, watchdog
+    # the watchdog's `collective` deadline arms over the stamped
+    # interval, so a FaultPlan delay at comm.collective (one slow/wedged
+    # host) breaches the very guard that must detect it; on breach-abort
+    # the HangError surfaces at this guard's exit — the moment the
+    # wedged collective finally returns to the host
+    with watchdog.guard("collective", comm_op=op):
+        t0 = time.perf_counter()
+        resilience.fault_point("comm.collective", op=op)
+        try:
+            yield
+        finally:
+            observe.record_comm_host(op, t0, time.perf_counter() - t0)
 
 
 def _payload_bytes(x) -> int:

@@ -58,13 +58,14 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import re
 import shutil
 import signal as _signal
 import threading
 import time
 
-from . import health, observe
+from . import health, observe, watchdog
 
 MANIFEST_VERSION = 1
 MANIFEST_SUFFIX = ".manifest.json"
@@ -84,12 +85,25 @@ RUN_STATUSES = ("ok", "preempt", "halt")
 class FaultPlan:
     """A deterministic set of fault rules, matched at named fault points.
 
-    Points wired in this PR:
-      - "step"       (TrainController, ctx: step) — before each train step
+    Points wired so far:
+      - "step"       (TrainController, ctx: step) — inside the step
+                     guard, before the model call
       - "ckpt.save"  (TrainController, ctx: step) — before each save
       - "ckpt.wait"  (overlap.wait_for_checkpoints, ctx: path) — before
                      each pending async write is awaited, i.e. a deferred
                      write failure / a slow durability barrier
+      - "comm.collective" (parallel.communicator._comm_stamp, ctx: op)
+      - "data.next"  (Model.fit / TrainController / DevicePrefetcher /
+                     data iterators) — inside the data_wait guard,
+                     before the next-batch fetch
+      - "fleet.publish" (fleet.ShardWriter.publish) — inside the
+                     fleet_publish guard
+      - "serving.decode" (serving decode) — inside the decode guard
+
+    A `delay(...)` at any of these points is the deterministic stand-in
+    for a wedged operation: it stalls inside the watchdog guard that
+    must detect it, so every breach path (warn/dump/abort) is driven by
+    tests instead of trusted.
     """
 
     def __init__(self):
@@ -204,6 +218,9 @@ def _metrics():
         "faults": observe.counter(
             "singa_resilience_faults_injected_total",
             "faults fired by the installed FaultPlan"),
+        "retry_s": observe.counter(
+            "singa_resilience_retry_seconds_total",
+            "wall seconds spent sleeping in retry backoff"),
         "resumed_step": observe.gauge(
             "singa_resilience_resumed_step",
             "step the controller auto-resumed from (0 = fresh start)"),
@@ -465,6 +482,9 @@ class TrainController:
                  save_every_s: float = 0.0, keep: int = 3,
                  max_restarts: int = 2, retries: int = 3,
                  backoff_s: float = 0.05, backoff_mult: float = 2.0,
+                 backoff_max_s: float = 30.0, retry_jitter: bool = True,
+                 max_elapsed_s: "float | None" = None,
+                 retry_seed: "int | None" = None,
                  handle_signals: bool = True, async_save: bool = True,
                  verbose: int = 0):
         self.model = model
@@ -476,6 +496,19 @@ class TrainController:
         self.retries = int(retries)
         self.backoff_s = float(backoff_s)
         self.backoff_mult = float(backoff_mult)
+        # decorrelated-jitter knobs: pure exponential backoff makes
+        # every restarted worker in a fleet retry the shared filesystem
+        # at the SAME instants (thundering herd); jittered sleeps are
+        # drawn from [base, 3 x previous sleep], capped by
+        # `backoff_max_s`. `max_elapsed_s` bounds the TOTAL time a
+        # retry loop may burn before giving up, independent of the
+        # attempt count — a preempting scheduler's grace period does
+        # not wait for retries**mult seconds.
+        self.backoff_max_s = float(backoff_max_s)
+        self.retry_jitter = bool(retry_jitter)
+        self.max_elapsed_s = (float(max_elapsed_s)
+                              if max_elapsed_s is not None else None)
+        self._retry_rng = random.Random(retry_seed)
         self.handle_signals = bool(handle_signals)
         self.async_save = bool(async_save)
         self.verbose = int(verbose)
@@ -504,8 +537,24 @@ class TrainController:
              **kw})
 
     # -- retry-with-backoff wrapper ----------------------------------------
+    def _retry_delay(self, attempt: int, prev: float) -> float:
+        """Next backoff sleep. Default: decorrelated jitter —
+        uniform(base, 3 x previous sleep), capped at `backoff_max_s` —
+        so a fleet of restarted workers spreads its retries instead of
+        hammering the shared filesystem in lockstep. With
+        retry_jitter=False: the plain exponential schedule (still
+        capped)."""
+        if self.retry_jitter:
+            hi = max(self.backoff_s, prev * 3.0)
+            delay = self._retry_rng.uniform(self.backoff_s, hi)
+        else:
+            delay = self.backoff_s * (self.backoff_mult ** (attempt - 1))
+        return min(delay, self.backoff_max_s)
+
     def _retry(self, what, fn):
         attempt = 0
+        t_start = time.monotonic()
+        prev = self.backoff_s
         while True:
             try:
                 return fn()
@@ -513,11 +562,30 @@ class TrainController:
                 raise
             except Exception as e:
                 attempt += 1
+                elapsed = time.monotonic() - t_start
                 if attempt > self.retries:
                     raise
-                _metrics()["retries"].inc()
-                delay = self.backoff_s * (self.backoff_mult
-                                          ** (attempt - 1))
+                if self.max_elapsed_s is not None \
+                        and elapsed >= self.max_elapsed_s:
+                    # total-elapsed cap: give up even with attempts
+                    # left — the caller's fallback (older checkpoint,
+                    # restart, operator) beats sleeping through a
+                    # scheduler's grace period
+                    self._emit("retry_exhausted", what=what,
+                               attempt=attempt,
+                               elapsed_s=round(elapsed, 4),
+                               max_elapsed_s=self.max_elapsed_s,
+                               error=f"{type(e).__name__}: {e}")
+                    raise
+                m = _metrics()
+                m["retries"].inc()
+                delay = self._retry_delay(attempt, prev)
+                if self.max_elapsed_s is not None:
+                    # never sleep past the cap just to fail afterwards
+                    delay = min(delay, max(
+                        0.0, self.max_elapsed_s - elapsed))
+                prev = delay
+                m["retry_s"].inc(delay)
                 self._emit("retry", what=what, attempt=attempt,
                            backoff_s=round(delay, 4),
                            error=f"{type(e).__name__}: {e}")
@@ -550,9 +618,14 @@ class TrainController:
         self._flush_losses()
 
         def do_save():
-            fault_point("ckpt.save", step=step)
-            return self.model.save_checkpoint(
-                self.ckpt_dir, step=step, async_save=self.async_save)
+            # the watchdog's ckpt_save deadline arms over the whole
+            # save (the model's own guard nests, counting once); the
+            # fault point inside means an injected stall breaches the
+            # very guard that must detect it
+            with watchdog.guard("ckpt_save", step=step):
+                fault_point("ckpt.save", step=step)
+                return self.model.save_checkpoint(
+                    self.ckpt_dir, step=step, async_save=self.async_save)
 
         if step > self._last_saved_step:
             # Barrier the PREVIOUS async write ourselves before starting
@@ -813,23 +886,33 @@ class TrainController:
                         break
                     self._cursor += 1
                     continue
-                fault_point("step", step=self._step)
-                if self._preempt is not None:  # a signal-injecting fault
-                    return self._preempt_exit()
                 # fleet hook: a sustained-straggler verdict under the
                 # halt policy raises FleetStragglerError (a HealthError)
                 # HERE, on the training thread, so the halt path below
                 # saves a final checkpoint and the report names the
-                # host(s) an elastic restart should exclude
+                # host(s) an elastic restart should exclude — and a
+                # PEER's watchdog hang verdict raises HangError here so
+                # this worker aborts-and-restores in lockstep
                 from . import fleet
                 fleet.check_straggler_halt(step=self._step)
-                with observe.span("data.wait"):
+                with observe.span("data.wait"), \
+                        watchdog.guard("data_wait", step=self._step):
+                    fault_point("data.next", step=self._step)
                     batch = next(it, _end)
                 if batch is _end:
                     break
                 if not isinstance(batch, (tuple, list)):
                     batch = (batch,)
-                out = self.model(*batch)
+                # the step guard encloses the fault point AND the model
+                # call, so an injected stall breaches the very deadline
+                # that must detect it (the model's inner guard nests,
+                # counting once at this outermost site)
+                with watchdog.guard("step", step=self._step):
+                    fault_point("step", step=self._step)
+                    preempted = self._preempt is not None
+                    out = None if preempted else self.model(*batch)
+                if preempted:  # a signal-injecting fault: exit cleanly
+                    return self._preempt_exit()
                 self._record_loss(out)
                 self._step += 1
                 self._cursor += 1
@@ -857,7 +940,11 @@ class TrainController:
         restarts, history ([[global_step, loss], ...]), last_checkpoint.
         Raises HealthError (after a final "halt" checkpoint) when the
         model's health policy halts; re-raises the last step error when
-        `max_restarts` in-process restarts are exhausted."""
+        `max_restarts` in-process restarts are exhausted. A
+        `watchdog.HangError` (this worker's own aborted hang, or a
+        peer's relayed by the fleet hook) is RESTARTABLE: restore the
+        latest durable checkpoint, replay, continue — only once
+        restarts are exhausted does it fall through to the halt path."""
         global _active_controller
         if iter(data) is data:
             # the controller re-iterates `data` on every epoch, restart
@@ -881,45 +968,81 @@ class TrainController:
             while True:
                 try:
                     return self._fit_once(data, epochs)
+                except watchdog.HangError as e:
+                    # a watchdog abort (this worker's own wedged op, or
+                    # a peer's via the fleet hook) says a DEPENDENCY
+                    # wedged, not that the numerics are suspect: route
+                    # it into the restore-and-restart machinery so
+                    # training resumes from the last durable checkpoint
+                    # instead of stalling — the halt path below is the
+                    # fallback only once restarts are exhausted
+                    if self._restarts < self.max_restarts:
+                        self._emit("hang_restart", op=e.op,
+                                   seconds=e.seconds,
+                                   hosts=list(e.hosts),
+                                   bundle=e.bundle_path)
+                        self._restart_after(e, "hung")
+                        # recovery succeeded: retire the sticky verdict
+                        # so the shard stops advertising this worker as
+                        # WEDGED and a LATER-installed aggregator (a
+                        # restarted coordinator, an auto-resumed peer
+                        # with a fresh dedup set) cannot re-escalate a
+                        # finished episode fleet-wide. Peers that were
+                        # polling during the hang window (abort ->
+                        # restore, which spans the wedge itself) have
+                        # already consumed it by (host, id).
+                        wd = watchdog.get_watchdog()
+                        if wd is not None:
+                            wd.clear_hang()
+                        continue
+                    self._halt_exit(e)
                 except health.HealthError as e:
-                    self._status = "halted"
-                    try:
-                        self._save(status="halt", final=True)
-                    except Exception as save_err:
-                        # the halt (with its flight bundle) outranks a
-                        # failed post-mortem save; record, don't mask
-                        self._emit("halt_save_failed",
-                                   error=str(save_err))
-                    e.resilience = self._report()
-                    hosts = getattr(e, "hosts", None)
-                    if hosts:
-                        # a fleet straggler halt: tell the relauncher
-                        # which host(s) to exclude from the next mesh
-                        e.resilience["exclude_hosts"] = list(hosts)
-                    raise
+                    self._halt_exit(e)
                 except (KeyboardInterrupt, SystemExit):
                     raise
                 except Exception as e:
                     if self._restarts >= self.max_restarts:
                         self._status = "failed"
                         raise
-                    self._restarts += 1
-                    _metrics()["restarts"].inc()
-                    self._emit("restart", n=self._restarts,
-                               error=f"{type(e).__name__}: {e}")
-                    self._log(f"step {self._step} failed ({e}); "
-                              f"restart {self._restarts}/"
-                              f"{self.max_restarts} from latest checkpoint")
-                    # the model state is suspect after a mid-step
-                    # failure: restore the latest durable checkpoint
-                    # (REQUIRED — without one there is nothing to
-                    # restart from) and replay
-                    self._resume_done = True
-                    self._do_resume(require=True)
+                    self._restart_after(e, "failed")
         finally:
             # _active_controller stays set: /statusz keeps answering for
             # the last run after fit returns or raises
             self._restore_signals(prev_handlers)
+
+    def _restart_after(self, e, verb: str):
+        """The in-process restart path: count it, restore the latest
+        durable checkpoint (REQUIRED — without one there is nothing to
+        restart from) and let the loop replay. The model state is
+        suspect after a mid-step failure, so a restore is never
+        optional."""
+        self._restarts += 1
+        _metrics()["restarts"].inc()
+        self._emit("restart", n=self._restarts,
+                   error=f"{type(e).__name__}: {e}")
+        self._log(f"step {self._step} {verb} ({e}); "
+                  f"restart {self._restarts}/"
+                  f"{self.max_restarts} from latest checkpoint")
+        self._resume_done = True
+        self._do_resume(require=True)
+
+    def _halt_exit(self, e):
+        """The HealthError save-then-stop path: final checkpoint with
+        manifest status "halt", report attached to the error, re-raise."""
+        self._status = "halted"
+        try:
+            self._save(status="halt", final=True)
+        except Exception as save_err:
+            # the halt (with its flight bundle) outranks a failed
+            # post-mortem save; record, don't mask
+            self._emit("halt_save_failed", error=str(save_err))
+        e.resilience = self._report()
+        hosts = getattr(e, "hosts", None)
+        if hosts:
+            # a fleet straggler halt: tell the relauncher which
+            # host(s) to exclude from the next mesh
+            e.resilience["exclude_hosts"] = list(hosts)
+        raise e
 
     def _report(self) -> dict:
         self._flush_losses()

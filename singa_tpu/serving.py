@@ -593,7 +593,14 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
         # books full serving wall time as productive; the nested stage
         # spans net out of it.
         obs = observe.is_enabled()
-        with observe.span("serving.decode", batch=B, new_tokens=max_new):
+        from . import resilience, watchdog
+        # the watchdog's `decode` deadline arms over the whole call
+        # (prefill + scan + the host seams); `serving.decode` is its
+        # deterministic FaultPlan hook
+        with watchdog.guard("decode", batch=B), \
+                observe.span("serving.decode", batch=B,
+                             new_tokens=max_new):
+            resilience.fault_point("serving.decode", batch=B)
             t0 = _time.perf_counter()
             ttft = None
             with observe.span("serving.prefill", batch=B,
@@ -766,7 +773,9 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
             ids, score, _nf = jitted(p, prompt)
             return ids, score
         t0 = _time.perf_counter()
-        with observe.span("serving.beam_decode", batch=B, beams=K):
+        from . import watchdog
+        with watchdog.guard("decode", batch=B), \
+                observe.span("serving.beam_decode", batch=B, beams=K):
             ids, score, nf = jitted(p, prompt)
             jax.block_until_ready(ids)
         # one fused program: no prefill seam, so no TTFT sample here

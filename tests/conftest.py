@@ -53,11 +53,12 @@ def _metrics_isolation():
     asserts the test left no async checkpoint pending, no prefetcher
     thread alive, and no stray non-daemon thread behind."""
     from singa_tpu import (diag, fleet, goodput, health, introspect,
-                           memory, observe)
+                           memory, observe, watchdog)
     diag.stop_diag_server()
     goodput.uninstall()
     fleet.uninstall()
     memory.reset()
+    watchdog.uninstall_watchdog()
     health.set_active_monitor(None)
     observe.get_registry().reset()
     observe.set_event_log(None)
@@ -66,6 +67,18 @@ def _metrics_isolation():
     yield
     diag.stop_diag_server()
     goodput.uninstall()
+    # watchdog teardown (ISSUE-10): the checker thread joined and the
+    # installed watchdog + its span listener dropped. Same capture-
+    # then-clean pattern as the fleet/memory checks below: the leak is
+    # recorded first and cleaned regardless, so one leaky test fails
+    # itself without cascading into the suite.
+    leaked_wd = [t.name for t in threading.enumerate()
+                 if t.is_alive() and t.name.startswith("singa-watchdog")]
+    from singa_tpu import watchdog as _watchdog
+    _watchdog.uninstall_watchdog()
+    assert not leaked_wd, (
+        f"watchdog thread(s) left running: {leaked_wd} — call "
+        "watchdog.uninstall_watchdog() before the test ends")
     # memory-ledger teardown (ISSUE-9): the ledger uninstalled (its
     # step/span listeners detached, the sampler thread joined) and all
     # region providers/transient notes dropped. Leaked sampler threads

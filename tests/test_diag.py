@@ -129,7 +129,60 @@ def test_statusz_golden_sections(served):
     # ISSUE-6: the resilience section (controller + recovery counters)
     assert "== resilience ==" in body
     assert "saves=" in body and "restarts=" in body
+    # ISSUE-10: the watchdog section (deadline table; not installed in
+    # this fixture, so the pointer line is the golden content)
+    assert "== watchdog ==" in body
+    assert "not installed" in body
     assert "== health ==" in body
+
+
+def test_statusz_watchdog_section_when_installed(served):
+    from singa_tpu import watchdog
+    srv = served[0]
+    watchdog.install_watchdog(deadlines={"step": 0.75})
+    try:
+        st, _h, body = _get(srv, "/statusz")
+        assert st == 200
+        assert "== watchdog ==" in body
+        assert "action=abort" in body
+        assert "0.750(static)" in body
+        assert "fleet_publish" in body      # every DEADLINE_OPS row
+    finally:
+        watchdog.uninstall_watchdog()
+
+
+def test_stackz_dumps_all_threads(served):
+    """ISSUE-10: /stackz serves the all-thread stack capture — thread
+    names + daemon flags + frames — live, the same capture the hang
+    bundle embeds."""
+    srv = served[0]
+    st, _h, body = _get(srv, "/stackz")
+    assert st == 200
+    assert "== threads ==" in body
+    assert "MainThread" in body              # the test runner's thread
+    assert "daemon" in body                  # the server's own threads
+    # the capture names real frames: the server's serve loop is parked
+    # somewhere in the stdlib's socketserver/selectors machinery
+    assert " in " in body and ".py:" in body
+
+
+def test_stackz_json_form(served):
+    srv = served[0]
+    st, _h, body = _get(srv, "/stackz?json=1")
+    assert st == 200
+    stacks = json.loads(body)
+    assert isinstance(stacks, list) and stacks
+    names = {s["name"] for s in stacks}
+    assert "MainThread" in names
+    me = next(s for s in stacks if s["name"] == "MainThread")
+    assert me["daemon"] is False
+    assert me["frames"] and all(
+        {"file", "line", "func"} <= set(f) for f in me["frames"])
+    # the main thread is parked in this very test's HTTP wait: the
+    # capture must name a real calling frame, proving the wedged-frame
+    # forensics a hang bundle depends on
+    funcs = {f["func"] for f in me["frames"]}
+    assert "test_stackz_json_form" in funcs
 
 
 def test_healthz_verdict(served):

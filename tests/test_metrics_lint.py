@@ -335,3 +335,55 @@ def test_lint_covers_memory_metric_names():
                    in check_metrics_names.registrations_in(fleet_py)}
     assert "singa_fleet_mem_bytes" in fleet_names
     assert check_metrics_names.check([fleet_py]) == []
+
+
+def test_lint_op_label_values(tmp_path):
+    """ISSUE-10, rule 5 extension: `op=` label values must be provably
+    members of a declared enum tuple (watchdog.py's DEADLINE_OPS,
+    observe.py's COMM_OPS) — a literal non-member, and a dynamic value
+    in a function that references no enum, are both violations."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from singa_tpu import observe\n"
+        "OPS = ('step', 'collective')\n"
+        "observe.counter('singa_x_total').inc(op='step')\n"      # member
+        "observe.counter('singa_x_total').inc(op='bogus_op')\n"  # not
+        "def guarded(o):\n"
+        "    if o not in OPS:\n"
+        "        raise ValueError(o)\n"
+        "    observe.counter('singa_x_total').inc(op=o)\n"       # proven
+        "def unguarded(o):\n"
+        "    observe.counter('singa_x_total').inc(op=o)\n")      # free
+    problems = check_metrics_names.check([str(bad)])
+    assert len(problems) == 2
+    assert any("bogus_op" in p for p in problems)
+    assert any("dynamic" in p for p in problems)
+
+
+def test_lint_covers_watchdog_metric_names():
+    """ISSUE-10: every singa_watchdog_* registration in watchdog.py is
+    in the default scan and passes every rule — including the new op=
+    enum rule (DEADLINE_OPS proof) — and observe.py's comm-op label
+    sites pass it via COMM_OPS."""
+    wd_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                         "watchdog.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(wd_py)}
+    assert {"singa_watchdog_breach_total", "singa_watchdog_dump_total",
+            "singa_watchdog_abort_total",
+            "singa_watchdog_hard_abort_total", "singa_watchdog_armed",
+            "singa_watchdog_deadline_seconds"} <= names
+    assert check_metrics_names.check([wd_py]) == []
+    obs_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                          "observe.py")
+    assert check_metrics_names.check([obs_py]) == []
+    # DEADLINE_OPS and COMM_OPS are recognized as declared enum tuples
+    import ast
+    enums, _consts = check_metrics_names._module_enum_info(
+        ast.parse(open(wd_py).read()))
+    assert enums["DEADLINE_OPS"] == (
+        "step", "collective", "data_wait", "ckpt_save", "ckpt_wait",
+        "decode", "fleet_publish")
+    enums_obs, _ = check_metrics_names._module_enum_info(
+        ast.parse(open(obs_py).read()))
+    assert "other" in enums_obs["COMM_OPS"]

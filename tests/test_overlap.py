@@ -492,3 +492,40 @@ def test_dispatch_fast_path_losses_match_first_step(dev):
     dev.rng_state = jax.random.PRNGKey(1)
     l2 = [float(m2(tx, ty)[1].numpy()) for _ in range(4)]
     assert l1 == l2
+
+
+def test_prefetcher_detects_producer_death_without_sentinel(
+        dev, monkeypatch):
+    """ISSUE-10 bugfix: a producer thread that dies WITHOUT posting its
+    error sentinel (interpreter-level death: the try/finally never ran)
+    used to park the consumer's ring get() forever. The bounded-wait
+    loop now re-checks producer liveness and raises naming the thread
+    instead of hanging the epoch."""
+    # simulate the hard death: the producer body exits immediately,
+    # bypassing the sentinel-posting finally entirely
+    monkeypatch.setattr(overlap.DevicePrefetcher, "_produce",
+                        lambda self: None)
+    pf = overlap.DevicePrefetcher(iter([(1,)]), device=dev)
+    pf._thread.join(timeout=5.0)
+    assert not pf._thread.is_alive()
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError, match=pf._thread.name):
+        next(pf)
+    assert time.perf_counter() - t0 < 3.0   # detected, not timed out
+    pf.close()
+
+
+def test_prefetcher_sentinel_death_still_raises_source_error(dev):
+    """The ordinary death path (source raises, sentinel posted) keeps
+    its contract: the source error is re-raised, not the new
+    dead-thread RuntimeError."""
+
+    def bad():
+        yield (1,)
+        raise ValueError("source exploded")
+
+    pf = overlap.DevicePrefetcher(bad(), device=dev)
+    next(pf)
+    with pytest.raises(ValueError, match="source exploded"):
+        next(pf)
+    pf.close()

@@ -816,3 +816,85 @@ def test_kill_resume_ab_cli(tmp_path):
     assert rec["resumed_status"] == "completed"
     assert rec["resumed_step"] > 0
     assert rec["max_abs_loss_delta"] < 1e-4
+
+
+# ---- retry backoff: decorrelated jitter + total-elapsed cap (ISSUE-10) -----
+
+def test_retry_backoff_uses_decorrelated_jitter(tmp_path, monkeypatch):
+    """The backoff sleeps are jittered — drawn from [base, 3 x previous
+    sleep], capped — not the lockstep exponential schedule that makes a
+    restarted fleet hammer the shared filesystem in unison; every slept
+    second lands in singa_resilience_retry_seconds_total."""
+    ctrl = resilience.TrainController(
+        None, str(tmp_path / "ck"), retries=5, backoff_s=0.01,
+        backoff_max_s=0.5, retry_seed=1234, handle_signals=False)
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] <= 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert ctrl._retry("save", flaky) == "ok"
+    assert len(sleeps) == 3
+    prev = 0.01
+    for s in sleeps:
+        assert 0.01 <= s <= min(0.5, max(0.01, prev * 3.0)) + 1e-9
+        prev = s
+    # jitter, not a fixed schedule: the draws differ (seeded, so this
+    # is deterministic) and a different seed gives different sleeps
+    assert len({round(s, 9) for s in sleeps}) > 1
+    ctrl2 = resilience.TrainController(
+        None, str(tmp_path / "ck"), retries=5, backoff_s=0.01,
+        backoff_max_s=0.5, retry_seed=99, handle_signals=False)
+    sleeps2 = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps2.append(s))
+    calls[0] = 0
+    ctrl2._retry("save", flaky)
+    assert sleeps2 != sleeps
+    reg = observe.get_registry()
+    got = reg.get("singa_resilience_retry_seconds_total").value()
+    assert got == pytest.approx(sum(sleeps) + sum(sleeps2))
+    assert reg.get("singa_resilience_retries_total").value() == 6
+
+
+def test_retry_jitter_off_keeps_exponential_schedule(tmp_path,
+                                                     monkeypatch):
+    ctrl = resilience.TrainController(
+        None, str(tmp_path / "ck"), retries=3, backoff_s=0.01,
+        backoff_mult=2.0, retry_jitter=False, handle_signals=False)
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+
+    def always_fails():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        ctrl._retry("save", always_fails)
+    assert sleeps == pytest.approx([0.01, 0.02, 0.04])
+
+
+def test_retry_total_elapsed_cap(tmp_path):
+    """max_elapsed_s bounds the retry loop's TOTAL wall time: with
+    attempts left, the loop still gives up once the cap is reached —
+    a scheduler's grace period does not wait for retries**mult."""
+    ctrl = resilience.TrainController(
+        None, str(tmp_path / "ck"), retries=1000, backoff_s=0.02,
+        retry_jitter=False, max_elapsed_s=0.1, handle_signals=False)
+    calls = [0]
+
+    def always_fails():
+        calls[0] += 1
+        raise OSError("down")
+
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        ctrl._retry("save", always_fails)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0                   # nowhere near 1000 retries
+    assert 1 < calls[0] < 20
+    assert any(r.get("event") == "retry_exhausted"
+               for r in observe.get_registry().recent)

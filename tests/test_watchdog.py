@@ -1,0 +1,695 @@
+"""Watchdog layer: deadlines, hang detection, abort-and-recover (ISSUE-10).
+
+Every breach path is DRIVEN, not trusted: a deterministic
+`resilience.FaultPlan.delay(...)` wedges one operation inside the very
+guard that must detect it — the train step, a collective, the data
+fetch, the checkpoint save/barrier, serving decode, the fleet publish —
+and the tests assert the escalation ladder (warn -> dump -> abort) fires,
+the hang bundle names the wedged frame, the abort resumes through
+`TrainController` with the loss curve intact, and a peer's hang verdict
+coordinates a fleet-wide abort-and-restore.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from singa_tpu import (fleet, health, layer, model as model_mod,  # noqa: E402
+                       observe, opt, overlap, resilience, tensor, watchdog)
+from singa_tpu.parallel.communicator import Communicator  # noqa: E402
+
+
+_OUT = "."  # per-test bundle dir (set by the autouse fixture below)
+
+
+@pytest.fixture(autouse=True)
+def _watchdog_hygiene(tmp_path):
+    # hang bundles default into the test's own tmp dir, never the CWD
+    global _OUT
+    _OUT = str(tmp_path / "bundles")
+    yield
+    resilience.clear_fault_plan()
+    watchdog.uninstall_watchdog()
+
+
+class Net(model_mod.Model):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = layer.Linear(16)
+        self.relu = layer.ReLU()
+        self.fc2 = layer.Linear(4)
+        self.sce = layer.SoftMaxCrossEntropy()
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+    def train_one_batch(self, x, y):
+        loss = self.sce(self.forward(x), y)
+        self.optimizer(loss)
+        return loss
+
+
+def _build(dev, seed=7, monitor=None):
+    dev.rng_state = jax.random.key(seed)
+    rng = np.random.RandomState(seed)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randint(0, 4, 16).astype(np.int32)
+    m = Net()
+    m.set_optimizer(opt.SGD(lr=0.1, momentum=0.9))
+    tx = tensor.from_numpy(X, dev)
+    ty = tensor.from_numpy(Y, dev)
+    m.compile([tx], is_train=True, use_graph=True, health=monitor)
+    return m, tx, ty
+
+
+def _install(**kw):
+    cfg = dict(action="abort", dump_at=1.5, abort_at=2.0, hard_at=100.0,
+               poll_interval_s=0.005, out_dir=_OUT)
+    cfg.update(kw)
+    return watchdog.install_watchdog(**cfg)
+
+
+# ---- deadline state & calibration ------------------------------------------
+
+def test_deadline_ops_enum_and_bad_op():
+    assert watchdog.DEADLINE_OPS == (
+        "step", "collective", "data_wait", "ckpt_save", "ckpt_wait",
+        "decode", "fleet_publish")
+    _install()
+    with pytest.raises(ValueError, match="DEADLINE_OPS"):
+        with watchdog.guard("bogus"):
+            pass
+    with pytest.raises(ValueError, match="not in"):
+        watchdog.Watchdog(deadlines={"bogus": 1.0}).close()
+    with pytest.raises(ValueError, match="warn"):
+        watchdog.Watchdog(action="explode")
+
+
+def test_calibration_p99_times_multiplier_with_clamps():
+    st = watchdog.OpDeadline("step", multiplier=10.0, floor_s=0.05,
+                             ceiling_s=1.0, min_samples=5)
+    for _ in range(4):
+        st.add_sample(0.01)
+    assert st.deadline() is None          # disarmed until warmed up
+    st.add_sample(0.01)
+    assert st.deadline() == pytest.approx(0.1)   # p99 x multiplier
+    for _ in range(20):
+        st.add_sample(0.5)
+    assert st.deadline() == 1.0           # ceiling clamp
+    tiny = watchdog.OpDeadline("step", multiplier=10.0, floor_s=0.05,
+                               ceiling_s=1.0, min_samples=2)
+    tiny.add_sample(1e-4)
+    tiny.add_sample(1e-4)
+    assert tiny.deadline() == 0.05        # floor clamp
+
+
+def test_static_deadline_overrides_calibration():
+    st = watchdog.OpDeadline("collective", static=0.25, min_samples=1)
+    assert st.deadline() == 0.25
+    st.add_sample(10.0)
+    assert st.deadline() == 0.25          # samples never move a static
+
+
+def test_guard_is_noop_without_watchdog():
+    assert watchdog.get_watchdog() is None
+    with watchdog.guard("step"):
+        pass                              # no error, no thread, no state
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("singa-watchdog")]
+
+
+def test_guard_feeds_calibration_and_build_spans_taint():
+    wd = _install(min_samples=2, floor_s=0.001, ceiling_s=10.0)
+    with watchdog.guard("step"):
+        with observe.span("introspect.build"):   # a compile inside
+            pass
+    assert len(wd.op_state("step").samples) == 0  # tainted: excluded
+    with watchdog.guard("step"):
+        pass
+    with watchdog.guard("step"):
+        pass
+    assert len(wd.op_state("step").samples) == 2
+    assert wd.op_state("step").deadline() is not None
+
+
+def test_nested_same_op_guard_counts_once():
+    wd = _install(min_samples=1, floor_s=0.001)
+    with watchdog.guard("step"):
+        with watchdog.guard("step"):      # inner guard: passthrough
+            pass
+        assert len(wd.armed()) == 1
+    assert len(wd.op_state("step").samples) == 1
+
+
+def test_breached_samples_never_feed_calibration():
+    wd = _install(deadlines={"collective": 0.02}, action="warn",
+                  min_samples=1)
+    with watchdog.guard("collective"):
+        time.sleep(0.08)                  # breaches (warn only)
+    assert len(wd.op_state("collective").samples) == 0
+    assert wd.op_state("collective").breaches >= 1
+
+
+# ---- the escalation ladder, per op, FaultPlan-driven -----------------------
+
+def test_warn_breach_via_wedged_data_fetch(dev):
+    """FaultPlan.delay("data.next") stalls Model.fit's fetch inside the
+    data_wait guard; under action="warn" training continues and the
+    breach is counted + event-logged."""
+    m, tx, ty = _build(dev)
+    _install(deadlines={"data_wait": 0.05}, action="warn")
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("data.next", 0.15, nth=2))
+    losses = m.fit([(tx, ty)] * 3, epochs=1)
+    assert len(losses) == 1               # run completed, nothing raised
+    reg = observe.get_registry()
+    assert reg.get("singa_watchdog_breach_total"
+                   ).value(op="data_wait") >= 1
+    assert reg.get("singa_watchdog_dump_total") is None \
+        or reg.get("singa_watchdog_dump_total").value(op="data_wait") == 0
+    assert any(r.get("kind") == "watchdog" and r.get("event") == "breach"
+               for r in reg.recent)
+
+
+def test_dump_breach_writes_hang_bundle(dev, tmp_path):
+    """The dump stage writes a flight-recorder-style bundle naming the
+    wedged thread + frame, round-tripped by load_hang_bundle and named
+    under the /flightz pattern."""
+    m, tx, ty = _build(dev)
+    wd = _install(deadlines={"data_wait": 0.05}, action="dump",
+                  out_dir=str(tmp_path))
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("data.next", 0.4, nth=2))
+    m.fit([(tx, ty)] * 3, epochs=1)       # dump never raises
+    reg = observe.get_registry()
+    assert reg.get("singa_watchdog_dump_total"
+                   ).value(op="data_wait") == 1
+    bundles = [f for f in os.listdir(tmp_path)
+               if f.startswith("flight_hang_data_wait")
+               and f.endswith(".jsonl")]
+    assert len(bundles) == 1
+    path = str(tmp_path / bundles[0])
+    b = watchdog.load_hang_bundle(path)
+    assert b["header"]["op"] == "data_wait"
+    assert b["header"]["n_threads"] == len(b["threads"]) >= 1
+    wedged = [t for t in b["threads"] if t.get("wedged")]
+    assert len(wedged) == 1               # names the stuck thread...
+    frames = " ".join(f["func"] for f in wedged[0]["frames"])
+    assert "fire" in frames or "fit" in frames  # ...inside the wedge
+    assert os.path.exists(path + ".stacks.txt")  # faulthandler sidecar
+    assert wd.last_bundle == path
+
+
+def test_abort_raises_hangerror_and_notes_monitor(dev, tmp_path):
+    """The abort stage: note_external(KIND_HANG) on the active monitor
+    and a HangError delivered at the guard's exit."""
+    mon = health.HealthMonitor(policy="warn", out_dir=str(tmp_path))
+    m, tx, ty = _build(dev, monitor=mon)
+    _install(deadlines={"data_wait": 0.05}, out_dir=str(tmp_path))
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("data.next", 0.4, nth=2))
+    with pytest.raises(watchdog.HangError) as ei:
+        m.fit([(tx, ty)] * 3, epochs=1)
+    e = ei.value
+    assert e.op == "data_wait" and e.seconds >= 0.05
+    assert e.bundle_path and os.path.exists(e.bundle_path)
+    assert isinstance(e, health.HealthError)   # rides the same plumbing
+    reg = observe.get_registry()
+    assert reg.get("singa_watchdog_abort_total"
+                   ).value(op="data_wait") == 1
+    assert reg.get("singa_health_anomaly_total"
+                   ).value(kind=health.KIND_HANG) == 1
+    assert any(r.get("anomaly_kinds") == [health.KIND_HANG]
+               for r in mon.recorder.ring)
+
+
+def test_collective_breach_via_wedged_allreduce():
+    """A wedged collective (the canonical hang: a peer died
+    mid-allreduce) breaches the guard inside _comm_stamp on the eager
+    path."""
+    _install(deadlines={"collective": 0.05})
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("comm.collective", 0.3, nth=2))
+    comm = Communicator()                 # world 1: eager per-call stamp
+    tick = jnp.ones(())
+    comm.all_reduce(tick)                 # fast: arms + disarms cleanly
+    with pytest.raises(watchdog.HangError) as ei:
+        comm.all_reduce(tick)
+    assert ei.value.op == "collective"
+    assert observe.get_registry().get(
+        "singa_watchdog_abort_total").value(op="collective") == 1
+
+
+def test_ckpt_wait_breach_via_wedged_barrier():
+    """A durability barrier waiting on a write that will never land
+    breaches the ckpt_wait guard in overlap.wait_for_checkpoints."""
+
+    class _FakeCk:
+        def wait_until_finished(self):
+            pass
+
+    _install(deadlines={"ckpt_wait": 0.05}, action="warn")
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("ckpt.wait", 0.15))
+    overlap._register_pending(overlap._PendingSave(_FakeCk(), "/tmp/x"))
+    overlap.wait_for_checkpoints()
+    assert observe.get_registry().get(
+        "singa_watchdog_breach_total").value(op="ckpt_wait") >= 1
+
+
+def test_ckpt_save_breach_via_controller(dev, tmp_path):
+    m, tx, ty = _build(dev)
+    _install(deadlines={"ckpt_save": 0.05}, action="warn")
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("ckpt.save", 0.15, nth=1))
+    ctrl = resilience.TrainController(
+        m, str(tmp_path / "ck"), save_every_steps=2,
+        handle_signals=False)
+    report = ctrl.fit([(tx, ty)] * 3, epochs=1)
+    assert report["status"] == "completed"
+    assert observe.get_registry().get(
+        "singa_watchdog_breach_total").value(op="ckpt_save") >= 1
+
+
+def test_fleet_publish_breach(tmp_path):
+    _install(deadlines={"fleet_publish": 0.05}, action="warn")
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("fleet.publish", 0.15, nth=1))
+    w = fleet.ShardWriter(str(tmp_path), interval_s=0)
+    try:
+        w.publish()
+    finally:
+        w.close(final_publish=False)
+    assert observe.get_registry().get(
+        "singa_watchdog_breach_total").value(op="fleet_publish") >= 1
+
+
+def test_decode_breach_via_wedged_serving(dev):
+    from singa_tpu import models
+    m = models.create_model("gpt", vocab_size=17, max_seq=16, dim=32,
+                            num_heads=2, num_layers=1)
+    ids = tensor.from_numpy(
+        np.random.RandomState(0).randint(0, 17, (1, 4)).astype(np.int32),
+        device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    prompt = np.random.RandomState(1).randint(0, 17, (1, 4))
+    _install(deadlines={"decode": 0.05}, action="warn")
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("serving.decode", 0.15, nth=2))
+    m.generate(prompt, 2, temperature=0.0)   # warm (compile outside)
+    m.generate(prompt, 2, temperature=0.0)   # wedged -> warn breach
+    assert observe.get_registry().get(
+        "singa_watchdog_breach_total").value(op="decode") >= 1
+
+
+# ---- abort-and-recover through the controller ------------------------------
+
+def test_abort_resumes_through_controller_curve_matches(dev, tmp_path):
+    """ACCEPTANCE: a wedged step aborts, the controller restores the
+    last durable checkpoint and replays, and the post-resume loss curve
+    matches the uninterrupted run exactly."""
+    data_n = 8
+    m0, tx, ty = _build(dev)
+    ref = resilience.TrainController(
+        m0, str(tmp_path / "ref"), save_every_steps=2,
+        handle_signals=False).fit([(tx, ty)] * data_n, epochs=1)
+    assert ref["status"] == "completed"
+
+    m1, tx, ty = _build(dev)              # fresh model, same seed
+    _install(deadlines={"step": 0.05}, out_dir=str(tmp_path))
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("step", 0.4, step=4))
+    ctrl = resilience.TrainController(
+        m1, str(tmp_path / "ck"), save_every_steps=2,
+        handle_signals=False)
+    report = ctrl.fit([(tx, ty)] * data_n, epochs=1)
+    assert report["status"] == "completed"
+    assert report["restarts"] == 1
+    # the hang landed on step 4 right after the cadence save at step 4
+    # settled: the restart restored it and lost zero steps
+    assert report["resumed_step"] == 4
+    reg = observe.get_registry()
+    assert reg.get("singa_watchdog_abort_total").value(op="step") == 1
+    assert any(r.get("event") == "hang_restart" for r in reg.recent)
+    base = dict((int(k), float(v)) for k, v in ref["history"])
+    got = dict((int(k), float(v)) for k, v in report["history"])
+    assert sorted(got) == sorted(base)
+    np.testing.assert_allclose(
+        [got[k] for k in sorted(got)], [base[k] for k in sorted(base)],
+        rtol=1e-6, atol=1e-7)
+
+
+def test_abort_exhausted_restarts_falls_to_halt_path(dev, tmp_path):
+    """Once max_restarts is spent, a hang stops being restartable: the
+    halt path saves a final checkpoint and re-raises with the report."""
+    m, tx, ty = _build(dev)
+    _install(deadlines={"step": 0.04})
+    # wedge EVERY attempt at step 2 (the restart replays into the same
+    # wedge — a peer that stays gone), with saves at steps 1 and 2 on
+    # disk so the first restart has something to restore
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("step", 0.3, step=2, times=10))
+    ctrl = resilience.TrainController(
+        m, str(tmp_path / "ck"), save_every_steps=1, max_restarts=1,
+        handle_signals=False)
+    with pytest.raises(watchdog.HangError) as ei:
+        ctrl.fit([(tx, ty)] * 4, epochs=1)
+    rep = ei.value.resilience
+    assert rep["status"] == "halted"
+    assert rep["restarts"] == 1
+    path, man = resilience.latest_checkpoint(str(tmp_path / "ck"))
+    assert man["step"] == 2               # the restore point is durable
+
+
+# ---- fleet-coordinated abort-and-restore -----------------------------------
+
+def _write_peer_shard(fleet_dir, host, hang):
+    """Craft a peer worker's telemetry shard carrying a hang verdict."""
+    lines = [
+        {"kind": "fleet_shard_header", "version": fleet.SHARD_VERSION,
+         "seq": 1, "host": host, "pid": 99999,
+         "ts": round(time.time(), 6),
+         "perf": round(time.perf_counter(), 7),
+         "started_ts": round(time.time(), 6), "steps": 5},
+        {"kind": "fleet_metrics", "metrics": {}},
+        {"kind": "fleet_goodput", "goodput": None},
+        {"kind": "fleet_health", "verdict": None},
+        {"kind": "fleet_mem", "mem": None},
+        {"kind": "fleet_hang", "hang": hang},
+    ]
+    path = os.path.join(fleet_dir, f"worker_{host}{fleet.SHARD_SUFFIX}")
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def test_hang_verdict_rides_own_shard(tmp_path):
+    """This process's watchdog verdict is published in its telemetry
+    shard and the aggregator marks the worker WEDGED (its own verdict
+    never self-escalates)."""
+    _install(deadlines={"collective": 0.03})
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("comm.collective", 0.2, nth=1))
+    comm = Communicator()
+    with pytest.raises(watchdog.HangError):
+        comm.all_reduce(jnp.ones(()))
+    w = fleet.ShardWriter(str(tmp_path), interval_s=0)
+    try:
+        w.publish()
+    finally:
+        w.close(final_publish=False)
+    shard = fleet.read_shard(w.path)
+    assert shard["hang"]["op"] == "collective"
+    assert shard["hang"]["stage"] == "abort"
+    agg = fleet.FleetAggregator(str(tmp_path), stale_after_s=60.0)
+    roll = agg.poll()
+    assert roll["wedged"] == [w.host]
+    assert roll["workers"][0]["hang"]["op"] == "collective"
+    assert agg.peer_hang() is None        # own host: never a peer hang
+    fleet.install_aggregator(aggregator=agg)
+    assert "WEDGED" in fleet.fleet_report()
+
+
+def test_peer_hang_escalates_once(tmp_path):
+    _write_peer_shard(str(tmp_path), "peer9",
+                      {"id": 3, "op": "collective", "stage": "abort",
+                       "seconds": 1.2, "deadline": 0.3,
+                       "ts": time.time()})
+    agg = fleet.FleetAggregator(str(tmp_path), stale_after_s=60.0)
+    agg.poll()
+    h = agg.peer_hang()
+    assert h and h["host"] == "peer9" and h["op"] == "collective"
+    assert agg.take_peer_hang() == h
+    agg.poll()                            # same (host, id): consumed
+    assert agg.take_peer_hang() is None
+    _write_peer_shard(str(tmp_path), "peer9",
+                      {"id": 4, "op": "step", "stage": "abort",
+                       "seconds": 2.0, "deadline": 0.3,
+                       "ts": time.time()})
+    agg.poll()                            # a NEW episode escalates again
+    assert agg.take_peer_hang()["op"] == "step"
+    # warn/dump-stage verdicts never escalate: the worker may recover
+    _write_peer_shard(str(tmp_path), "peer7",
+                      {"id": 1, "op": "step", "stage": "warn",
+                       "seconds": 0.4, "deadline": 0.3,
+                       "ts": time.time()})
+    agg.poll()
+    assert agg.take_peer_hang() is None
+
+
+def test_peer_hang_coordinates_restore_through_controller(dev, tmp_path):
+    """ACCEPTANCE: a peer's wedged-collective verdict arrives through
+    the fleet spool and THIS worker aborts-and-restores in lockstep —
+    restore from its own latest checkpoint, replay, complete."""
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    fleet.install_aggregator(str(spool), poll_interval_s=0.0,
+                             stale_after_s=60.0)
+    m, tx, ty = _build(dev)
+    planted = []
+
+    class Src:
+        def __iter__(self):
+            for i in range(6):
+                if i == 3 and not planted:
+                    planted.append(_write_peer_shard(
+                        str(spool), "peerH",
+                        {"id": 1, "op": "collective", "stage": "abort",
+                         "seconds": 0.9, "deadline": 0.3,
+                         "ts": time.time()}))
+                yield (tx, ty)
+
+    ctrl = resilience.TrainController(
+        m, str(tmp_path / "ck"), save_every_steps=1,
+        handle_signals=False)
+    report = ctrl.fit(Src(), epochs=1)
+    assert report["status"] == "completed"
+    assert report["restarts"] == 1
+    assert report["final_step"] == 6
+    reg = observe.get_registry()
+    assert any(r.get("event") == "peer_hang"
+               and r.get("host") == "peerH" for r in reg.recent)
+    assert any(r.get("event") == "hang_restart"
+               and r.get("hosts") == ["peerH"] for r in reg.recent)
+
+
+# ---- hard fallback ---------------------------------------------------------
+
+def test_hard_abort_injects_async_exception():
+    """A thread that never re-enters a guard exit still gets the abort:
+    the async-exception fallback lands at its next bytecode boundary."""
+    _install(deadlines={"step": 0.05}, abort_at=1.5, hard_at=2.5)
+    caught = []
+
+    def wedged():
+        try:
+            with watchdog.guard("step"):
+                for _ in range(600):      # ~6s: never exits in time
+                    time.sleep(0.01)
+        except watchdog.HangError as e:
+            caught.append(e)
+
+    t = threading.Thread(target=wedged, name="wedge-victim")
+    t.start()
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert caught and isinstance(caught[0], watchdog.HangError)
+    assert observe.get_registry().get(
+        "singa_watchdog_hard_abort_total").value(op="step") == 1
+
+
+# ---- telemetry & hygiene ---------------------------------------------------
+
+def test_compile_count_stays_one_with_watchdog(dev):
+    _install(floor_s=600.0)               # nothing can breach
+    m, tx, ty = _build(dev)
+    for _ in range(3):
+        m(tx, ty)
+    reg = observe.get_registry()
+    c = reg.get("singa_model_compile_total")
+    assert sum(v for _n, _k, v in c.samples()) == 1
+    assert reg.get("singa_model_recompile_total") is None
+    assert len(watchdog.get_watchdog().op_state("step").samples) >= 2
+
+
+def test_watchdog_report_and_statusz_section():
+    wd = _install(deadlines={"step": 0.5})
+    rep = watchdog.watchdog_report()
+    assert "== watchdog ==" in rep
+    assert "step" in rep and "static" in rep and "warming" in rep
+    assert "last breach: none" in rep
+    watchdog.uninstall_watchdog()
+    assert "not installed" in watchdog.watchdog_report()
+    assert wd.hang_report() is None
+
+
+def test_uninstall_joins_thread_and_detaches_listener():
+    wd = _install()
+    name = wd._thread.name
+    assert any(t.name == name for t in threading.enumerate())
+    watchdog.uninstall_watchdog()
+    assert not any(t.name == name for t in threading.enumerate())
+    # the span-enter taint listener is gone: spans no longer reach it
+    with observe.span("introspect.build"):
+        pass                              # no error, no state
+    assert watchdog.get_watchdog() is None
+    watchdog.uninstall_watchdog()         # idempotent
+
+
+def test_operation_error_outranks_abort(dev):
+    """When the wedged op itself raises, its error wins — the abort is
+    consumed silently instead of masking the root cause."""
+    wd = _install(deadlines={"collective": 0.03})
+
+    class Boom(RuntimeError):
+        pass
+
+    with pytest.raises(Boom):
+        with watchdog.guard("collective"):
+            time.sleep(0.12)              # abort threshold crossed
+            raise Boom("the op's own failure")
+    # and the next clean guard does not inherit a stale abort
+    with watchdog.guard("collective"):
+        pass
+    assert wd is watchdog.get_watchdog()
+
+
+def test_armed_table_and_deadline_gauge():
+    wd = _install(deadlines={"step": 5.0})
+    with watchdog.guard("step"):
+        armed = wd.armed()
+        assert len(armed) == 1
+        assert armed[0]["op"] == "step"
+        assert armed[0]["deadline"] == 5.0
+    assert wd.armed() == []
+    assert observe.get_registry().get(
+        "singa_watchdog_deadline_seconds").value(op="step") == 5.0
+
+
+# ---- the full hang A/B (subprocess harness) --------------------------------
+
+@pytest.mark.slow
+def test_hang_ab_harness(tmp_path):
+    """The 3-worker hang A/B end to end: one FaultPlan-wedged
+    collective, detection + coordinated abort-and-restore asserted from
+    the coordinator's HTTP surface, HANG record written."""
+    import subprocess
+    out = str(tmp_path / "HANG_test.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "singa_tpu.watchdog", "--ab",
+         "--out", out, "--timeout", "240"],
+        cwd=_ROOT, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out, encoding="utf-8") as f:
+        rec = json.load(f)
+    assert rec["ok"] is True
+    assert rec["hang_op"] == "collective"
+    assert rec["wedged_restarts"] >= 1
+    assert rec["coordinated"] is True
+    assert rec["max_abs_loss_delta"] < 1e-4
+
+
+# ---- review-driven hardening (ISSUE-10 review pass) ------------------------
+
+def test_take_abort_mid_escalation_still_delivers():
+    """Race fix: the checker sets stage=3 BEFORE abort_s lands; a guard
+    exiting in that window must still raise (the verdict is already on
+    its way to the fleet — peers restore, so this thread must too)."""
+    wd = _install(deadlines={"collective": 10.0})
+    g = watchdog.guard("collective")
+    g.__enter__()
+    g._entry.stage = 3                    # checker mid-abort: no abort_s
+    with pytest.raises(watchdog.HangError):
+        g.__exit__(None, None, None)
+
+
+def test_escalation_skips_disarmed_entries():
+    """Race fix: an entry the guard already exited (held in the
+    checker's in-flight due list) must not be escalated — worst case
+    was an async HangError injected into a thread running recovery."""
+    wd = _install(deadlines={"collective": 0.01})
+    with watchdog.guard("collective") as g:
+        entry = g._entry
+    assert entry.done
+    wd._escalate(entry, 5.0)              # stale due-list replay
+    assert entry.stage == 0 and entry.abort_s is None
+    reg = observe.get_registry()
+    c = reg.get("singa_watchdog_breach_total")
+    assert c is None or c.value(op="collective") == 0
+
+
+def test_failed_dump_is_retried_next_poll(dev, tmp_path):
+    """Fix: the dump stage advances only after the bundle LANDS, so a
+    transient dump failure is retried by a later poll instead of the
+    post-mortem silently never being written."""
+    m, tx, ty = _build(dev)
+    wd = _install(deadlines={"data_wait": 0.04}, action="dump",
+                  abort_at=50.0, out_dir=str(tmp_path))
+    calls = []
+    real = wd.dump_hang_bundle
+
+    def flaky(op, seconds, entry=None):
+        calls.append(op)
+        if len(calls) == 1:
+            raise OSError("disk hiccup")
+        return real(op, seconds, entry=entry)
+
+    wd.dump_hang_bundle = flaky
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("data.next", 0.5, nth=2))
+    m.fit([(tx, ty)] * 3, epochs=1)
+    assert len(calls) >= 2                # failed once, retried
+    assert observe.get_registry().get(
+        "singa_watchdog_dump_total").value(op="data_wait") == 1
+    assert any(f.startswith("flight_hang_data_wait")
+               for f in os.listdir(tmp_path))
+
+
+def test_recovery_retires_fleet_verdict_keeps_forensics(dev, tmp_path):
+    """Fix: a successful hang restart retires the FLEET-facing verdict
+    (the shard stops advertising WEDGED; a later-installed aggregator
+    cannot re-escalate the finished episode) while /statusz and worker
+    reports keep the sticky forensic record — and a NEW breach
+    un-retires."""
+    m, tx, ty = _build(dev)
+    wd = _install(deadlines={"step": 0.05}, out_dir=str(tmp_path))
+    resilience.install_fault_plan(
+        resilience.FaultPlan().delay("step", 0.4, step=4))
+    ctrl = resilience.TrainController(
+        m, str(tmp_path / "ck"), save_every_steps=2,
+        handle_signals=False)
+    report = ctrl.fit([(tx, ty)] * 8, epochs=1)
+    assert report["status"] == "completed" and report["restarts"] == 1
+    assert wd.hang_report() is None       # fleet verdict retired
+    assert wd.last_breach is not None     # forensics sticky
+    assert "last breach: {" in watchdog.watchdog_report()
+    w = fleet.ShardWriter(str(tmp_path / "spool"), interval_s=0)
+    try:
+        w.publish()
+    finally:
+        w.close(final_publish=False)
+    assert fleet.read_shard(w.path)["hang"] is None
+    # a fresh aggregator over the post-recovery spool sees no hang
+    agg = fleet.FleetAggregator(str(tmp_path / "spool"),
+                                stale_after_s=60.0)
+    roll = agg.poll()
+    assert roll["wedged"] == [] and agg.peer_hang() is None
+    # a new episode re-arms the verdict (step's deadline is static)
+    with pytest.raises(watchdog.HangError):
+        with watchdog.guard("step"):
+            time.sleep(0.3)
+    assert wd.hang_report() is not None
