@@ -56,7 +56,45 @@ def main():
                         "introspect) for the prefill/decode executables: "
                         "compile-phase times, HBM temp bytes, and the "
                         "recompile-blame history of this run")
+    p.add_argument("--serve", action="store_true",
+                   help="serving A/B: a seeded Poisson request workload "
+                        "with heterogeneous prompt/output lengths "
+                        "against the continuous-batching engine "
+                        "(singa_tpu.engine, paged KV cache) vs the "
+                        "static-batch baseline at EQUAL KV-cache HBM "
+                        "budget; reports sustained tokens/s and "
+                        "p50/p99 TTFT for both arms")
+    p.add_argument("--serve-requests", type=int, default=24,
+                   help="requests in the Poisson workload (per arm)")
+    p.add_argument("--serve-rps", type=float, default=None,
+                   help="mean arrival rate (default: sized so arrivals "
+                        "finish in ~2s wall)")
+    p.add_argument("--serve-seed", type=int, default=0,
+                   help="workload RNG seed (arrivals + lengths)")
+    p.add_argument("--serve-prompt-lens", default="8,48", metavar="LO,HI",
+                   help="uniform prompt-length range")
+    p.add_argument("--serve-new-lens", default="4,64", metavar="LO,HI",
+                   help="output-length range")
+    p.add_argument("--serve-new-dist", default="bimodal",
+                   choices=["uniform", "bimodal"],
+                   help="output-length distribution: uniform over "
+                        "[LO,HI], or bimodal (75%% short requests near "
+                        "LO, 25%% long near HI — the heavy-tailed shape "
+                        "production traffic has, and the one a static "
+                        "max-length batch pays for hardest)")
+    p.add_argument("--serve-slots", type=int, default=None,
+                   help="engine decode slots (default 2x --batch)")
+    p.add_argument("--serve-page-size", type=int, default=8,
+                   help="KV-cache page size (tokens)")
+    p.add_argument("--serve-steps-per-sync", type=int, default=4,
+                   help="decode steps between admission/eviction syncs")
+    p.add_argument("--serve-out", default=None, metavar="FILE",
+                   help="append the serve records as JSON lines "
+                        "(BENCHDEC_rNN.json style)")
     args = p.parse_args()
+
+    if args.serve:
+        return serve_main(args)
 
     import numpy as np
     import jax
@@ -237,6 +275,246 @@ def main():
             {"key": b["key"], "reason": b["reason"], "detail": b["detail"]}
             for b in introspect.blame_history()]
     print(json.dumps(rec))
+    return 0
+
+
+def _pct(xs, p):
+    from singa_tpu.engine import pctile
+    return pctile(xs, p)
+
+
+def serve_main(args):
+    """The --serve A/B: one seeded Poisson workload, two serving arms.
+
+    Arm 1 (engine): the continuous-batching ServingEngine — per-request
+    admission, paged KV cache sized to the SAME byte budget as the
+    baseline's static cache (num_pages * page_size == batch * T rows),
+    eviction at each request's own output length.
+
+    Arm 2 (static): the serving.py status quo — requests queue until
+    `--batch` of them form a batch (or the previous batch finished),
+    prompts pad to the max prompt length, and EVERY sequence decodes the
+    max output length; first tokens exist only when the whole batch
+    returns, which is what the TTFT numbers show.
+
+    tokens/s counts only USEFUL tokens (each request's own max_new) so
+    the static arm is not credited for the padding it decodes."""
+    import threading
+    import numpy as np
+
+    from singa_tpu import device, engine, models, observe, tensor
+
+    dev = device.best_device()
+    on_cpu = dev.is_host()
+    if on_cpu:
+        args.dim, args.layers = min(args.dim, 128), min(args.layers, 2)
+        args.vocab = min(args.vocab, 1024)
+        args.batch = min(args.batch, 4)
+    p_lo, p_hi = (int(x) for x in args.serve_prompt_lens.split(","))
+    n_lo, n_hi = (int(x) for x in args.serve_new_lens.split(","))
+    B = args.batch
+    T = p_hi + n_hi
+    ps = args.serve_page_size
+    slots = args.serve_slots or 2 * B
+    n_req = args.serve_requests
+    rps = args.serve_rps or max(4.0, n_req / 2.0)
+
+    m = models.create_model(
+        "gpt", vocab_size=args.vocab, max_seq=T, dim=args.dim,
+        num_heads=args.heads, num_layers=args.layers,
+        num_kv_heads=args.kv_heads,
+        pos_encoding="rope" if args.rope else "learned")
+    rng0 = np.random.RandomState(0)
+    ids = tensor.from_numpy(
+        rng0.randint(0, args.vocab, (B, p_hi)).astype(np.int32),
+        device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    dt = None if args.dtype == "float32" else args.dtype
+
+    # ---- the workload (shared by both arms, fully seeded) ---------------
+    rng = np.random.RandomState(args.serve_seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rps, n_req))
+    prompts = [rng.randint(0, args.vocab,
+                           (rng.randint(p_lo, p_hi + 1),)).astype(np.int32)
+               for _ in range(n_req)]
+    if args.serve_new_dist == "bimodal":
+        short_hi = max(n_lo + 1, n_lo + (n_hi - n_lo) // 4)
+        long_lo = max(short_hi, n_hi - (n_hi - n_lo) // 8)
+        is_long = rng.rand(n_req) < 0.25
+        new_lens = np.where(is_long,
+                            rng.randint(long_lo, n_hi + 1, n_req),
+                            rng.randint(n_lo, short_hi + 1, n_req))
+    else:
+        new_lens = rng.randint(n_lo, n_hi + 1, n_req)
+    useful = int(np.sum(new_lens))
+
+    def replay(submit_fn):
+        """Submit each request at its arrival offset; returns per-request
+        (arrive_ts, handle-ish)."""
+        t0 = time.perf_counter()
+        out = []
+        for i in range(n_req):
+            dt_s = t0 + arrivals[i] - time.perf_counter()
+            if dt_s > 0:
+                time.sleep(dt_s)
+            out.append((time.perf_counter(), submit_fn(i)))
+        return t0, out
+
+    # ---- arm 1: the continuous-batching engine --------------------------
+    num_pages = -(-B * T // ps)  # EQUAL HBM: pool rows == static rows
+    eng = engine.ServingEngine(
+        m, max_slots=slots, page_size=ps, num_pages=num_pages,
+        max_ctx=T, dtype=dt, kv_dtype=args.kv_dtype,
+        steps_per_sync=args.serve_steps_per_sync,
+        queue_limit=max(128, 2 * n_req)).start()
+    # warm every prompt bucket the workload will hit (+ the decode
+    # executable), so the timed arm measures serving, not XLA
+    for b in sorted({eng._bucket(len(pr)) for pr in prompts}):
+        w = eng.submit(np.zeros(min(b, T - 2), np.int32) + 1, 2)
+        if not w.wait(300):
+            raise RuntimeError(f"engine warmup (bucket {b}) stalled "
+                               "after 300s")
+    _t0, handles = replay(
+        lambda i: eng.submit(prompts[i], int(new_lens[i])))
+    stuck = [h.id for _, h in handles if not h.wait(600)]
+    if stuck:
+        # fail like the static arm does, not with a None-math crash or
+        # a silently bogus record built from half-finished handles
+        raise RuntimeError(
+            f"engine arm stalled: requests {stuck} not terminal "
+            "after 600s")
+    # handle timestamps share one clock (time.monotonic): wall = first
+    # submit -> last terminal
+    eng_wall = max((h.finished_ts or 0) for _, h in handles) \
+        - min(h.submitted for _, h in handles)
+    eng_done = [h for _, h in handles if h.outcome == "completed"]
+    eng_ttft = [h.ttft_s for _, h in handles if h.ttft_s is not None]
+    eng_tok = sum(len(h.tokens) for h in eng_done)
+    eng_report = eng.report()
+    eng.stop()
+
+    # ---- arm 2: static batching over the same schedule ------------------
+    # warmup = compile the one static signature
+    wp = rng0.randint(0, args.vocab, (B, p_hi)).astype(np.int32)
+    m.generate(wp, n_hi, temperature=0.0, dtype=dt,
+               kv_dtype=args.kv_dtype)
+
+    sq = []
+    sdone = {}
+    slock = threading.Lock()
+    sstop = threading.Event()
+
+    def static_worker():
+        while True:
+            with slock:
+                batch = sq[:B]
+                del sq[:len(batch)]
+            if not batch:
+                if sstop.is_set():
+                    return
+                time.sleep(0.002)
+                continue
+            mat = np.zeros((B, p_hi), np.int32)
+            for j, (i, _ts) in enumerate(batch):
+                mat[j, :len(prompts[i])] = prompts[i]
+            m.generate(mat, n_hi, temperature=0.0, dtype=dt,
+                       kv_dtype=args.kv_dtype)
+            tdone = time.perf_counter()
+            with slock:
+                for i, _ts in batch:
+                    sdone[i] = tdone
+
+    wt = threading.Thread(target=static_worker, daemon=True)
+    wt.start()
+
+    def static_submit(i):
+        with slock:
+            sq.append((i, time.perf_counter()))
+        return i
+
+    st0, shandles = replay(static_submit)
+    deadline = time.perf_counter() + 600
+    while True:
+        with slock:
+            if len(sdone) == n_req:
+                break
+            done_n = len(sdone)
+        if not wt.is_alive():
+            sstop.set()
+            raise RuntimeError(
+                f"static-arm worker died with {done_n}/{n_req} "
+                "requests finished (its m.generate raised — rerun "
+                "with a smaller config)")
+        if time.perf_counter() > deadline:
+            sstop.set()
+            raise RuntimeError(
+                f"static arm stalled: {done_n}/{n_req} after 600s")
+        time.sleep(0.005)
+    sstop.set()
+    wt.join(timeout=30)
+    st_wall = max(sdone.values()) - (st0 + float(arrivals[0]))
+    # a static batch emits its first token only when the whole batch
+    # call returns: TTFT = completion - arrival
+    st_ttft = [sdone[i] - (st0 + float(arrivals[i]))
+               for i in range(n_req)]
+
+    eng_tok_s = eng_tok / eng_wall if eng_wall > 0 else 0.0
+    st_tok_s = useful / st_wall if st_wall > 0 else 0.0
+    cfg = (f"d{args.dim}_l{args.layers}_v{args.vocab}_b{B}"
+           f"_p{p_lo}to{p_hi}_n{n_lo}to{n_hi}_r{n_req}"
+           + (f"_kv8" if args.kv_dtype == "int8" else "")
+           + ("_cpu" if on_cpu else ""))
+    base = {
+        "unit": "tokens/s",
+        "requests": n_req, "rps": round(rps, 2),
+        "prompt_lens": [p_lo, p_hi], "new_lens": [n_lo, n_hi],
+        "useful_tokens": useful,
+        "kv_budget_rows": B * T,
+        "device_kind": getattr(dev.jax_device, "device_kind", "")
+        or "unknown",
+    }
+    recs = [
+        {"metric": f"gpt_serve_engine_tok_s_{cfg}",
+         "value": round(eng_tok_s, 1), **base,
+         "completed": len(eng_done),
+         "slots": slots, "page_size": ps, "num_pages": num_pages,
+         "pool_mb": round(eng_report["pool_bytes"] / 1e6, 2),
+         "steps_per_sync": args.serve_steps_per_sync,
+         "ttft_p50_s": round(_pct(eng_ttft, 0.5), 4),
+         "ttft_p99_s": round(_pct(eng_ttft, 0.99), 4),
+         "wall_s": round(eng_wall, 3)},
+        {"metric": f"gpt_serve_static_tok_s_{cfg}",
+         "value": round(st_tok_s, 1), **base,
+         "batch": B, "decoded_tokens": n_req * n_hi,
+         "ttft_p50_s": round(_pct(st_ttft, 0.5), 4),
+         "ttft_p99_s": round(_pct(st_ttft, 0.99), 4),
+         "wall_s": round(st_wall, 3)},
+        {"metric": f"gpt_serve_speedup_x_{cfg}",
+         "value": round(eng_tok_s / st_tok_s, 3) if st_tok_s else None,
+         "unit": "x", "requests": n_req,
+         "ttft_p99_ratio": round(
+             _pct(st_ttft, 0.99) / _pct(eng_ttft, 0.99), 3)
+         if eng_ttft and _pct(eng_ttft, 0.99) > 0 else None},
+    ]
+    # TTFT as records of their OWN, not just fields: tools/bench_trend
+    # extracts top-level metric/value pairs only, so a latency series
+    # must be a record for the regression gate to see it across rounds
+    for arm, ttfts in (("engine", eng_ttft), ("static", st_ttft)):
+        for pname, p in (("p50", 0.5), ("p99", 0.99)):
+            v = _pct(ttfts, p)
+            if v is not None:
+                recs.append(
+                    {"metric": f"gpt_serve_{arm}_ttft_{pname}_s_{cfg}",
+                     "value": round(v, 4), "unit": "s",
+                     "requests": n_req, "rps": round(rps, 2)})
+    for rec in recs:
+        observe.record_bench(rec)
+        print(json.dumps(rec))
+    if args.serve_out:
+        with open(args.serve_out, "a", encoding="utf-8") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
     return 0
 
 

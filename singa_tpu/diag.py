@@ -168,6 +168,11 @@ class _Handler(BaseHTTPRequestHandler):
             parts.append(watchdog.watchdog_report())
         except Exception as e:
             parts.append(f"(watchdog unavailable: {e})")
+        try:
+            from . import engine
+            parts.append(engine.serving_report())
+        except Exception as e:
+            parts.append(f"(serving unavailable: {e})")
         mon = self._monitor()
         if mon is None:
             parts.append("== health ==\nno HealthMonitor attached")

@@ -84,8 +84,10 @@ REGION_UNATTRIBUTED = "unattributed"
 #: donated pre-step buffers died but before the new state is assigned
 #: back, so params would misattribute; steps ride the post-commit
 #: `observe.add_step_listener` hook instead. The serving decode span
-#: exit is the only moment the KV caches are live host-visible buffers.
-SNAPSHOT_SPAN_LEAVES = ("serving.decode",)
+#: exit is the only moment the KV caches are live host-visible buffers;
+#: the engine's per-sync step span keeps the page-pool occupancy on the
+#: /memz timeline for processes that only serve (no train steps).
+SNAPSHOT_SPAN_LEAVES = ("serving.decode", "serving.engine_step")
 
 #: top-K largest live arrays embedded in an OOM bundle
 OOM_TOP_K = 16
@@ -137,6 +139,17 @@ def unregister_provider(region: str, key):
     with _lock:
         _providers.pop(
             (region, id(key) if not isinstance(key, int) else key), None)
+
+
+def region_has_provider(region: str) -> bool:
+    """True when a persistent birth-site provider owns `region` — the
+    serving decode path consults this to skip its transient
+    note_arrays(kv_cache) once an engine's page pool is registered
+    (the provider is authoritative; a second transient claim would be
+    redundant weakref churn on every call)."""
+    _check_region(region)
+    with _lock:
+        return any(rg == region for (rg, _k) in _providers)
 
 
 def _iter_arrays(obj):
@@ -942,7 +955,8 @@ def memz_report() -> str:
 __all__ = [
     "MEM_REGIONS", "MemoryLedger", "LeakDetector",
     "install_ledger", "uninstall_ledger", "get_ledger", "reset",
-    "register_provider", "unregister_provider", "note_arrays",
+    "register_provider", "unregister_provider", "region_has_provider",
+    "note_arrays",
     "track_model", "track_optimizer", "track_prefetcher", "untrack",
     "total_live_bytes", "hbm_fallback_bytes",
     "is_resource_exhausted", "dump_oom_bundle",

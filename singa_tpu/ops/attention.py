@@ -1,5 +1,6 @@
-"""Attention ops: fused flash attention (Pallas) + ring attention (sequence
-parallel over a mesh axis).
+"""Attention ops: fused flash attention (Pallas), ring attention (sequence
+parallel over a mesh axis), and paged decode attention (the serving
+engine's ragged KV-cache path).
 
 No counterpart exists in the reference — it has no attention op at all
 (SURVEY.md §2.3: transformers enter only via ONNX import) — but long-context
@@ -867,6 +868,226 @@ def _ring_jnp(q, k, v, axis_name: str, causal=False, scale=None):
     # fully-masked rows (causal, early shards) have l == 0; guard division
     l = jnp.maximum(l, 1e-20)
     return (acc / l).astype(q.dtype)
+
+
+# ======================= 4. paged decode attention =======================
+#
+# The serving engine's ragged decode path (singa_tpu.engine): each active
+# sequence owns a host-assigned list of fixed-size KV-cache PAGES in a
+# shared pool, so a 32-token request stops reserving max-length HBM. The
+# attention here is the decode-side flash pattern — one packed query row
+# block per sequence, online softmax over its pages — with the page
+# table driving WHICH pool rows stream through VMEM (vLLM/PagedAttention
+# moved to Pallas scalar prefetch: the BlockSpec index map reads the
+# prefetched page table, so only the sequence's own pages are DMA'd).
+#
+# Two tiers, same math, mirroring flash_attention:
+#   paged_attention_reference — gather + masked softmax in jnp; ground
+#       truth, and the dispatch default off-TPU (a decode step is tiny;
+#       unrolling an interpret-mode grid into every scan step is not).
+#   _paged_fwd_pallas — PrefetchScalarGridSpec kernel, grid
+#       (seqs, packed-kv-heads, pages): K/V pages stream one at a time,
+#       pages at or beyond a sequence's length are neither computed nor
+#       DMA'd (the index map clamps to the last needed page, so the
+#       block index doesn't change and Pallas elides the copy).
+#
+# Layout matches the serving cache convention: queries arrive HEAD-PACKED
+# block-diagonal (N, Hp, Q, P*D) with Q = P*G rows (serving.py builds
+# them via _DecodeCore._pack_q), pools are (n_pages, Hp, page_size, P*D).
+# int8 KV is preserved: per-(head, position) scale pools ride along and
+# fold into scores/weights exactly as the dense token_step does.
+
+def _paged_factors(sc, groups, rows):
+    """(T?, P) per-position scales -> (rows, T?) row factors for packed
+    block-diagonal queries: row q = c*groups + g reads lane block c.
+    Rows beyond P*groups (query padding) get factor 1."""
+    pg = sc.shape[-1] * groups
+    f = jnp.repeat(sc.swapaxes(-1, -2), groups, axis=-2)  # (P*G, T)
+    if rows > pg:
+        pad = jnp.ones(f.shape[:-2] + (rows - pg, f.shape[-1]), f.dtype)
+        f = jnp.concatenate([f, pad], axis=-2)
+    return f
+
+
+def paged_attention_reference(q, k_pool, v_pool, page_table, lengths,
+                              page_size, scale=1.0, k_scales=None,
+                              v_scales=None, groups=1):
+    """Ground-truth paged decode attention.
+
+    q:          (N, Hp, Q, PD) packed block-diagonal queries (Q = P*G)
+    k_pool/v_pool: (n_pages, Hp, page_size, PD) shared page pools
+                (int8 when k_scales/v_scales are given)
+    page_table: (N, M) int32 — page ids per sequence, row-major in time
+    lengths:    (N,) int32 — valid KV positions per sequence (>= 1)
+    k_scales/v_scales: (n_pages, Hp, page_size, P) fp32 (int8 KV only)
+
+    Returns (N, Hp, Q, PD). The math is the dense token_step's masked
+    softmax over the gathered pages — gathers materialize a copy, which
+    is why the TPU path streams pages in the kernel instead."""
+    N, Hp, Q, PD = q.shape
+    M = page_table.shape[1]
+    T = M * page_size
+
+    def gather(pool):
+        g = pool[page_table]                   # (N, M, Hp, ps, PD/P)
+        g = jnp.moveaxis(g, 2, 1)              # (N, Hp, M, ps, ·)
+        return g.reshape(N, Hp, T, g.shape[-1])
+
+    ks = gather(k_pool)
+    vs = gather(v_pool)
+    kf = ks.astype(q.dtype) if ks.dtype == jnp.int8 else ks
+    vf = vs.astype(q.dtype) if vs.dtype == jnp.int8 else vs
+    s = jnp.einsum("nhqd,nhtd->nhqt", q, kf) * scale
+    if k_scales is not None:
+        s = s * _paged_factors(gather(k_scales), groups, Q)
+    valid = (lax.broadcasted_iota(jnp.int32, (1, 1, 1, T), 3)
+             < lengths[:, None, None, None])
+    a = jax.nn.softmax(jnp.where(valid, s, -jnp.inf), axis=-1)
+    if v_scales is not None:
+        a = a * _paged_factors(gather(v_scales), groups, Q)
+    return jnp.einsum("nhqt,nhtd->nhqd", a.astype(q.dtype),
+                      vf).astype(q.dtype)
+
+
+def _paged_fwd_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                      nM, page_size, groups, kv8):
+    """Grid (N, Hp, pages): stream one sequence's pages through VMEM and
+    run the online softmax. Pages past the sequence length are gated
+    (compute) and their DMA elided (index map re-addresses the last
+    needed page). CONTRACT: fully sequential grid — the scratch state
+    persists across the page dimension."""
+    if kv8:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+        ks_ref = vs_ref = None
+    n = pl.program_id(0)
+    pg = pl.program_id(2)
+
+    @pl.when(pg == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ln = len_ref[n]
+    needed = pg * page_size < ln
+
+    def _update():
+        # q arrives PRE-SCALED (the wrapper folds the softmax scale in,
+        # like flash); int8 K/V cast to the query dtype for native MXU
+        # dots, scales fold in exactly as the dense kv8 token_step does
+        q = q_ref[0, 0]                         # (Qp, PD)
+        k_blk = k_ref[0, 0].astype(q.dtype)     # (ps, PD)
+        v_blk = v_ref[0, 0].astype(q.dtype)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if kv8:
+            s = s * _paged_factors(ks_ref[0, 0], groups, s.shape[0])
+        pos = pg * page_size + lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(pos < ln, s, _NEG_INF)
+        m_prev = m_ref[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[...][:, :1] * corr \
+            + jnp.sum(p, axis=-1, keepdims=True)
+        if kv8:
+            p = p * _paged_factors(vs_ref[0, 0], groups, p.shape[0])
+        acc_ref[...] = acc_ref[...] * corr + jnp.dot(
+            p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    pl.when(needed)(_update)
+
+    @pl.when(pg == nM - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[...][:, :1], 1e-20)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_fwd_pallas(q, k_pool, v_pool, page_table, lengths, page_size,
+                      scale, k_scales, v_scales, groups, interpret):
+    N, Hp, Q, PD = q.shape
+    M = page_table.shape[1]
+    ps = page_size
+    kv8 = k_scales is not None
+    # pad query rows to the 8-sublane alignment; extra rows are zeros
+    # (their softmax output is garbage over a zero query — discarded)
+    Qp = max(8, Q + (-Q) % 8)
+    qf = (q * scale).astype(q.dtype)
+    if Qp != Q:
+        qf = jnp.concatenate(
+            [qf, jnp.zeros((N, Hp, Qp - Q, PD), qf.dtype)], axis=2)
+    lengths = jnp.maximum(lengths.astype(jnp.int32), 1)
+    pt = page_table.astype(jnp.int32)
+
+    def page_map(n, hp, pg, pt_ref, len_ref):
+        # clamp to the last needed page: fully-masked steps re-address
+        # it, so their DMA is elided (the block index doesn't change)
+        last = jnp.minimum((len_ref[n] - 1) // ps, M - 1)
+        return (pt_ref[n, jnp.minimum(pg, last)], hp, 0, 0)
+
+    def q_map(n, hp, pg, pt_ref, len_ref):
+        return (n, hp, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, Qp, PD), q_map),
+        pl.BlockSpec((1, 1, ps, PD), page_map),
+        pl.BlockSpec((1, 1, ps, PD), page_map),
+    ]
+    operands = [qf, k_pool, v_pool]
+    if kv8:
+        in_specs += [pl.BlockSpec((1, 1, ps, k_scales.shape[-1]),
+                                  page_map),
+                     pl.BlockSpec((1, 1, ps, v_scales.shape[-1]),
+                                  page_map)]
+        operands += [k_scales, v_scales]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(N, Hp, M),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, Qp, PD), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((Qp, PD), jnp.float32),
+            pltpu.VMEM((Qp, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((Qp, _STAT_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_fwd_kernel, nM=M, page_size=ps,
+                          groups=groups, kv8=kv8),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, Hp, Qp, PD), q.dtype),
+        interpret=interpret,
+    )(pt, lengths, *operands)
+    return out[:, :, :Q, :]
+
+
+def paged_attention(q, k_pool, v_pool, page_table, lengths, page_size,
+                    scale=1.0, k_scales=None, v_scales=None, groups=1,
+                    use_kernel=None):
+    """Paged decode attention: dispatch between the Pallas page-streaming
+    kernel and the gather-based reference (see paged_attention_reference
+    for shapes). `use_kernel=None` picks the kernel only on a real TPU
+    backend — off-TPU the kernel would run in interpret mode, unrolling
+    the whole (N, Hp, pages) grid into every traced decode step;
+    `use_kernel=True` forces it (interpret off-TPU, how the agreement
+    test exercises the kernel path), False forces the reference."""
+    N, Hp, Q, PD = q.shape
+    ps = int(page_size)
+    aligned = (ps % 8 == 0 and PD % 128 == 0)
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu" and aligned
+    if not use_kernel or not _HAS_PALLAS or not aligned:
+        return paged_attention_reference(
+            q, k_pool, v_pool, page_table, lengths, ps, scale,
+            k_scales, v_scales, groups)
+    interpret = jax.default_backend() != "tpu"
+    return _paged_fwd_pallas(q, k_pool, v_pool, page_table, lengths, ps,
+                             scale, k_scales, v_scales, groups, interpret)
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):

@@ -99,6 +99,8 @@ class FaultPlan:
       - "fleet.publish" (fleet.ShardWriter.publish) — inside the
                      fleet_publish guard
       - "serving.decode" (serving decode) — inside the decode guard
+      - "serving.engine_step" (engine.ServingEngine decode loop) —
+                     inside the decode guard, before each decode sync
 
     A `delay(...)` at any of these points is the deterministic stand-in
     for a wedged operation: it stalls inside the watchdog guard that

@@ -203,31 +203,36 @@ class _DecodeCore:
         import jax.numpy as jnp
         return jnp.repeat(sp.swapaxes(2, 3), G, axis=2)
 
-    def prefill(self, p, prompt, n):
-        """Causal pass over the (n, S0) prompt; returns the last-position
-        logits (n, V) and per-block head-packed KV caches of time-length
-        T, shape (n, H/P, T, P*D) (see class docstring).
+    def prefill_parts(self, p, prompt, n):
+        """Causal pass over the (n, S) prompt (S from the prompt shape,
+        so the serving engine's padded-bucket prompts reuse it): returns
+        the final hidden states h (n, S, E) and the per-block RAW
+        (rotated, unpacked) k/v (n, Hkv, S, D) — the shared front half
+        of both the dense `prefill` (which pads them into T-length
+        caches) and the engine's paged prefill (which scatters them into
+        pool pages).
 
-        Attention runs through the Pallas flash kernel (O(S0) score
+        Attention runs through the Pallas flash kernel (O(S) score
         memory — the same kernel the training path uses, GQA via repeat),
         so a 16k+-token prompt prefills on one chip instead of
-        materializing an (S0, S0) score matrix per head; short prompts
-        that don't tile the kernel fall back to the O(S0^2) reference
+        materializing an (S, S) score matrix per head; short prompts
+        that don't tile the kernel fall back to the O(S^2) reference
         path inside flash_attention itself."""
         import jax.numpy as jnp
         from .ops.attention import flash_attention
-        H, D, S0, T, P = self.H, self.E // self.H, self.S0, self.T, self.P
+        D = self.E // self.H
+        S = prompt.shape[1]
         ln = self.ln
-        h = p["emb"][prompt] + (0 if self.rope else p["pos"][:S0])
+        h = p["emb"][prompt] + (0 if self.rope else p["pos"][:S])
 
-        caches = []
-        Hkv, G = self.Hkv, self.G
+        kvs = []
+        G = self.G
         if self.rope:
             from .autograd import rope_tables, apply_rope
-            rcos, rsin = rope_tables(jnp.arange(S0), D, self.rope_theta)
+            rcos, rsin = rope_tables(jnp.arange(S), D, self.rope_theta)
         for li, bp in enumerate(p["blocks"]):
             x = ln(h, bp["g1"], bp["b1"])
-            q, k, v = self.qkv(bp, x, n, S0)    # q (n,H,·); kv (n,Hkv,·)
+            q, k, v = self.qkv(bp, x, n, S)     # q (n,H,·); kv (n,Hkv,·)
             if self.rope:
                 # rotate q/k; the cache stores ROTATED keys (standard),
                 # so decode steps only rotate their own position
@@ -236,10 +241,23 @@ class _DecodeCore:
             kr = jnp.repeat(k, G, axis=1) if G > 1 else k
             vr = jnp.repeat(v, G, axis=1) if G > 1 else v
             o = flash_attention(q, kr, vr, True, self.scale)
-            h = h + _mm(o.swapaxes(1, 2).reshape(n, S0, self.E),
+            h = h + _mm(o.swapaxes(1, 2).reshape(n, S, self.E),
                         bp["Wo"]) + bp["bo"]
             x = ln(h, bp["g2"], bp["b2"])
             h = h + self.mlp(bp, x, li)
+            kvs.append((k, v))
+        return h, kvs
+
+    def prefill(self, p, prompt, n):
+        """Causal pass over the (n, S0) prompt; returns the last-position
+        logits (n, V) and per-block head-packed KV caches of time-length
+        T, shape (n, H/P, T, P*D) (see class docstring)."""
+        import jax.numpy as jnp
+        D, S0, T, P = self.E // self.H, self.S0, self.T, self.P
+        Hkv = self.Hkv
+        h, kvs = self.prefill_parts(p, prompt, n)
+        caches = []
+        for k, v in kvs:
             if self.kv8:
                 k8, ks = self._quant_kv(k, n, S0)
                 v8, vs = self._quant_kv(v, n, S0)
@@ -257,8 +275,110 @@ class _DecodeCore:
                 Vc = jnp.zeros((n, Hkv // P, T, P * D), v.dtype) \
                     .at[:, :, :S0].set(self._pack(v, n, S0))
             caches.append((Kc, Vc))
-        logits0 = _mm(ln(h[:, -1], p["gf"], p["bf"]), p["head"])
+        logits0 = _mm(self.ln(h[:, -1], p["gf"], p["bf"]), p["head"])
         return logits0, caches
+
+    def _pack_q(self, q, n):
+        """(n, H, D) per-head queries -> packed BLOCK-DIAGONAL
+        (n, Hp, P*G, P*D): packed slot c holds kv head (hp*P + c)'s G
+        query rows in block c, zeros elsewhere — the full-width
+        contraction with the packed K then yields exactly the per-head
+        scores (GQA: G rows per block; MHA is the G=1 case)."""
+        import jax.numpy as jnp
+        D, P, G = self.E // self.H, self.P, self.G
+        Hp = self.Hkv // P
+        ar = jnp.arange(P)
+        q6 = jnp.moveaxis(q.reshape(n, Hp, P, G, D), 2, 0)
+        return jnp.zeros((n, Hp, P, G, P, D), q.dtype) \
+            .at[:, :, ar, :, ar, :].set(q6) \
+            .reshape(n, Hp, P * G, P * D)
+
+    def _unpack_o(self, O2, n):
+        """(n, Hp, P*G, P*D) packed attention output -> (n, E): extract
+        the DIAGONAL (own-head) blocks the packed contraction kept."""
+        import jax.numpy as jnp
+        D, P, G = self.E // self.H, self.P, self.G
+        Hp = self.Hkv // P
+        ar = jnp.arange(P)
+        return jnp.moveaxis(
+            O2.reshape(n, Hp, P, G, P, D)[:, :, ar, :, ar, :],
+            0, 2).reshape(n, self.E)
+
+    def paged_token_step(self, p, tok, pools, page_table, lens, active,
+                         n, page_size, n_pages, use_kernel=None):
+        """One ragged decode step against the PAGED KV cache (the
+        serving engine's hot path): feed token `tok` (n,) for each slot
+        at its own position `lens[i]`, write the new K/V row into the
+        slot's current page (inactive slots scatter out-of-bounds and
+        are DROPPED), and attend over each slot's pages via
+        ops.attention.paged_attention with per-slot lengths. Returns
+        (logits (n, V), new pools).
+
+        `pools` is a list per block: (K, V) of (n_pages, Hp, page_size,
+        P*D), or with kv8 ((K8, Ks), (V8, Vs)) carrying the fp32
+        per-(head, position) scale pools. Numerics match `token_step`
+        by construction: same qkv/rope/pack/extract helpers, same scale
+        folding — the paged==dense greedy agreement test leans on
+        this."""
+        import jax.numpy as jnp
+        from .ops.attention import paged_attention
+        D, E, P = self.E // self.H, self.E, self.P
+        G = self.G
+        ln = self.ln
+        ps = page_size
+        # clamp positions so an inactive slot's stale length can never
+        # index outside the table/pos-embedding (its output is masked)
+        pos = jnp.minimum(lens, self.T - 1)
+        h = p["emb"][tok] + (0 if self.rope else p["pos"][pos])
+        if self.rope:
+            from .autograd import rope_tables, apply_rope
+            rcos, rsin = rope_tables(pos, D, self.rope_theta)  # (n, D)
+            rcos, rsin = rcos[:, None, :], rsin[:, None, :]
+        nidx = jnp.arange(n)
+        # inactive slots write to page id n_pages: out of bounds, and
+        # the scatter uses mode="drop" — no trash page needed
+        pvec = jnp.where(active, page_table[nidx, pos // ps], n_pages)
+        off = pos % ps
+        ln_att = jnp.where(active, pos + 1, 1)
+        new_pools = []
+        for li, (bp, pool) in enumerate(zip(p["blocks"], pools)):
+            x = ln(h, bp["g1"], bp["b1"])
+            q, kn, vn = self.qkv(bp, x, n)   # q (n,H,D); kv (n,Hkv,D)
+            if self.rope:
+                q = apply_rope(q, rcos, rsin)
+                kn = apply_rope(kn, rcos, rsin)
+            if self.kv8:
+                (K8, Ks), (V8, Vs) = pool
+                k8, ks = self._quant_kv(kn[:, :, None], n, 1)
+                v8, vs = self._quant_kv(vn[:, :, None], n, 1)
+                K8 = K8.at[pvec, :, off, :].set(k8[:, :, 0], mode="drop")
+                Ks = Ks.at[pvec, :, off, :].set(ks[:, :, 0], mode="drop")
+                V8 = V8.at[pvec, :, off, :].set(v8[:, :, 0], mode="drop")
+                Vs = Vs.at[pvec, :, off, :].set(vs[:, :, 0], mode="drop")
+                pool = ((K8, Ks), (V8, Vs))
+                Kmat, Vmat, Ksc, Vsc = K8, V8, Ks, Vs
+            else:
+                K, V = pool
+                K = K.at[pvec, :, off, :].set(
+                    self._pack(kn[:, :, None], n, 1)[:, :, 0],
+                    mode="drop")
+                V = V.at[pvec, :, off, :].set(
+                    self._pack(vn[:, :, None], n, 1)[:, :, 0],
+                    mode="drop")
+                pool = (K, V)
+                Kmat, Vmat, Ksc, Vsc = K, V, None, None
+            Q2 = self._pack_q(q, n)
+            O2 = paged_attention(
+                Q2, Kmat, Vmat, page_table, ln_att, ps,
+                scale=self.scale, k_scales=Ksc, v_scales=Vsc,
+                groups=G, use_kernel=use_kernel)
+            o = self._unpack_o(O2.astype(x.dtype), n)
+            h = h + _mm(o, bp["Wo"]) + bp["bo"]
+            x = ln(h, bp["g2"], bp["b2"])
+            h = h + self.mlp(bp, x, li)
+            new_pools.append(pool)
+        logits = _mm(ln(h, p["gf"], p["bf"]), p["head"])
+        return logits, new_pools
 
     def token_step(self, p, tok, caches, i, n):
         """Feed token `tok` (n,) at generated-index `i` (position S0+i)
@@ -274,7 +394,6 @@ class _DecodeCore:
         pos_idx = self.S0 + i
         h = p["emb"][tok] + (0 if self.rope else p["pos"][pos_idx])
         kmask = (jnp.arange(self.T) <= pos_idx)
-        ar = jnp.arange(P)
         if self.rope:
             from .autograd import rope_tables, apply_rope
             rcos, rsin = rope_tables(pos_idx[None], D, self.rope_theta)
@@ -303,15 +422,10 @@ class _DecodeCore:
                 Vc = lax.dynamic_update_slice(
                     Vc, vn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
                 Kmat, Vmat = Kc, Vc
-            # block-diagonal queries: packed slot c holds kv head
-            # (hp*P + c)'s G query rows in block c, zeros elsewhere —
-            # the full-width contraction with the packed K then yields
-            # exactly the per-head scores (GQA: G rows per block; MHA is
-            # the G=1 case)
-            q6 = jnp.moveaxis(q.reshape(n, Hp, P, G, D), 2, 0)
-            Q2 = jnp.zeros((n, Hp, P, G, P, D), q.dtype) \
-                .at[:, :, ar, :, ar, :].set(q6) \
-                .reshape(n, Hp, P * G, P * D)
+            # block-diagonal queries (see _pack_q): the full-width
+            # contraction with the packed K yields exactly the per-head
+            # scores (GQA: G rows per block; MHA is the G=1 case)
+            Q2 = self._pack_q(q, n)
             s = jnp.einsum("nhqj,nhtj->nhqt", Q2, Kmat) * self.scale
             if self.kv8:
                 # K-scales: one factor per (source position, own block)
@@ -322,9 +436,7 @@ class _DecodeCore:
                 # (the only one extracted below)
                 a = (a * self._scale_rows(Vs, G)).astype(x.dtype)
             O2 = jnp.einsum("nhqt,nhtj->nhqj", a, Vmat)  # (n,Hp,P*G,P*D)
-            o = jnp.moveaxis(
-                O2.reshape(n, Hp, P, G, P, D)[:, :, ar, :, ar, :],
-                0, 2).reshape(n, E)
+            o = self._unpack_o(O2, n)
             h = h + _mm(o, bp["Wo"]) + bp["bo"]
             x = ln(h, bp["g2"], bp["b2"])
             h = h + self.mlp(bp, x, li)
@@ -614,9 +726,15 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
             # fused beam program never surfaces its caches) — the
             # ledger's serving.decode snapshot attributes them here.
             # Gated on an installed ledger: without a consumer, the
-            # per-array weakref churn would tax every decode call
+            # per-array weakref churn would tax every decode call.
+            # When a serving engine's page pool owns the kv_cache
+            # region (a persistent provider), the transient note is
+            # superseded — the pool provider is authoritative and the
+            # per-call weakref churn buys nothing
             from . import memory
-            if memory.get_ledger() is not None:
+            if memory.get_ledger() is not None and \
+                    not memory.region_has_provider(
+                        memory.REGION_KV_CACHE):
                 memory.note_arrays(memory.REGION_KV_CACHE, caches)
             if max_new > 1:
                 with observe.span("serving.decode_scan", batch=B,

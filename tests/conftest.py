@@ -52,11 +52,12 @@ def _metrics_isolation():
     HTTP ports, server threads, or span listeners — and (ISSUE-5)
     asserts the test left no async checkpoint pending, no prefetcher
     thread alive, and no stray non-daemon thread behind."""
-    from singa_tpu import (diag, fleet, goodput, health, introspect,
-                           memory, observe, watchdog)
+    from singa_tpu import (diag, engine, fleet, goodput, health,
+                           introspect, memory, observe, watchdog)
     diag.stop_diag_server()
     goodput.uninstall()
     fleet.uninstall()
+    engine.reset()
     memory.reset()
     watchdog.uninstall_watchdog()
     health.set_active_monitor(None)
@@ -79,6 +80,19 @@ def _metrics_isolation():
     assert not leaked_wd, (
         f"watchdog thread(s) left running: {leaked_wd} — call "
         "watchdog.uninstall_watchdog() before the test ends")
+    # serving-engine teardown (ISSUE-11): every live engine stopped —
+    # the admission queue drained (in-flight requests finished
+    # "evicted"), the singa-serve-* decode thread joined, the page pool
+    # freed and its kv_cache provider unregistered. Capture-then-clean
+    # like the fleet/memory checks: the leak is recorded first and
+    # cleaned regardless, so one leaky test fails itself without
+    # cascading into the suite.
+    leaked_serve = [t.name for t in threading.enumerate()
+                    if t.is_alive() and t.name.startswith("singa-serve")]
+    engine.reset()
+    assert not leaked_serve, (
+        f"serving-engine thread(s) left running: {leaked_serve} — call "
+        "ServingEngine.stop() (or engine.reset()) before the test ends")
     # memory-ledger teardown (ISSUE-9): the ledger uninstalled (its
     # step/span listeners detached, the sampler thread joined) and all
     # region providers/transient notes dropped. Leaked sampler threads

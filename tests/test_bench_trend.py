@@ -125,6 +125,37 @@ def test_bytes_metrics_default_to_lower_is_better():
     assert not bench_trend.lower_is_better("kv_bytes", "bytes/s")
 
 
+def test_ttft_and_percentile_metrics_lower_is_better():
+    """ISSUE-11 satellite: serving latencies regress UP — `ttft`
+    anywhere in the name (even unit-less, how a round might write a
+    derived field) and `_p50`/`_p99` percentile suffixes; rate units
+    still win so a throughput metric can never be misread."""
+    assert bench_trend.lower_is_better("engine_ttft_p99_s", "s")
+    assert bench_trend.lower_is_better("toy_serve_ttft_p99", "")
+    assert bench_trend.lower_is_better("baseline_ttft_p50", "")
+    assert bench_trend.lower_is_better("decode_step_p99", "")
+    assert not bench_trend.lower_is_better("toy_serve_engine_tok_s",
+                                           "tokens/s")
+
+
+def test_ttft_fixture_regression_flagged():
+    """The checked-in SERVE fixtures carry a unit-less ttft p99 series:
+    improving in clean/ (no flag), +50% in regress/ (flagged UP) — a
+    serving-latency slide trips the trend gate like a training one."""
+    clean = bench_trend.trend_table(bench_trend.collect([CLEAN]))
+    assert clean["toy_serve_ttft_p99"]["by_round"] == {1: 0.030,
+                                                      2: 0.028}
+    assert not [r for r in bench_trend.find_regressions(clean)
+                if r[0] == "toy_serve_ttft_p99"]
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = {m: (rnd, v, best_r, best, delta)
+            for m, rnd, v, best_r, best, delta
+            in bench_trend.find_regressions(table, threshold=0.05)}
+    rnd, v, best_r, best, delta = regs["toy_serve_ttft_p99"]
+    assert (rnd, v, best_r, best) == (2, 0.045, 1, 0.030)
+    assert abs(delta - 0.5) < 1e-9
+
+
 def test_bytes_fixture_regression_flagged():
     """The checked-in fixtures carry a toy_hbm_bytes series: flat in
     clean/ (no flag), +50% in regress/ (flagged UP against the best —

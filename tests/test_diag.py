@@ -133,6 +133,11 @@ def test_statusz_golden_sections(served):
     # this fixture, so the pointer line is the golden content)
     assert "== watchdog ==" in body
     assert "not installed" in body
+    # ISSUE-11: the serving section (no engine in this fixture, so the
+    # pointer line is the golden content; the live-engine body is
+    # covered in tests/test_engine.py)
+    assert "== serving ==" in body
+    assert "no ServingEngine running" in body
     assert "== health ==" in body
 
 

@@ -387,3 +387,50 @@ def test_lint_covers_watchdog_metric_names():
     enums_obs, _ = check_metrics_names._module_enum_info(
         ast.parse(open(obs_py).read()))
     assert "other" in enums_obs["COMM_OPS"]
+
+
+def test_lint_covers_engine_metric_names():
+    """ISSUE-11: rule 5 extends to the serving engine's `outcome=`
+    label — REQUEST_OUTCOMES is recognized as the declared enum tuple,
+    every singa_serve_* registration in engine.py passes the full lint,
+    and an undeclared outcome literal is rejected."""
+    eng_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                          "engine.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(eng_py)}
+    assert {"singa_serve_requests_total", "singa_serve_admitted_total",
+            "singa_serve_tokens_total", "singa_serve_steps_total",
+            "singa_serve_prefills_total", "singa_serve_queue_depth",
+            "singa_serve_slot_occupancy", "singa_serve_pages_in_use",
+            "singa_serve_page_pool_pages",
+            "singa_serve_queue_delay_seconds",
+            "singa_serve_ttft_seconds", "singa_serve_request_seconds",
+            "singa_serve_request_tokens_per_sec",
+            "singa_serve_slots"} <= names
+    assert all(n.startswith("singa_serve_") for n in names)
+    assert check_metrics_names.check([eng_py]) == []
+    import ast
+    enums, _consts = check_metrics_names._module_enum_info(
+        ast.parse(open(eng_py).read()))
+    assert enums["REQUEST_OUTCOMES"] == ("completed", "evicted",
+                                         "rejected", "timeout")
+    assert "outcome" in check_metrics_names.ENUM_LABEL_KWARGS
+
+
+def test_outcome_label_rule(tmp_path):
+    """An outcome= literal not in a declared enum tuple is a violation;
+    a member and an enum-guarded dynamic value pass."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "OUTCOMES = ('completed', 'evicted')\n"
+        "observe.counter('singa_x_total', 'a').inc(outcome='completed')\n"
+        "observe.counter('singa_x_total', 'a').inc(outcome='dropped')\n"
+        "def guarded(o):\n"
+        "    assert o in OUTCOMES\n"
+        "    observe.counter('singa_x_total', 'a').inc(outcome=o)\n"
+        "def unguarded(o):\n"
+        "    observe.counter('singa_x_total', 'a').inc(outcome=o)\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 2, problems
+    assert any("'dropped'" in p for p in problems)
+    assert any("dynamic" in p for p in problems)

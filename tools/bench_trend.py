@@ -20,11 +20,13 @@ Record formats tolerated (all of which exist in the repo today):
     (MULTICHIP/RESILIENCE/FLEET style) -> `<family>_ok` 0/1.
 
 Direction is inferred from the record's `unit` (or the metric name):
-times ("s", "ms", "seconds", `*_ms`/`*_s` suffixes) and memory
+times ("s", "ms", "seconds", `*_ms`/`*_s` suffixes), memory
 footprints ("bytes" unit, `*_bytes` suffix — MEM_r*.json's region
-records) regress UP, everything else (throughput, ratios, ok-flags)
-regresses DOWN. Rate units ("tokens/s") always win over the name
-heuristics.
+records), and serving latencies (any metric naming `ttft` or a
+`*_p50`/`*_p99` percentile — BENCHDEC_r06's engine TTFT records, even
+when unit-less) regress UP, everything else (throughput, ratios,
+ok-flags) regresses DOWN. Rate units ("tokens/s") always win over the
+name heuristics.
 
 Usage: `python tools/bench_trend.py [DIR|FILES...] [--threshold 0.05]`
 (default DIR = the repo root). `--latest-only` restricts regression
@@ -46,7 +48,12 @@ ROUND_RE = re.compile(r"^([A-Z]+)_r(\d+)\.json$")
 
 #: units whose metrics regress by going UP (latency- and footprint-like)
 LOWER_BETTER_UNITS = ("s", "ms", "us", "seconds", "sec", "bytes")
-LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_latency", "_bytes")
+LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_latency", "_bytes",
+                         "_p50", "_p99")
+#: name substrings that mark a latency metric regardless of unit — the
+#: serving bench's TTFT records must trip the gate even when a round
+#: wrote them unit-less
+LOWER_BETTER_SUBSTRINGS = ("ttft",)
 
 
 def parse_records(path: str, family: str):
@@ -141,6 +148,8 @@ def lower_is_better(metric: str, unit: str) -> bool:
         # metric would be misread as a latency
         return False
     if u in LOWER_BETTER_UNITS:
+        return True
+    if any(sub in metric.lower() for sub in LOWER_BETTER_SUBSTRINGS):
         return True
     return any(metric.endswith(sfx) for sfx in LOWER_BETTER_SUFFIXES)
 
