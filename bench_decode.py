@@ -91,6 +91,17 @@ def main():
     p.add_argument("--serve-out", default=None, metavar="FILE",
                    help="append the serve records as JSON lines "
                         "(BENCHDEC_rNN.json style)")
+    p.add_argument("--serve-slo-ttft-p99", type=float, default=1.0,
+                   help="declared p99 TTFT target (seconds) both arms "
+                        "are scored against (singa_tpu.slo)")
+    p.add_argument("--serve-slo-latency-p99", type=float, default=30.0,
+                   help="declared p99 request-latency target (seconds)")
+    p.add_argument("--serve-slo-availability", type=float, default=0.99,
+                   help="declared availability target (non-timeout/"
+                        "evicted fraction)")
+    p.add_argument("--serve-slo-tok-s", type=float, default=0.0,
+                   help="per-request tokens/sec floor (0 disables the "
+                        "objective)")
     args = p.parse_args()
 
     if args.serve:
@@ -283,6 +294,41 @@ def _pct(xs, p):
     return pctile(xs, p)
 
 
+def _slo_config(args):
+    from singa_tpu import slo
+    return slo.SLOConfig(
+        ttft_p99_s=args.serve_slo_ttft_p99,
+        latency_p99_s=args.serve_slo_latency_p99,
+        availability=args.serve_slo_availability,
+        min_tokens_per_sec=args.serve_slo_tok_s
+        if args.serve_slo_tok_s > 0 else None,
+        # windows sized to cover the whole arm: the bench scores the
+        # run, not a trailing slice of it
+        window_s=3600.0, fast_window_s=60.0, slow_window_s=3600.0)
+
+
+def _slo_fields(att_map, cfg):
+    """Per-arm SLO fields from an attainment map ({objective:
+    {"attainment", ...}}): per-objective attainment percent + whole-run
+    burn rate, and the worst-objective `slo_attainment_pct` headline
+    the standalone trend record carries."""
+    from singa_tpu import slo
+    fields = {}
+    worst = None
+    for obj, a in att_map.items():
+        at = a.get("attainment")
+        if at is None:
+            continue
+        pct = round(100.0 * at, 2)
+        fields[f"slo_{obj}_pct"] = pct
+        worst = pct if worst is None else min(worst, pct)
+        burn = slo.burn_rate(at, cfg.target_fraction(obj))
+        fields[f"slo_{obj}_burn"] = round(burn, 3) \
+            if burn is not None else None
+    fields["slo_attainment_pct"] = worst
+    return fields
+
+
 def serve_main(args):
     """The --serve A/B: one seeded Poisson workload, two serving arms.
 
@@ -375,6 +421,14 @@ def serve_main(args):
         if not w.wait(300):
             raise RuntimeError(f"engine warmup (bucket {b}) stalled "
                                "after 300s")
+    # the SLO tracker scores the MEASURED workload only: installed
+    # after warmup, so compile-time TTFTs don't burn the budget
+    from singa_tpu import slo
+    slo_cfg = _slo_config(args)
+    # capacity covers the whole arm: the default 4096-record ring
+    # would silently score only the tail of a bigger workload
+    tracker = slo.SLOTracker(slo_cfg,
+                             capacity=max(4096, 2 * n_req)).install()
     _t0, handles = replay(
         lambda i: eng.submit(prompts[i], int(new_lens[i])))
     stuck = [h.id for _, h in handles if not h.wait(600)]
@@ -393,6 +447,10 @@ def serve_main(args):
     eng_tok = sum(len(h.tokens) for h in eng_done)
     eng_report = eng.report()
     eng.stop()
+    eng_verdict = tracker.evaluate()
+    eng_slo = _slo_fields(eng_verdict["objectives"], slo_cfg)
+    eng_slo["slo_breaching"] = eng_verdict["breaching"]
+    slo.reset()
 
     # ---- arm 2: static batching over the same schedule ------------------
     # warmup = compile the one static signature
@@ -458,6 +516,16 @@ def serve_main(args):
     # call returns: TTFT = completion - arrival
     st_ttft = [sdone[i] - (st0 + float(arrivals[i]))
                for i in range(n_req)]
+    # the static arm has no engine feeding a tracker; score the SAME
+    # objectives with slo's pure math over the measured latencies (a
+    # static request is terminal when its batch returns, so TTFT ==
+    # total latency; rate = its useful tokens over that latency)
+    st_records = [{"ts": 0.0, "outcome": "completed",
+                   "ttft_s": st_ttft[i], "total_s": st_ttft[i],
+                   "tokens_per_sec": int(new_lens[i]) / st_ttft[i]
+                   if st_ttft[i] > 0 else None}
+                  for i in range(n_req)]
+    st_slo = _slo_fields(slo.attainment(st_records, slo_cfg), slo_cfg)
 
     eng_tok_s = eng_tok / eng_wall if eng_wall > 0 else 0.0
     st_tok_s = useful / st_wall if st_wall > 0 else 0.0
@@ -483,13 +551,13 @@ def serve_main(args):
          "steps_per_sync": args.serve_steps_per_sync,
          "ttft_p50_s": round(_pct(eng_ttft, 0.5), 4),
          "ttft_p99_s": round(_pct(eng_ttft, 0.99), 4),
-         "wall_s": round(eng_wall, 3)},
+         "wall_s": round(eng_wall, 3), **eng_slo},
         {"metric": f"gpt_serve_static_tok_s_{cfg}",
          "value": round(st_tok_s, 1), **base,
          "batch": B, "decoded_tokens": n_req * n_hi,
          "ttft_p50_s": round(_pct(st_ttft, 0.5), 4),
          "ttft_p99_s": round(_pct(st_ttft, 0.99), 4),
-         "wall_s": round(st_wall, 3)},
+         "wall_s": round(st_wall, 3), **st_slo},
         {"metric": f"gpt_serve_speedup_x_{cfg}",
          "value": round(eng_tok_s / st_tok_s, 3) if st_tok_s else None,
          "unit": "x", "requests": n_req,
@@ -508,6 +576,18 @@ def serve_main(args):
                     {"metric": f"gpt_serve_{arm}_ttft_{pname}_s_{cfg}",
                      "value": round(v, 4), "unit": "s",
                      "requests": n_req, "rps": round(rps, 2)})
+    # SLO attainment as records of their OWN (not just per-arm fields):
+    # bench_trend classifies `attainment` higher-is-better, so a
+    # declared-objective slide trips the gate across rounds
+    for arm, fields in (("engine", eng_slo), ("static", st_slo)):
+        v = fields.get("slo_attainment_pct")
+        if v is not None:
+            recs.append(
+                {"metric": f"gpt_serve_{arm}_slo_attainment_pct_{cfg}",
+                 "value": v, "unit": "pct", "requests": n_req,
+                 "slo_ttft_p99_s": args.serve_slo_ttft_p99,
+                 "slo_latency_p99_s": args.serve_slo_latency_p99,
+                 "slo_availability": args.serve_slo_availability})
     for rec in recs:
         observe.record_bench(rec)
         print(json.dumps(rec))

@@ -20,6 +20,10 @@ the wall-clock go" without touching the training process:
   /memz      the live device-memory ledger (singa_tpu.memory): region
              breakdown + reconciliation + estimate-vs-actual drift +
              leak state; ?json=1 returns the timeline JSON
+  /slo       serving-SLO state (singa_tpu.slo): per-objective
+             attainment, error-budget burn rates, breach state, and
+             the recent violating requests with their phase-stamped
+             timelines; ?json=1 structured
   /stackz    on-demand all-thread Python stack dump (names + daemon
              flags + frames, the same capture the watchdog's hang
              bundle embeds); ?json=1 returns the structured form
@@ -90,6 +94,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "/fleetz": self._fleetz,
                 "/fleetz/trace": self._fleetz_trace,
                 "/memz": self._memz,
+                "/slo": self._sloz,
                 "/stackz": self._stackz,
                 "/profilez": self._profilez,
             }.get(url.path.rstrip("/") or "/")
@@ -115,6 +120,9 @@ class _Handler(BaseHTTPRequestHandler):
             "  /fleetz/trace merged Perfetto/Chrome trace (JSON)\n"
             "  /memz         live device-memory ledger breakdown; "
             "?json=1 for the timeline JSON\n"
+            "  /slo          serving SLO attainment + error-budget "
+            "burn rates + violating request timelines; ?json=1 for "
+            "the structured form\n"
             "  /stackz       all-thread Python stack dump; "
             "?json=1 for the structured form\n"
             "  /profilez     ?steps=N[&seconds=S] on-demand xplane "
@@ -173,6 +181,11 @@ class _Handler(BaseHTTPRequestHandler):
             parts.append(engine.serving_report())
         except Exception as e:
             parts.append(f"(serving unavailable: {e})")
+        try:
+            from . import slo
+            parts.append(slo.slo_report())
+        except Exception as e:
+            parts.append(f"(slo unavailable: {e})")
         mon = self._monitor()
         if mon is None:
             parts.append("== health ==\nno HealthMonitor attached")
@@ -249,6 +262,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(memory.memz_json())
             return
         self._send(memory.memz_report() + "\n")
+
+    def _sloz(self, q):
+        """Serving-SLO state from the installed slo.SLOTracker: the
+        declared objectives, per-objective attainment over the sliding
+        window, fast/slow error-budget burn rates, breach state, and
+        the recent VIOLATING request ids with their phase-stamped
+        timelines. `?json=1` returns the structured form. 503 until a
+        tracker is installed."""
+        from . import slo
+        status = 200 if slo.get_tracker() is not None else 503
+        if (q.get("json") or ["0"])[0] not in ("0", "", "false"):
+            self._send_json(slo.slo_json(), status=status)
+        else:
+            self._send(slo.slo_report() + "\n", status=status)
 
     def _stackz(self, q):
         """On-demand all-thread stack dump — the hang-forensics capture

@@ -66,7 +66,7 @@ import tempfile
 import threading
 import time
 
-from . import distributed, health, observe
+from . import distributed, health, observe, slo
 
 SHARD_VERSION = 1
 SHARD_SUFFIX = ".shard.jsonl"
@@ -148,6 +148,14 @@ def _agg_metrics():
         "sustained": observe.counter(
             "singa_fleet_straggler_sustained_total",
             "sustained-straggler verdicts by host"),
+        "serve_rps": observe.gauge(
+            "singa_fleet_serve_rps",
+            "per-host serving-engine terminal requests per second, "
+            "from each worker's fleet_serve snapshot"),
+        "slo_att": observe.gauge(
+            "singa_fleet_slo_attainment_pct",
+            "per-host worst-objective SLO attainment percent, from "
+            "each worker's fleet_serve snapshot"),
     }
 
 
@@ -252,6 +260,16 @@ class ShardWriter:
         except Exception:
             hang = None
         lines.append({"kind": "fleet_hang", "hang": hang})
+        serve = None
+        try:
+            # the serving view (singa_tpu.slo): live engine occupancy/
+            # queue/RPS/TTFT + SLO attainment, plus the recent request
+            # timelines and decode-sync records the merged trace needs
+            # to show requests flowing through this replica
+            serve = slo.fleet_serve_snapshot()
+        except Exception:
+            serve = None
+        lines.append({"kind": "fleet_serve", "serve": serve})
         for rec in observe.span_records():
             lines.append({"kind": "fleet_span", "name": rec["name"],
                           "t0": rec["t0"], "dur": rec["dur"],
@@ -326,6 +344,8 @@ def read_shard(path: str) -> "dict | None":
                      if r.get("kind") == "fleet_mem"), None),
         "hang": next((r.get("hang") for r in rows
                       if r.get("kind") == "fleet_hang"), None),
+        "serve": next((r.get("serve") for r in rows
+                       if r.get("kind") == "fleet_serve"), None),
         "spans": [r for r in rows if r.get("kind") == "fleet_span"],
     }
 
@@ -373,8 +393,8 @@ def merge_metric_snapshots(snaps: dict) -> dict:
 class _WorkerState:
     __slots__ = ("path", "host", "pid", "seq", "ts", "perf", "steps",
                  "started_ts", "metrics", "goodput", "health", "mem",
-                 "hang", "spans", "prev_ts", "prev_steps", "step_rate",
-                 "over_since")
+                 "hang", "serve", "spans", "prev_ts", "prev_steps",
+                 "step_rate", "over_since")
 
     def __init__(self, path):
         self.path = path
@@ -390,6 +410,7 @@ class _WorkerState:
         self.health = None
         self.mem = None   # per-host memory-ledger region snapshot
         self.hang = None  # per-host watchdog hang verdict (sticky)
+        self.serve = None  # per-host serving snapshot (slo.fleet_serve)
         self.spans = {}   # (tid, t0, name) -> span rec, insertion-ordered
         self.prev_ts = None
         self.prev_steps = 0
@@ -492,6 +513,7 @@ class FleetAggregator:
             w.health = shard["health"]
             w.mem = shard.get("mem")
             w.hang = shard.get("hang")
+            w.serve = shard.get("serve")
             if fresh and w.prev_ts and w.ts > w.prev_ts:
                 w.step_rate = max(
                     0.0, (w.steps - w.prev_steps) / (w.ts - w.prev_ts))
@@ -586,6 +608,12 @@ class FleetAggregator:
             if isinstance(w.mem, dict):
                 m["mem"].set(float(w.mem.get("total_bytes") or 0.0),
                              host=w.host)
+            if isinstance(w.serve, dict):
+                m["serve_rps"].set(float(w.serve.get("rps") or 0.0),
+                                   host=w.host)
+                att = slo.serve_attainment_pct(w.serve)
+                if att is not None:
+                    m["slo_att"].set(att, host=w.host)
         for hostname, score in self._scores.items():
             m["score"].set(score, host=hostname)
         return local
@@ -779,6 +807,25 @@ class FleetAggregator:
                         if isinstance(w.mem, dict) else None,
                     "mem_regions": dict(w.mem.get("regions") or {})
                         if isinstance(w.mem, dict) else None,
+                    # the per-replica serving columns (ROADMAP item 5):
+                    # RPS, queue, occupancy, page util, TTFT, kv-cache
+                    # bytes from the memory ledger, SLO attainment
+                    "serve": {
+                        "rps": w.serve.get("rps"),
+                        "queue_depth": w.serve.get("queue_depth"),
+                        "occupancy": w.serve.get("occupancy"),
+                        "slots": w.serve.get("slots"),
+                        "page_util": w.serve.get("page_util"),
+                        "kv_cache_bytes": w.serve.get("kv_cache_bytes"),
+                        "ttft_p50_s": w.serve.get("ttft_p50_s"),
+                        "ttft_p99_s": w.serve.get("ttft_p99_s"),
+                        "finished": w.serve.get("finished"),
+                        "slo_attainment_pct":
+                            slo.serve_attainment_pct(w.serve),
+                        "slo_breaching":
+                            ((w.serve.get("slo") or {})
+                             .get("breaching") or []),
+                    } if isinstance(w.serve, dict) else None,
                 })
             # worst-HBM host: max live bytes across workers that
             # published a memory snapshot (freshest shard per host
@@ -845,6 +892,28 @@ class FleetAggregator:
                         "args": {"path": rec.get("name"),
                                  "host": w.host},
                     })
+                if isinstance(w.serve, dict):
+                    # the request-level serving view: per-request
+                    # queued/prefill/decode spans + decode-step slices
+                    # + the flow events linking them, aligned onto the
+                    # shared wall clock via the SAME handshake offset —
+                    # a multi-replica trace shows requests flowing
+                    # through workers. When the worker's span ring
+                    # already published serving.engine_step slices
+                    # (span records on, the normal case), the sync ring
+                    # must not overlay near-identical duplicates on the
+                    # same tid — the flows bind inside the real ones.
+                    have_step_spans = any(
+                        (rec.get("name") or "").rsplit("/", 1)[-1]
+                        == "serving.engine_step"
+                        for rec in w.spans.values())
+                    timelines = w.serve.get("timelines") or []
+                    syncs = w.serve.get("syncs") or []
+                    events.extend(slo._track_metadata(
+                        timelines, syncs, w.pid))
+                    events.extend(slo.request_trace_events(
+                        timelines, syncs, w.pid, offset=off,
+                        emit_sync_slices=not have_step_spans))
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def export_trace(self, path: str) -> str:
@@ -1029,6 +1098,32 @@ def fleet_report() -> str:
             f"{r['age_s']:>7.2f} {r['steps']:>7} "
             f"{r['step_rate']:>8.2f} {gp:>8} {mem:>8} "
             f"{r['straggler_score']:>10.3f} {state}")
+    serving = [r for r in roll["workers"] if r.get("serve")]
+    if serving:
+        lines.append("== fleet serving ==")
+        lines.append(
+            f"{'host':<12} {'rps':>7} {'queue':>6} {'occ':>7} "
+            f"{'pages':>7} {'ttft_p50_ms':>12} {'ttft_p99_ms':>12} "
+            f"{'kv_mb':>8} {'slo_pct':>8} breaching")
+        for r in serving:
+            s = r["serve"]
+            occ = f"{s['occupancy']}/{s['slots']}" \
+                if s.get("slots") is not None else "-"
+            pu = f"{100.0 * s['page_util']:.0f}%" \
+                if s.get("page_util") is not None else "-"
+            p50 = f"{s['ttft_p50_s'] * 1e3:.1f}" \
+                if s.get("ttft_p50_s") is not None else "-"
+            p99 = f"{s['ttft_p99_s'] * 1e3:.1f}" \
+                if s.get("ttft_p99_s") is not None else "-"
+            kv = f"{s['kv_cache_bytes'] / 1e6:.2f}" \
+                if s.get("kv_cache_bytes") is not None else "-"
+            att = f"{s['slo_attainment_pct']:.1f}" \
+                if s.get("slo_attainment_pct") is not None else "-"
+            lines.append(
+                f"{r['host']:<12} {s.get('rps') or 0.0:>7.2f} "
+                f"{s.get('queue_depth') or 0:>6} {occ:>7} {pu:>7} "
+                f"{p50:>12} {p99:>12} {kv:>8} {att:>8} "
+                f"{','.join(s.get('slo_breaching') or []) or 'none'}")
     steps_total = 0
     for s in (roll["metrics"].get("singa_steps_total") or
               {}).get("series", {}).values():

@@ -64,6 +64,7 @@ KIND_GRAD_NORM = "grad_norm_limit"
 KIND_STRAGGLER = "straggler"  # fleet sustained-straggler verdict
 KIND_MEM_LEAK = "mem_leak"    # memory-ledger sustained-growth verdict
 KIND_HANG = "hang"            # watchdog deadline-breach abort verdict
+KIND_SLO = "slo"              # SLO tracker sustained burn-rate breach
 
 
 class HealthError(RuntimeError):
@@ -636,7 +637,7 @@ def record_nan_logits(n: int, kind: str):
 
 __all__ = [
     "POLICIES", "HealthError", "StepStatsCollector", "collector",
-    "KIND_STRAGGLER", "KIND_MEM_LEAK", "KIND_HANG",
+    "KIND_STRAGGLER", "KIND_MEM_LEAK", "KIND_HANG", "KIND_SLO",
     "apply_skip", "FlightRecorder", "load_flight_bundle", "HealthMonitor",
     "record_nan_logits", "set_active_monitor", "active_monitor",
 ]

@@ -86,8 +86,11 @@ REGION_UNATTRIBUTED = "unattributed"
 #: `observe.add_step_listener` hook instead. The serving decode span
 #: exit is the only moment the KV caches are live host-visible buffers;
 #: the engine's per-sync step span keeps the page-pool occupancy on the
-#: /memz timeline for processes that only serve (no train steps).
-SNAPSHOT_SPAN_LEAVES = ("serving.decode", "serving.engine_step")
+#: /memz timeline for processes that only serve (no train steps), and
+#: the engine-prefill span catches the admission seam, where a new
+#: request's pages were just written into the pool.
+SNAPSHOT_SPAN_LEAVES = ("serving.decode", "serving.engine_step",
+                        "serving.engine_prefill")
 
 #: top-K largest live arrays embedded in an OOM bundle
 OOM_TOP_K = 16

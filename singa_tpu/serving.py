@@ -705,7 +705,12 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
         # books full serving wall time as productive; the nested stage
         # spans net out of it.
         obs = observe.is_enabled()
-        from . import resilience, watchdog
+        from . import resilience, slo, watchdog
+        # an installed SLO tracker needs honest fenced samples even
+        # with the metric hooks disabled — the tracker was installed
+        # on purpose, and silently starving it of records would make
+        # /slo read "no data" for exactly one of the two serving modes
+        sample = obs or slo.get_tracker() is not None
         # the watchdog's `decode` deadline arms over the whole call
         # (prefill + scan + the host seams); `serving.decode` is its
         # deterministic FaultPlan hook
@@ -718,7 +723,7 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
             with observe.span("serving.prefill", batch=B,
                               prompt_tokens=S0):
                 tok0, caches, key, nf = prefill_jit(p, prompt, key)
-                if obs:
+                if sample:
                     jax.block_until_ready(tok0)
                     ttft = _time.perf_counter() - t0
             # memory-ledger birth-site hook: the per-block KV caches
@@ -744,15 +749,23 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
                 toks = tok0[:, None]
             ids = jnp.concatenate([prompt if isinstance(prompt, jax.Array)
                                    else jnp.asarray(prompt), toks], axis=1)
-            if obs:
+            if sample:
                 jax.block_until_ready(ids)
                 kind = "greedy" if temperature == 0.0 else "sampled"
-                observe.record_decode(
-                    kind, _time.perf_counter() - t0,
-                    new_tokens=B * max_new,
-                    batch=B, ttft=ttft, prompt_tokens=B * S0)
-                from . import health
-                health.record_nan_logits(int(jax.device_get(nf)), kind)
+                total = _time.perf_counter() - t0
+                if obs:
+                    observe.record_decode(
+                        kind, total, new_tokens=B * max_new,
+                        batch=B, ttft=ttft, prompt_tokens=B * S0)
+                    from . import health
+                    health.record_nan_logits(int(jax.device_get(nf)),
+                                             kind)
+                # SLO wiring: the dense path's calls count toward the
+                # declared serving objectives too (latency/rate/TTFT),
+                # so /slo answers for static-batch deployments —
+                # note_decode is a no-op without a tracker
+                slo.note_decode(kind, total, B * max_new, ttft=ttft,
+                                batch=B)
         return ids
 
     return decode
@@ -885,8 +898,9 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
     def run(p, prompt):
         import time as _time
 
-        from . import observe
-        if not observe.is_enabled():
+        from . import observe, slo
+        obs = observe.is_enabled()
+        if not obs and slo.get_tracker() is None:
             # no fence, no record: pure dispatch
             ids, score, _nf = jitted(p, prompt)
             return ids, score
@@ -897,11 +911,13 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
             ids, score, nf = jitted(p, prompt)
             jax.block_until_ready(ids)
         # one fused program: no prefill seam, so no TTFT sample here
-        observe.record_decode("beam", _time.perf_counter() - t0,
-                              new_tokens=B * max_new, batch=B,
-                              prompt_tokens=B * S0)
-        from . import health
-        health.record_nan_logits(int(jax.device_get(nf)), "beam")
+        total = _time.perf_counter() - t0
+        if obs:
+            observe.record_decode("beam", total, new_tokens=B * max_new,
+                                  batch=B, prompt_tokens=B * S0)
+            from . import health
+            health.record_nan_logits(int(jax.device_get(nf)), "beam")
+        slo.note_decode("beam", total, B * max_new, batch=B)
         return ids, score
 
     return run

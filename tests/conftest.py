@@ -53,11 +53,13 @@ def _metrics_isolation():
     asserts the test left no async checkpoint pending, no prefetcher
     thread alive, and no stray non-daemon thread behind."""
     from singa_tpu import (diag, engine, fleet, goodput, health,
-                           introspect, memory, observe, watchdog)
+                           introspect, memory, observe, slo, watchdog)
     diag.stop_diag_server()
     goodput.uninstall()
     fleet.uninstall()
     engine.reset()
+    slo.reset()
+    engine.clear_request_listeners()
     memory.reset()
     watchdog.uninstall_watchdog()
     health.set_active_monitor(None)
@@ -93,6 +95,23 @@ def _metrics_isolation():
     assert not leaked_serve, (
         f"serving-engine thread(s) left running: {leaked_serve} — call "
         "ServingEngine.stop() (or engine.reset()) before the test ends")
+    # SLO-tracker teardown (ISSUE-12): the installed tracker is
+    # uninstalled silently (like the memory ledger), but a RAW engine
+    # request listener a test registered itself must be removed by the
+    # test — capture-then-clean: the leak is recorded first, every
+    # listener cleared regardless, so one leaky test fails itself
+    # without cascading into the suite.
+    _tr = slo.get_tracker()
+    leaked_slo = [getattr(cb, "__qualname__", str(cb))
+                  for cb in engine.request_listeners()
+                  if _tr is None or cb != _tr._on_request]
+    slo.reset()
+    engine.clear_request_listeners()
+    assert not leaked_slo, (
+        f"engine request listener(s) leaked: {leaked_slo} — "
+        "engine.remove_request_listener() (or register through "
+        "slo.SLOTracker.install, which slo.reset() detaches) before "
+        "the test ends")
     # memory-ledger teardown (ISSUE-9): the ledger uninstalled (its
     # step/span listeners detached, the sampler thread joined) and all
     # region providers/transient notes dropped. Leaked sampler threads

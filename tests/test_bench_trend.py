@@ -172,3 +172,35 @@ def test_bytes_fixture_regression_flagged():
     rnd, v, best_r, best, delta = regs["toy_hbm_bytes"]
     assert (rnd, v, best_r, best) == (3, 1500000.0, 2, 1000000.0)
     assert abs(delta - 0.5) < 1e-9
+
+
+def test_attainment_metrics_higher_is_better():
+    """ISSUE-12 satellite: SLO attainment records end in `_pct` (a
+    lower-better suffix) but a DROP in attainment is the regression —
+    the `attainment` substring overrides the suffix heuristic; rate
+    units and plain percentiles keep their directions."""
+    assert not bench_trend.lower_is_better(
+        "gpt_serve_engine_slo_attainment_pct_cfg", "pct")
+    assert not bench_trend.lower_is_better(
+        "toy_serve_slo_attainment_pct", "")
+    # plain percentile/TTFT metrics are still lower-is-better
+    assert bench_trend.lower_is_better("toy_serve_ttft_p99", "")
+    assert bench_trend.lower_is_better("engine_latency_p99", "")
+
+
+def test_attainment_fixture_regression_flagged():
+    """The checked-in SLO fixtures carry an attainment series:
+    improving in clean/ (99 -> 100, no flag), dropping in regress/
+    (100 -> 90, flagged DOWN against the best prior round)."""
+    clean = bench_trend.trend_table(bench_trend.collect([CLEAN]))
+    assert clean["toy_serve_slo_attainment_pct"]["by_round"] \
+        == {1: 99.0, 2: 100.0}
+    assert not [r for r in bench_trend.find_regressions(clean)
+                if r[0] == "toy_serve_slo_attainment_pct"]
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = {m: (rnd, v, best_r, best, delta)
+            for m, rnd, v, best_r, best, delta
+            in bench_trend.find_regressions(table, threshold=0.05)}
+    rnd, v, best_r, best, delta = regs["toy_serve_slo_attainment_pct"]
+    assert (rnd, v, best_r, best) == (2, 90.0, 1, 100.0)
+    assert abs(delta - 0.1) < 1e-9

@@ -457,3 +457,73 @@ def test_memz_serves_breakdown_live_mid_run(served):
     assert rep["top_arrays"] and rep["static_hbm"]
     _st, _h, idx = _get(srv, "/")
     assert "/memz" in idx
+
+
+def test_slo_without_tracker_is_503():
+    srv = observe.start_diag_server(port=0)
+    try:
+        st, _h, body = _get(srv, "/slo")
+        assert st == 503
+        assert "no SLOTracker installed" in body
+        st, _h, body = _get(srv, "/slo?json=1")
+        assert st == 503
+        assert json.loads(body) == {"installed": False}
+    finally:
+        diag.stop_diag_server()
+
+
+def test_slo_endpoint_golden_sections():
+    """ISSUE-12: /slo serves the declared objectives, per-objective
+    attainment + burn rates, breach state, and the recent violating
+    request ids WITH their timelines; ?json=1 is the structured form;
+    /statusz grows the `== slo ==` section and the index advertises
+    the endpoint."""
+    from singa_tpu import slo
+    from singa_tpu.slo import SLOConfig, SLOTracker
+    cfg = SLOConfig(ttft_p99_s=0.1, availability=0.9,
+                    eval_interval_s=1e9)
+    tracker = SLOTracker(cfg, clock=lambda: 100.0).install()
+    # one good, one violating record — with a synthetic timeline so
+    # the violation renders its phase trail
+    tracker.note_record({"ts": 99.0, "id": 1, "outcome": "completed",
+                         "ttft_s": 0.01, "total_s": 0.2,
+                         "tokens_per_sec": 40.0})
+    tracker.note_record(
+        {"ts": 99.5, "id": 2, "outcome": "completed", "ttft_s": 0.5,
+         "total_s": 0.9, "tokens_per_sec": 10.0},
+        timeline={"id": 2, "outcome": "completed", "new_tokens": 9,
+                  "events": [["submit", 98.0, None],
+                             ["queue", 98.001, None],
+                             ["admit", 98.4, None],
+                             ["terminal", 98.9,
+                              {"outcome": "completed"}]]})
+    srv = observe.start_diag_server(port=0)
+    try:
+        st, _h, body = _get(srv, "/slo")
+        assert st == 200
+        assert "== slo ==" in body
+        assert "objectives: ttft_p99, availability" in body
+        assert "ttft_p99" in body and "availability" in body
+        assert "attainment 50.00%" in body       # 1 of 2 met the TTFT
+        assert "burn" in body and "window requests: 2" in body
+        assert "recent violations (1):" in body
+        assert "req 2 [ttft_p99]" in body
+        # the violating request's timeline trail renders inline
+        assert "submit+0.000s" in body and "admit+0.400s" in body
+        st, _h, body = _get(srv, "/slo?json=1")
+        assert st == 200
+        rep = json.loads(body)
+        assert rep["installed"] is True
+        assert rep["config"]["ttft_p99_s"] == 0.1
+        assert rep["verdict"]["objectives"]["ttft_p99"]["attainment"] \
+            == 0.5
+        assert rep["violations"][0]["id"] == 2
+        assert rep["violations"][0]["timeline"]["events"][0][0] \
+            == "submit"
+        st, _h, body = _get(srv, "/statusz")
+        assert "== slo ==" in body
+        _st, _h, idx = _get(srv, "/")
+        assert "/slo" in idx
+    finally:
+        diag.stop_diag_server()
+        slo.reset()

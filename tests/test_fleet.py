@@ -33,7 +33,7 @@ def _fleet_hygiene():
 
 def _write_fake_shard(fleet_dir, host, pid, seq=1, ts=None, perf=0.0,
                       spans=(), steps=0, metrics=None, goodput=None,
-                      name=None, mem=None):
+                      name=None, mem=None, serve=None):
     """Hand-build one shard file in the documented format — the unit
     tests' stand-in for another process's ShardWriter (the writer end
     is covered by the round-trip test and the subprocess A/B)."""
@@ -46,7 +46,8 @@ def _write_fake_shard(fleet_dir, host, pid, seq=1, ts=None, perf=0.0,
              {"kind": "fleet_metrics", "metrics": metrics or {}},
              {"kind": "fleet_goodput", "goodput": goodput},
              {"kind": "fleet_health", "verdict": None},
-             {"kind": "fleet_mem", "mem": mem}]
+             {"kind": "fleet_mem", "mem": mem},
+             {"kind": "fleet_serve", "serve": serve}]
     for nm, t0, dur, tid, kind in spans:
         lines.append({"kind": "fleet_span", "name": nm, "t0": t0,
                       "dur": dur, "tid": tid, "span_kind": kind})
@@ -601,3 +602,163 @@ def test_shard_without_ledger_and_report_without_mem(tmp_path):
     fleet.install_aggregator(aggregator=agg)
     assert "worst-HBM host: none (no memory shards)" \
         in fleet.fleet_report()
+
+
+def _fake_serve(rps=3.5, att=0.75, breaching=("ttft_p99",),
+                timelines=None, syncs=None):
+    """A fleet_serve snapshot in the documented shape (the writer end
+    — slo.fleet_serve_snapshot over a live engine — is covered in
+    tests/test_slo.py)."""
+    return {
+        "engines": 1, "rps": rps, "queue_depth": 2, "occupancy": 3,
+        "slots": 4, "pages_in_use": 6, "pages_total": 16,
+        "page_util": 0.375, "kv_cache_bytes": 2_000_000,
+        "ttft_p50_s": 0.012, "ttft_p99_s": 0.090,
+        "finished": {"completed": 7, "evicted": 0, "rejected": 0,
+                     "timeout": 1},
+        "slo": {"objectives": {"ttft_p99": {"attainment": att,
+                                            "burn_fast": 5.0,
+                                            "burn_slow": 3.0,
+                                            "breach": bool(breaching)}},
+                "breaching": list(breaching), "window_requests": 8},
+        "timelines": timelines or [],
+        "syncs": syncs or [],
+    }
+
+
+def test_shard_carries_serve_and_fleetz_serving_columns(tmp_path):
+    """ISSUE-12: the fleet_serve line rides shards into the rollup's
+    per-replica serving view (RPS, queue, occupancy, page util, TTFT,
+    kv-cache bytes, SLO attainment), /fleetz grows the serving table,
+    and the per-host gauges export."""
+    d = str(tmp_path)
+    _write_fake_shard(d, "hostA", 100, steps=5, serve=_fake_serve())
+    _write_fake_shard(d, "hostB", 101, steps=5)  # training-only worker
+    agg = fleet.FleetAggregator(d)
+    roll = agg.poll()
+    by_host = {r["host"]: r for r in roll["workers"]}
+    s = by_host["hostA"]["serve"]
+    assert s["rps"] == 3.5 and s["queue_depth"] == 2
+    assert s["occupancy"] == 3 and s["slots"] == 4
+    assert s["page_util"] == 0.375
+    assert s["kv_cache_bytes"] == 2_000_000
+    assert s["ttft_p99_s"] == 0.090
+    assert s["slo_attainment_pct"] == 75.0
+    assert s["slo_breaching"] == ["ttft_p99"]
+    assert by_host["hostB"]["serve"] is None
+    g = observe.get_registry().get("singa_fleet_serve_rps")
+    assert g.value(host="hostA") == 3.5
+    g = observe.get_registry().get("singa_fleet_slo_attainment_pct")
+    assert g.value(host="hostA") == 75.0
+    fleet.install_aggregator(aggregator=agg)
+    rep = fleet.fleet_report()
+    assert "== fleet serving ==" in rep
+    for col in ("rps", "queue", "occ", "pages", "ttft_p50_ms",
+                "ttft_p99_ms", "kv_mb", "slo_pct", "breaching"):
+        assert col in rep, col
+    srv_line = next(ln for ln in rep.splitlines()
+                    if ln.startswith("hostA") and "3.50" in ln)
+    assert "3/4" in srv_line           # occupancy
+    assert "38%" in srv_line           # page utilization
+    assert "2.00" in srv_line          # kv MB
+    assert "75.0" in srv_line          # slo attainment pct
+    assert "ttft_p99" in srv_line      # breaching objective
+    # a fleet with no serving workers renders no serving table
+    _write_fake_shard(d, "hostA", 100, seq=2, serve=None)
+    _write_fake_shard(d, "hostB", 101, seq=2)
+    assert "== fleet serving ==" not in fleet.fleet_report()
+
+
+def test_merged_trace_carries_request_flows_clock_aligned(tmp_path):
+    """The merged trace shows requests flowing through workers: one
+    worker's serve timelines/syncs become queued/prefill/decode spans
+    + engine_step slices + flow events, aligned onto the shared wall
+    clock via the SAME handshake offset as its ordinary spans."""
+    d = str(tmp_path)
+    wall = 1_700_000_000.0
+    tl = {"id": 42, "outcome": "completed", "prompt_tokens": 5,
+          "new_tokens": 4, "slot": 1, "ttft_s": 0.4, "total_s": 0.9,
+          "tokens_per_sec": 4.4,
+          "events": [["submit", 100.0, None], ["queue", 100.001, None],
+                     ["admit", 100.2, None], ["prefill", 100.21, None],
+                     ["first_token", 100.4, None],
+                     ["decode", 100.6, {"tokens": 2, "sync": 9}],
+                     ["decode", 100.8, {"tokens": 4, "sync": 10}],
+                     ["terminal", 100.9, {"outcome": "completed"}]],
+          "syncs": [9, 10]}
+    syncs = [{"sync": 9, "t0": 100.5, "dur": 0.2, "tid": 77,
+              "slots": 1, "steps": 2, "tokens": 2},
+             {"sync": 10, "t0": 100.75, "dur": 0.1, "tid": 77,
+              "slots": 1, "steps": 2, "tokens": 2}]
+    _write_fake_shard(d, "hostA", 100, ts=wall, perf=100.0,
+                      spans=[("model.step", 101.0, 0.01, 7, "span")],
+                      serve=_fake_serve(timelines=[tl], syncs=syncs))
+    agg = fleet.FleetAggregator(d)
+    agg.poll()
+    trace = agg.trace_events()
+    events = trace["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert all("ts" in e and "dur" in e and "tid" in e for e in xs)
+    # the request spans, offset-aligned: submit was 0.0s after the
+    # handshake sample on the worker's clock -> ts == wall
+    queued = next(e for e in xs if e["name"] == "req 42 queued")
+    assert queued["ts"] == pytest.approx(wall * 1e6, abs=1.0)
+    assert queued["dur"] == pytest.approx(0.2 * 1e6, abs=1.0)
+    decode = next(e for e in xs if e["name"] == "req 42 decode")
+    assert decode["tid"] == 900_101  # slot 1's track
+    steps = [e for e in xs if e["name"] == "serving.engine_step"]
+    assert len(steps) == 2 and all(e["tid"] == 77 for e in steps)
+    from singa_tpu import slo
+    flows = [e for e in events if e.get("cat") == "req_flow"
+             and e.get("id") == slo.flow_event_id(100, 42)]
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    for ev in flows[1:]:  # each step lands INSIDE an engine_step slice
+        assert any(s["tid"] == ev["tid"]
+                   and s["ts"] <= ev["ts"] <= s["ts"] + s["dur"]
+                   for s in steps), ev
+    # the ordinary span slices still align (regression: same offset)
+    step_span = next(e for e in xs if e["name"] == "model.step")
+    assert step_span["ts"] == pytest.approx((wall + 1.0) * 1e6,
+                                            abs=1.0)
+
+
+def test_merged_trace_dedupes_engine_step_slices(tmp_path):
+    """Review fix (ISSUE-12): when a worker's span ring already
+    published serving.engine_step slices, the serve sync ring must not
+    overlay near-identical duplicates on the same tid — the flow
+    events bind inside the REAL span slices instead."""
+    from singa_tpu import slo
+    d = str(tmp_path)
+    wall = 1_700_000_000.0
+    tl = {"id": 7, "outcome": "completed", "prompt_tokens": 3,
+          "new_tokens": 2, "slot": 0, "ttft_s": 0.1, "total_s": 0.3,
+          "tokens_per_sec": 6.7,
+          "events": [["submit", 100.0, None], ["queue", 100.001, None],
+                     ["admit", 100.05, None],
+                     ["prefill", 100.06, None],
+                     ["first_token", 100.1, None],
+                     ["decode", 100.3, {"tokens": 2, "sync": 5}],
+                     ["terminal", 100.3, {"outcome": "completed"}]],
+          "syncs": [5]}
+    sync = {"sync": 5, "t0": 100.15, "dur": 0.15, "tid": 77,
+            "slots": 1, "steps": 2, "tokens": 2}
+    # the span ring carries the REAL engine_step slice, nested just
+    # inside the sync interval on the same thread
+    _write_fake_shard(
+        d, "hostA", 100, ts=wall, perf=100.0,
+        spans=[("serving.engine_step", 100.1501, 0.1498, 77, "span")],
+        serve=_fake_serve(timelines=[tl], syncs=[sync]))
+    agg = fleet.FleetAggregator(d)
+    agg.poll()
+    events = agg.trace_events()["traceEvents"]
+    steps = [e for e in events if e.get("ph") == "X"
+             and e.get("name") == "serving.engine_step"]
+    assert len(steps) == 1            # the span slice, no sync overlay
+    assert steps[0]["args"].get("path") is not None  # span-ring origin
+    flows = [e for e in events if e.get("cat") == "req_flow"
+             and e.get("id") == slo.flow_event_id(100, 7)]
+    assert [e["ph"] for e in flows] == ["s", "f"]
+    f = flows[-1]  # still binds inside the real span slice
+    s = steps[0]
+    assert s["tid"] == f["tid"] == 77
+    assert s["ts"] <= f["ts"] <= s["ts"] + s["dur"]

@@ -434,3 +434,72 @@ def test_outcome_label_rule(tmp_path):
     assert len(problems) == 2, problems
     assert any("'dropped'" in p for p in problems)
     assert any("dynamic" in p for p in problems)
+
+
+def test_lint_covers_slo_metric_names():
+    """ISSUE-12: rule 5 extends to the SLO layer's `phase=` and
+    `objective=` labels — REQUEST_PHASES and SLO_OBJECTIVES are
+    recognized as declared enum tuples, every singa_slo_* registration
+    in slo.py passes the full lint, and the new kwarg is enforced."""
+    slo_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                          "slo.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(slo_py)}
+    assert {"singa_slo_attainment_pct", "singa_slo_burn_rate_fast",
+            "singa_slo_burn_rate_slow",
+            "singa_slo_error_budget_remaining",
+            "singa_slo_window_requests", "singa_slo_evaluations_total",
+            "singa_slo_violations_total", "singa_slo_breach_total",
+            "singa_slo_phase_seconds"} <= names
+    assert all(n.startswith("singa_slo_") for n in names)
+    assert check_metrics_names.check([slo_py]) == []
+    import ast
+    enums, _consts = check_metrics_names._module_enum_info(
+        ast.parse(open(slo_py).read()))
+    assert enums["REQUEST_PHASES"] == (
+        "submit", "queue", "admit", "prefill", "first_token", "decode",
+        "terminal")
+    assert enums["SLO_OBJECTIVES"] == (
+        "ttft_p99", "latency_p99", "availability", "tokens_per_sec")
+    assert "objective" in check_metrics_names.ENUM_LABEL_KWARGS
+    assert "phase" in check_metrics_names.ENUM_LABEL_KWARGS
+
+
+def test_objective_label_rule(tmp_path):
+    """An objective= literal not in a declared enum tuple is a
+    violation; a member, a constant member, and an enum-guarded
+    dynamic value pass; an unguarded dynamic value fails."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "SLO_OBJECTIVES = ('ttft_p99', 'availability')\n"
+        "OBJ_TTFT = 'ttft_p99'\n"
+        "observe.gauge('singa_x', 'a').set(1.0, objective='ttft_p99')\n"
+        "observe.gauge('singa_x', 'a').set(1.0, objective=OBJ_TTFT)\n"
+        "observe.gauge('singa_x', 'a').set(1.0, objective='made_up')\n"
+        "def guarded(o):\n"
+        "    assert o in SLO_OBJECTIVES\n"
+        "    observe.gauge('singa_x', 'a').set(1.0, objective=o)\n"
+        "def unguarded(o):\n"
+        "    observe.gauge('singa_x', 'a').set(1.0, objective=o)\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 2, problems
+    assert any("'made_up'" in p for p in problems)
+    assert any("dynamic" in p for p in problems)
+
+
+def test_phase_label_proven_against_request_phases(tmp_path):
+    """slo.py's phase= usage pattern: a REQUEST_PHASES-guarded loop
+    passes, a free literal outside the enum fails."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "REQUEST_PHASES = ('submit', 'decode')\n"
+        "def feed(durs):\n"
+        "    for phase, d in durs:\n"
+        "        if phase in REQUEST_PHASES:\n"
+        "            observe.histogram('singa_p', 'a')"
+        ".observe(d, phase=phase)\n"
+        "observe.histogram('singa_p', 'a')"
+        ".observe(1.0, phase='teardown')\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 1, problems
+    assert "'teardown'" in problems[0]

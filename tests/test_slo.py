@@ -1,0 +1,516 @@
+"""Request-level serving observability (ISSUE-12): phase-stamped
+request timelines ring-buffered per engine (locked copies under
+concurrent submit/evict), the Trace Event export whose flow events link
+each request to the decode-step slices it rode, the SLO tracker's
+multi-window burn-rate math on synthetic violation sequences, and a
+FaultPlan-injected TTFT degradation tripping KIND_SLO within the
+sustain window while a clean engine stays at 100% attainment."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, health, models, observe, resilience, tensor
+from singa_tpu import engine as eng
+from singa_tpu import slo
+from singa_tpu.slo import (REQUEST_PHASES, SLO_OBJECTIVES, SLOConfig,
+                           SLOTracker)
+
+
+def _gpt(vocab=97, max_seq=64, dim=64, heads=4, layers=2):
+    dev = device.best_device()
+    m = models.create_model(
+        "gpt", vocab_size=vocab, max_seq=max_seq, dim=dim,
+        num_heads=heads, num_layers=layers)
+    ids = tensor.from_numpy(
+        np.random.RandomState(0).randint(0, vocab, (2, 8))
+        .astype(np.int32), device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    return _gpt()
+
+
+# ---- enums & pure math -----------------------------------------------------
+
+def test_phase_and_objective_enums():
+    assert REQUEST_PHASES == ("submit", "queue", "admit", "prefill",
+                              "first_token", "decode", "terminal")
+    assert SLO_OBJECTIVES == ("ttft_p99", "latency_p99", "availability",
+                              "tokens_per_sec")
+
+
+def _rec(ts=0.0, outcome="completed", ttft=0.01, total=0.1, rate=100.0):
+    return {"ts": ts, "outcome": outcome, "ttft_s": ttft,
+            "total_s": total, "tokens_per_sec": rate}
+
+
+def test_objective_good_semantics():
+    cfg = SLOConfig(ttft_p99_s=0.1, latency_p99_s=1.0,
+                    availability=0.99, min_tokens_per_sec=10.0)
+    ok = _rec()
+    assert slo.objective_good("ttft_p99", ok, cfg) is True
+    assert slo.objective_good("ttft_p99", _rec(ttft=0.2), cfg) is False
+    # a queue-expired timeout never reached a first token: violation
+    assert slo.objective_good(
+        "ttft_p99", _rec(outcome="timeout", ttft=None), cfg) is False
+    # a path that doesn't MEASURE ttft (beam note_decode) is not
+    # applicable — not a 0%-attainment false alarm
+    assert slo.objective_good(
+        "ttft_p99", _rec(outcome="completed", ttft=None), cfg) is None
+    # rejected = deliberate shed: excluded from latency-shaped
+    # objectives, counts as available
+    assert slo.objective_good(
+        "ttft_p99", _rec(outcome="rejected", ttft=None), cfg) is None
+    assert slo.objective_good(
+        "availability", _rec(outcome="rejected"), cfg) is True
+    assert slo.objective_good(
+        "availability", _rec(outcome="timeout"), cfg) is False
+    assert slo.objective_good(
+        "availability", _rec(outcome="evicted"), cfg) is False
+    # latency/rate judged on successes only
+    assert slo.objective_good(
+        "latency_p99", _rec(outcome="evicted", total=9.0), cfg) is None
+    assert slo.objective_good(
+        "latency_p99", _rec(total=2.0), cfg) is False
+    assert slo.objective_good(
+        "tokens_per_sec", _rec(rate=1.0), cfg) is False
+    assert slo.objective_good("tokens_per_sec", ok, cfg) is True
+
+
+def test_burn_rate_math_on_synthetic_violation_sequence():
+    """Exact attainment + burn arithmetic over a constructed window:
+    50/100 TTFT violations against a p99 target = attainment 0.5, burn
+    (1-0.5)/(1-0.99) = 50x; windowing excludes old records; a zero
+    budget (availability target 1.0) stays finite."""
+    cfg = SLOConfig(ttft_p99_s=0.1, availability=0.9,
+                    window_s=100.0, fast_window_s=10.0,
+                    slow_window_s=100.0)
+    now = 1000.0
+    recs = [_rec(ts=now - 1 - i, ttft=0.2 if i < 50 else 0.01)
+            for i in range(100)]
+    att = slo.attainment(recs, cfg, now=now)
+    assert att["ttft_p99"] == {"good": 50, "total": 100,
+                               "attainment": 0.5}
+    assert att["availability"]["attainment"] == 1.0
+    assert slo.burn_rate(0.5, 0.99) == pytest.approx(50.0)
+    assert slo.burn_rate(1.0, 0.99) == 0.0
+    assert slo.burn_rate(None, 0.99) is None
+    assert slo.burn_rate(0.9, 1.0) == pytest.approx(0.1 / 1e-6)
+    # records older than the window fall out
+    att_fast = slo.attainment(recs, cfg, now=now, window_s=5.0)
+    assert att_fast["ttft_p99"]["total"] == 5  # ts now-1..now-5
+    # ancient records: empty window -> attainment None
+    att_empty = slo.attainment(recs, cfg, now=now + 10_000)
+    assert att_empty["ttft_p99"]["attainment"] is None
+
+
+def test_multiwindow_burn_gate_and_sustain(monkeypatch):
+    """The breach verdict needs BOTH windows burning for `sustain`
+    consecutive evaluations; it fires note_external(KIND_SLO) exactly
+    once per episode and re-arms after recovery."""
+    mon = health.HealthMonitor(policy="warn")
+    health.set_active_monitor(mon)
+    clock = [1000.0]
+    cfg = SLOConfig(ttft_p99_s=0.1, window_s=100.0, fast_window_s=10.0,
+                    slow_window_s=100.0, burn_threshold=2.0, sustain=2,
+                    min_requests=3, eval_interval_s=1e9)
+    tr = SLOTracker(cfg, clock=lambda: clock[0])
+    # slow window full of violations, but the FAST window clean:
+    # no breach (the fast window says the burn already stopped)
+    for i in range(20):
+        tr.note_record(_rec(ts=960.0 + i * 0.5, ttft=0.5))
+    for i in range(5):
+        tr.note_record(_rec(ts=995.0 + i, ttft=0.01))
+    v = tr.evaluate(now=clock[0])
+    o = v["objectives"]["ttft_p99"]
+    assert o["burn_slow"] > 2.0 and o["burn_fast"] == 0.0
+    assert not o["burning"] and not v["breaching"]
+    # now the fast window degrades too: burning, but sustain=2 means
+    # the FIRST evaluation must not breach yet
+    for i in range(5):
+        tr.note_record(_rec(ts=996.0 + i, ttft=0.5))
+    v = tr.evaluate(now=clock[0])
+    assert v["objectives"]["ttft_p99"]["burning"]
+    assert not v["breaching"]
+    c = observe.get_registry().get("singa_health_anomaly_total")
+    assert c is None or c.value(kind=health.KIND_SLO) == 0
+    v = tr.evaluate(now=clock[0])
+    assert v["breaching"] == ["ttft_p99"]
+    assert v["objectives"]["ttft_p99"]["breach"]
+    c = observe.get_registry().get("singa_health_anomaly_total")
+    assert c.value(kind=health.KIND_SLO) == 1
+    assert mon.last_action == "warn"
+    b = observe.get_registry().get("singa_slo_breach_total")
+    assert b.value(objective="ttft_p99") == 1
+    # still breaching on the next eval: the episode fires only ONCE
+    tr.evaluate(now=clock[0])
+    assert c.value(kind=health.KIND_SLO) == 1
+    # recovery: clean traffic floods both windows -> re-armed, and a
+    # fresh degradation fires a NEW episode
+    clock[0] = 1200.0
+    for i in range(10):
+        tr.note_record(_rec(ts=1190.0 + i, ttft=0.01))
+    v = tr.evaluate(now=clock[0])
+    assert not v["breaching"]
+    for i in range(10):
+        tr.note_record(_rec(ts=1195.0 + i * 0.5, ttft=0.5))
+    tr.evaluate(now=clock[0])
+    tr.evaluate(now=clock[0])
+    assert c.value(kind=health.KIND_SLO) == 2
+
+
+def test_tracker_metrics_exported():
+    cfg = SLOConfig(ttft_p99_s=0.1, availability=0.9,
+                    eval_interval_s=1e9)
+    tr = SLOTracker(cfg, clock=lambda: 100.0)
+    tr.note_record(_rec(ts=99.0))
+    tr.note_record(_rec(ts=99.5, ttft=0.5))  # one violation
+    tr.evaluate(now=100.0)
+    reg = observe.get_registry()
+    assert reg.get("singa_slo_attainment_pct").value(
+        objective="ttft_p99") == pytest.approx(50.0)
+    assert reg.get("singa_slo_violations_total").value(
+        objective="ttft_p99") == 1
+    assert reg.get("singa_slo_window_requests").value() == 2
+    assert reg.get("singa_slo_evaluations_total").value() >= 1
+    assert reg.get("singa_slo_burn_rate_slow").value(
+        objective="ttft_p99") == pytest.approx(50.0)
+    assert reg.get("singa_slo_error_budget_remaining").value(
+        objective="ttft_p99") == pytest.approx(-49.0)
+
+
+# ---- engine timelines ------------------------------------------------------
+
+def test_request_timeline_phases_trace_schema_and_flow_links(gpt):
+    """One engine run, two assertions families (engine builds pay an
+    AOT compile each — tier-1 budget): (a) the phase-stamped timeline
+    (order, per-sync tokens progress, durations); (b) the exported
+    Trace Event JSON (schema, queue/slot tracks, flow events binding
+    inside the decode-step slices the request rode)."""
+    e = eng.ServingEngine(gpt, max_slots=2, page_size=8, max_ctx=64,
+                          steps_per_sync=2).start()
+    try:
+        rng = np.random.RandomState(1)
+        hs = [e.submit(rng.randint(0, 97, (5,)), 8) for _ in range(2)]
+        h = e.submit(rng.randint(0, 97, (6,)), 9)
+        for hh in hs + [h]:
+            assert hh.wait(300) and hh.outcome == "completed"
+        tls = e.timelines()
+        tl = next(t for t in tls if t["id"] == h.id)
+        phases = [ev[0] for ev in tl["events"]]
+        assert phases[0] == "submit" and phases[-1] == "terminal"
+        assert all(p in REQUEST_PHASES for p in phases)
+        # lifecycle order: submit -> queue -> admit -> prefill ->
+        # first_token -> decode* -> terminal
+        order = [p for p in phases if p != "decode"]
+        assert order == ["submit", "queue", "admit", "prefill",
+                         "first_token", "terminal"]
+        # per-sync decode progress carries tokens-so-far + the sync id
+        decodes = [ev for ev in tl["events"] if ev[0] == "decode"]
+        assert decodes, tl
+        toks = [ev[2]["tokens"] for ev in decodes]
+        assert toks == sorted(toks) and toks[-1] == 9
+        assert [ev[2]["sync"] for ev in decodes] == tl["syncs"]
+        # stamps are monotonic
+        stamps = [ev[1] for ev in tl["events"]]
+        assert stamps == sorted(stamps)
+        assert tl["tokens_per_sec"] > 0
+        # per-phase durations sum to ~the request's total latency
+        durs = slo.phase_durations(tl)
+        assert {p for p, _ in durs} <= set(REQUEST_PHASES)
+        assert sum(d for _, d in durs) == pytest.approx(
+            stamps[-1] - stamps[0])
+        _assert_trace_flow_links(e)
+    finally:
+        e.stop()
+
+
+def test_timeline_ring_locked_copy_under_concurrent_submit(gpt):
+    """Readers (diag/fleet threads) take locked copies while the
+    decode thread appends: hammer timelines()/sync_records()/report()
+    from the test thread while a submitter thread streams requests —
+    no mutation-during-iteration, every entry well-formed, ring
+    bounded."""
+    e = eng.ServingEngine(gpt, max_slots=2, page_size=8, max_ctx=64,
+                          steps_per_sync=2, timeline_capacity=8,
+                          prompt_buckets=[8]).start()
+    errors = []
+
+    def submitter():
+        try:
+            rng = np.random.RandomState(2)
+            hs = [e.submit(rng.randint(0, 97, (rng.randint(1, 9),)),
+                           int(rng.randint(1, 5))) for _ in range(10)]
+            for h in hs:
+                if not h.wait(300):
+                    errors.append(f"request {h.id} stalled")
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errors.append(repr(exc))
+
+    t = threading.Thread(target=submitter)
+    t.start()
+    try:
+        deadline = time.monotonic() + 300
+        polls = 0
+        while t.is_alive() and time.monotonic() < deadline:
+            tls = e.timelines()
+            assert len(tls) <= 8  # ring stays bounded
+            for tl in tls:
+                assert tl["events"][0][0] == "submit"
+                assert tl["events"][-1][0] == "terminal"
+                assert tl["outcome"] in eng.REQUEST_OUTCOMES
+            e.sync_records()
+            e.report()
+            polls += 1
+            if polls % 8 == 0:  # the expensive full-trace build
+                slo.engine_trace_events(e)
+    finally:
+        t.join(timeout=300)
+        e.stop()
+    assert not errors, errors
+    assert not t.is_alive()
+
+
+def _assert_trace_flow_links(e):
+    """The exported Trace Event JSON is schema-valid (X slices carry
+    ts/dur/tid), request spans sit on queue/slot tracks, and a chosen
+    request's flow events (s -> t* -> f, one shared id) each land
+    INSIDE a serving.engine_step slice — the trace answers 'which
+    decode steps did this request ride'."""
+    trace = slo.engine_trace_events(e)
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    assert all(isinstance(ev.get("name"), str) and "ph" in ev
+               and "pid" in ev for ev in events)
+    xs = [ev for ev in events if ev["ph"] == "X"]
+    assert all("ts" in ev and "dur" in ev and "tid" in ev
+               for ev in xs)
+    steps = [ev for ev in xs
+             if ev["name"] == "serving.engine_step"]
+    assert steps
+    # track metadata names the queue + slot tracks
+    tnames = {ev["args"]["name"] for ev in events
+              if ev["ph"] == "M" and ev["name"] == "thread_name"}
+    assert "serve queue" in tnames
+    assert any(n.startswith("serve slot") for n in tnames)
+    tl = next(t for t in e.timelines() if t["syncs"])
+    rid = tl["id"]
+    spans = [ev for ev in xs if (ev.get("args") or {}).get("id")
+             == rid]
+    assert {ev["name"] for ev in spans} == {
+        f"req {rid} queued", f"req {rid} prefill",
+        f"req {rid} decode"}
+    # flow ids are pid-scoped: two replicas' "request 3" must not
+    # cross-link in a merged trace
+    import os
+    fid = slo.flow_event_id(os.getpid(), rid)
+    flows = [ev for ev in events if ev.get("cat") == "req_flow"
+             and ev.get("id") == fid]
+    assert [ev["ph"] for ev in flows] \
+        == ["s"] + ["t"] * (len(flows) - 2) + ["f"]
+    assert len(flows) - 1 == len(tl["syncs"])
+    for ev in flows[1:]:
+        assert any(s["pid"] == ev["pid"] and s["tid"] == ev["tid"]
+                   and s["ts"] <= ev["ts"] <= s["ts"] + s["dur"]
+                   for s in steps), ev
+    # the flow start sits inside the request's own decode span
+    start = flows[0]
+    dec = next(ev for ev in spans
+               if ev["name"] == f"req {rid} decode")
+    assert dec["ts"] <= start["ts"] <= dec["ts"] + dec["dur"]
+
+
+# ---- the degradation A/B (in-process) --------------------------------------
+
+def test_faultplan_ttft_degradation_trips_kind_slo(gpt):
+    """A FaultPlan delay on serving.engine_step stalls every decode
+    sync, so queued requests' TTFT degrades past the declared target:
+    the tracker must breach within the sustain window (burn both
+    windows), feed KIND_SLO to the monitor (/healthz flips to warn),
+    and list the violating requests with their timelines — while the
+    engine's OWN telemetry keeps serving (no raise into the decode
+    loop)."""
+    mon = health.HealthMonitor(policy="warn")
+    health.set_active_monitor(mon)
+    cfg = SLOConfig(ttft_p99_s=0.04, window_s=60.0, fast_window_s=5.0,
+                    slow_window_s=30.0, burn_threshold=2.0, sustain=2,
+                    min_requests=3, eval_interval_s=1e9)
+    tracker = SLOTracker(cfg).install()
+    plan = resilience.FaultPlan()
+    plan.delay("serving.engine_step", 0.12, times=10 ** 9)
+    e = eng.ServingEngine(gpt, max_slots=1, page_size=8, max_ctx=64,
+                          steps_per_sync=1).start()
+    try:
+        rng = np.random.RandomState(4)
+        # warm the executables BEFORE injecting, so compile time does
+        # not masquerade as the degradation
+        w = e.submit(rng.randint(0, 97, (5,)), 2)
+        assert w.wait(300)
+        resilience.install_fault_plan(plan)
+        # the anchor owns the single slot, so every later request
+        # queues behind delayed syncs -> TTFT ~ the injected delay
+        anchor = e.submit(rng.randint(0, 97, (5,)), 24)
+        evals_to_breach = None
+        n_evals = 0
+        for _ in range(6):
+            h = e.submit(rng.randint(0, 97, (4,)), 2)
+            assert h.wait(300), h.id
+            n_evals += 1
+            v = tracker.evaluate()
+            if v["breaching"] and evals_to_breach is None:
+                evals_to_breach = n_evals
+                break
+        assert evals_to_breach is not None, tracker.last_verdict()
+        assert evals_to_breach <= cfg.sustain + 3  # within 5 windows
+        assert "ttft_p99" in tracker.breaching()
+        assert mon.last_action == "warn"
+        assert mon.verdict()["status"] == "warn"
+        c = observe.get_registry().get("singa_health_anomaly_total")
+        assert c.value(kind=health.KIND_SLO) == 1
+        viol = tracker.violations()
+        assert viol and all("ttft_p99" in r["objectives"]
+                            for r in viol)
+        # the violating requests carry their full timelines
+        assert any(r["timeline"] is not None
+                   and r["timeline"]["events"][-1][0] == "terminal"
+                   for r in viol)
+        assert anchor is not None  # still decoding or done; either way
+    finally:
+        resilience.clear_fault_plan()
+        e.stop()
+        slo.reset()
+        health.set_active_monitor(None)
+
+
+def test_clean_engine_full_attainment_snapshot_and_no_data_line(gpt):
+    """The control arm on ONE engine build (AOT compiles dominate the
+    tier-1 budget): the fresh engine renders the explicit 'no data'
+    TTFT line (ISSUE-12 satellite fix — not pctile's empty-list
+    behavior); clean traffic with generous targets holds 100%
+    attainment on every objective with the monitor untouched; and the
+    fleet_serve snapshot carries the serving columns."""
+    mon = health.HealthMonitor(policy="warn")
+    health.set_active_monitor(mon)
+    cfg = SLOConfig(ttft_p99_s=60.0, latency_p99_s=120.0,
+                    availability=0.9, eval_interval_s=1e9)
+    tracker = SLOTracker(cfg).install()
+    e = eng.ServingEngine(gpt, max_slots=2, page_size=8,
+                          max_ctx=64, steps_per_sync=2).start()
+    try:
+        # zero terminal requests: the explicit no-data line
+        assert eng.pctile([], 0.5) is None
+        rep = eng.serving_report()
+        assert "ttft: no data (0 admitted requests)" in rep
+        assert "ttft p50" not in rep
+        r = e.report()
+        assert r["ttft_p50_s"] is None and r["ttft_p99_s"] is None
+        rng = np.random.RandomState(5)
+        hs = [e.submit(rng.randint(0, 97, (6,)), 5) for _ in range(4)]
+        for h in hs:
+            assert h.wait(300) and h.outcome == "completed"
+        # ...and with traffic the line flips to percentiles + rps
+        rep = eng.serving_report()
+        assert "ttft p50" in rep and "rps" in rep
+        assert "no data" not in rep
+        v = tracker.evaluate()
+        for obj in cfg.enabled():
+            assert v["objectives"][obj]["attainment"] == 1.0
+            assert not v["objectives"][obj]["burning"]
+        assert not v["breaching"]
+        c = observe.get_registry().get("singa_health_anomaly_total")
+        assert c is None or c.value(kind=health.KIND_SLO) == 0
+        assert mon.last_action is None
+        # the fleet_serve shard line
+        snap = slo.fleet_serve_snapshot()
+        assert snap["engines"] == 1 and snap["slots"] == 2
+        assert snap["kv_cache_bytes"] > 0
+        assert snap["finished"]["completed"] == 4
+        assert snap["ttft_p99_s"] is not None
+        assert snap["slo"]["objectives"]["ttft_p99"]["attainment"] \
+            == 1.0
+        assert snap["timelines"] and snap["syncs"] is not None
+        assert slo.serve_attainment_pct(snap) == 100.0
+    finally:
+        e.stop()
+        slo.reset()
+        health.set_active_monitor(None)
+    # without engine or tracker: no serve line rides the shard
+    assert slo.fleet_serve_snapshot() is None
+
+
+# ---- lifecycle & wiring ----------------------------------------------------
+
+def test_install_uninstall_listener_lifecycle():
+    t1 = SLOTracker(SLOConfig(ttft_p99_s=1.0))
+    t1.install()
+    assert slo.get_tracker() is t1
+    assert eng.request_listeners() == [t1._on_request]
+    # a second install REPLACES the first (old listener detached)
+    t2 = SLOTracker(SLOConfig(ttft_p99_s=1.0)).install()
+    assert slo.get_tracker() is t2
+    assert eng.request_listeners() == [t2._on_request]
+    slo.reset()
+    assert slo.get_tracker() is None
+    assert eng.request_listeners() == []
+
+
+def test_dense_decode_path_feeds_tracker(gpt):
+    """serving.py wiring: a static-batch m.generate call lands in the
+    installed tracker as a completed record, so /slo answers for
+    dense-path deployments too."""
+    tracker = SLOTracker(SLOConfig(latency_p99_s=600.0,
+                                   eval_interval_s=1e9)).install()
+    try:
+        prompt = np.random.RandomState(6).randint(0, 97, (2, 8))
+        gpt.generate(prompt, 3, temperature=0.0)
+        recs = tracker.window_records(window_s=1e9)
+        # one record PER SEQUENCE in the batch, at the per-request
+        # rate — min_tokens_per_sec is a per-request floor, and a
+        # batch must not weigh as one sample
+        assert len(recs) == 2
+        assert all(r["outcome"] == "completed" for r in recs)
+        assert recs[0]["total_s"] > 0
+        assert recs[0]["tokens_per_sec"] == pytest.approx(
+            3 / recs[0]["total_s"])
+    finally:
+        slo.reset()
+
+
+def test_slo_report_without_tracker():
+    assert "no SLOTracker installed" in slo.slo_report()
+    assert slo.slo_json() == {"installed": False}
+
+
+def test_read_surfaces_do_not_advance_sustain():
+    """Review fix (ISSUE-12): /slo, /statusz and fleet shard publishes
+    read through `current_verdict()`, which respects the eval cadence
+    — poll frequency must not fast-forward the 'sustain consecutive
+    evaluations' state machine into a breach the configured cadence
+    would not have convicted."""
+    cfg = SLOConfig(ttft_p99_s=0.1, window_s=100.0, fast_window_s=10.0,
+                    slow_window_s=100.0, sustain=2, min_requests=3,
+                    eval_interval_s=1e9)
+    tr = SLOTracker(cfg, clock=lambda: 1000.0).install()
+    try:
+        for _ in range(6):
+            tr.note_record(_rec(ts=999.0, ttft=0.5))  # burning hard
+        v1 = tr.current_verdict()  # first read evaluates once
+        assert v1["objectives"]["ttft_p99"]["burning"]
+        for _ in range(10):
+            slo.slo_report()
+            slo.slo_json()
+            slo.fleet_serve_snapshot()
+        assert tr._evals == v1["evaluations"]  # throttle held
+        assert not tr.breaching()  # scrapes observed, didn't convict
+        # the cadence itself still convicts: one more REAL evaluation
+        tr.evaluate(now=1000.0)
+        assert tr.breaching() == ["ttft_p99"]
+    finally:
+        slo.reset()

@@ -26,7 +26,9 @@ records), and serving latencies (any metric naming `ttft` or a
 `*_p50`/`*_p99` percentile — BENCHDEC_r06's engine TTFT records, even
 when unit-less) regress UP, everything else (throughput, ratios,
 ok-flags) regresses DOWN. Rate units ("tokens/s") always win over the
-name heuristics.
+name heuristics, and SLO `attainment` metrics are higher-is-better
+even though they end in percentile-looking suffixes (`_pct`) — a drop
+in attainment is the regression (SLO_r*.json records).
 
 Usage: `python tools/bench_trend.py [DIR|FILES...] [--threshold 0.05]`
 (default DIR = the repo root). `--latest-only` restricts regression
@@ -54,6 +56,11 @@ LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_latency", "_bytes",
 #: serving bench's TTFT records must trip the gate even when a round
 #: wrote them unit-less
 LOWER_BETTER_SUBSTRINGS = ("ttft",)
+#: name substrings that mark a higher-is-better metric even when a
+#: lower-better suffix would otherwise match — SLO attainment records
+#: end in `_pct` (and the percentile suffixes), but a DROP in
+#: attainment is the regression
+HIGHER_BETTER_SUBSTRINGS = ("attainment",)
 
 
 def parse_records(path: str, family: str):
@@ -146,6 +153,10 @@ def lower_is_better(metric: str, unit: str) -> bool:
         # a rate (tokens/s, items/s): higher is better — and this must
         # win over the name-suffix heuristic, or a `*_tok_s` throughput
         # metric would be misread as a latency
+        return False
+    if any(sub in metric.lower() for sub in HIGHER_BETTER_SUBSTRINGS):
+        # SLO attainment: named like a percentile (`_pct`, `_p99`
+        # fragments) but a fall is the regression
         return False
     if u in LOWER_BETTER_UNITS:
         return True

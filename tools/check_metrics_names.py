@@ -18,12 +18,13 @@ literal, then fails if
      metric names (copy-pasted helps make /metrics output ambiguous;
      every name must describe itself), or
   5. a `reason=` / `phase=` / `bucket=` / `region=` / `op=` /
-     `outcome=` label value on a metric record call
+     `outcome=` / `objective=` label value on a metric record call
      (.inc/.set/.observe/.dec) does not come from a declared enum: these
      labels are CONTRACTUALLY low-cardinality (introspect.py's
      RECOMPILE_REASONS / COMPILE_PHASES, goodput.py's GOODPUT_BUCKETS,
      memory.py's MEM_REGIONS, watchdog.py's DEADLINE_OPS, observe.py's
-     COMM_OPS, engine.py's REQUEST_OUTCOMES),
+     COMM_OPS, engine.py's REQUEST_OUTCOMES, slo.py's REQUEST_PHASES
+     and SLO_OBJECTIVES),
      so a string literal must be a
      member of a module-level ALL-CAPS tuple of string literals, a NAME
      must be a module-level constant whose value is a member, and a
@@ -114,12 +115,13 @@ def registrations_in(path, tree=None):
 
 
 # Enum-guarded label kwargs: values must be provably low-cardinality
-# (reason/phase: introspect.py's RECOMPILE_REASONS / COMPILE_PHASES;
-# bucket: goodput.py's GOODPUT_BUCKETS; region: memory.py's
-# MEM_REGIONS; op: watchdog.py's DEADLINE_OPS / observe.py's COMM_OPS;
-# outcome: engine.py's REQUEST_OUTCOMES).
+# (reason/phase: introspect.py's RECOMPILE_REASONS / COMPILE_PHASES and
+# slo.py's REQUEST_PHASES; bucket: goodput.py's GOODPUT_BUCKETS;
+# region: memory.py's MEM_REGIONS; op: watchdog.py's DEADLINE_OPS /
+# observe.py's COMM_OPS; outcome: engine.py's REQUEST_OUTCOMES;
+# objective: slo.py's SLO_OBJECTIVES).
 ENUM_LABEL_KWARGS = ("reason", "phase", "bucket", "region", "op",
-                     "outcome")
+                     "outcome", "objective")
 RECORD_FUNCS = {"inc", "set", "observe", "dec"}
 
 # Rule 6: `host=` label values must originate in the cluster topology.
