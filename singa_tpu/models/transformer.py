@@ -276,7 +276,7 @@ class GPT(_VocabTPMixin, model.Model):
         assert num_beams <= self.vocab_size, \
             f"num_beams {num_beams} exceeds vocab_size {self.vocab_size}"
         B, S0 = ids.shape
-        assert kv_dtype in (None, "int8"), kv_dtype
+        assert kv_dtype in (None, "int8", "int4"), kv_dtype
         sig = ("beam", B, S0, max_new_tokens, num_beams,
                float(length_penalty), eos_id, pad_id, dtype,
                moe_capacity_factor, kv_dtype)
@@ -296,13 +296,19 @@ class GPT(_VocabTPMixin, model.Model):
 
     def generate(self, prompt, max_new_tokens, temperature=0.0, top_k=None,
                  seed=0, dtype=None, moe_capacity_factor=None,
-                 kv_dtype=None):
+                 kv_dtype=None, draft_model=None, spec_k=0):
         """Autoregressive sampling: greedy (temperature=0) or
         temperature/top-k. `prompt` is (B, S0) int32 (numpy or Tensor);
         returns (B, S0+max_new_tokens) numpy. The decode function is
         compiled once per (B, S0, max_new_tokens, sampler, dtype)
         signature. `dtype="bfloat16"` casts weights/activations for the
-        decode (≈2x faster on TPU: each step is weight-bandwidth-bound)."""
+        decode (≈2x faster on TPU: each step is weight-bandwidth-bound).
+        `kv_dtype` quantizes the KV cache ("int8", or packed-nibble
+        "int4"). `draft_model`/`spec_k` switch GREEDY decode to
+        draft-model speculative decoding (serving.build_spec_decode):
+        the draft proposes spec_k tokens per round, the target verifies
+        them in one batched forward — output tokens are identical to
+        plain greedy by construction, only the wall time changes."""
         import jax
         import numpy as np
         ids = prompt.numpy() if isinstance(prompt, Tensor) \
@@ -317,12 +323,29 @@ class GPT(_VocabTPMixin, model.Model):
         elif top_k is not None:
             top_k = max(1, min(int(top_k), self.vocab_size))
         B, S0 = ids.shape
-        assert kv_dtype in (None, "int8"), kv_dtype
-        sig = (B, S0, max_new_tokens, float(temperature), top_k, dtype,
-               moe_capacity_factor, kv_dtype)
+        assert kv_dtype in (None, "int8", "int4"), kv_dtype
         cache = getattr(self, "_decode_cache", None)
         if cache is None:
             cache = self._decode_cache = {}
+        if draft_model is not None and spec_k:
+            assert temperature == 0.0, \
+                "speculative decoding is greedy-only (temperature=0)"
+            assert draft_model.vocab_size >= self.vocab_size, \
+                "draft vocab must cover the target's"
+            from ..serving import build_spec_decode, decode_state
+            sig = ("spec", B, S0, max_new_tokens, int(spec_k), dtype,
+                   moe_capacity_factor, kv_dtype, id(draft_model))
+            fn = cache.get(sig)
+            if fn is None:
+                fn = cache[sig] = build_spec_decode(
+                    self, draft_model, B, S0, max_new_tokens,
+                    int(spec_k), dtype, moe_capacity_factor, kv_dtype)
+            out = fn(self._decode_state(dtype),
+                     decode_state(draft_model, dtype),
+                     ids.astype(np.int32))
+            return np.asarray(jax.device_get(out))
+        sig = (B, S0, max_new_tokens, float(temperature), top_k, dtype,
+               moe_capacity_factor, kv_dtype)
         fn = cache.get(sig)
         if fn is None:
             fn = cache[sig] = self._build_decode(

@@ -870,7 +870,53 @@ def _ring_jnp(q, k, v, axis_name: str, causal=False, scale=None):
     return (acc / l).astype(q.dtype)
 
 
-# ======================= 4. paged decode attention =======================
+# ======================= 4. int4 nibble packing ==========================
+#
+# int4 KV quantization packs TWO 4-bit values per byte along the lane
+# (feature) dimension, split-half layout: byte j of a packed row holds
+# lane j in its LOW nibble and lane j + L/2 in its HIGH nibble, so the
+# unpack is a concat of two sign-extended halves — no strided interleave,
+# and a per-token cache-row write stays a contiguous byte-aligned slice
+# (packing along the token dim would force read-modify-write of bytes
+# shared between positions). Values are symmetric int4 in [-7, 7] with
+# the same per-(head, position) scale layout the int8 path uses (scale
+# basis max|kv| / 7 instead of / 127) — the scale algebra downstream is
+# IDENTICAL, only the byte stream halves again.
+
+def nibble_pack(q):
+    """(..., L) int values in [-8, 7] -> (..., L/2) uint8, split-half
+    layout (low nibble = lane j, high nibble = lane j + L/2)."""
+    L = q.shape[-1]
+    assert L % 2 == 0, f"nibble_pack needs an even last dim, got {L}"
+    u = q.astype(jnp.uint8)
+    lo = u[..., : L // 2] & 0xF
+    hi = u[..., L // 2:] & 0xF
+    return (hi << 4) | lo
+
+
+def nibble_unpack(p, dtype=jnp.float32):
+    """(..., L/2) uint8 -> (..., L) `dtype`, inverting nibble_pack.
+    Arithmetic runs in int32 (sign extension via the 0x8 test) so the
+    same expression lowers in Pallas/Mosaic and under plain XLA."""
+    x = p.astype(jnp.int32)
+    lo = x & 0xF
+    hi = (x >> 4) & 0xF
+    lo = lo - ((lo & 0x8) << 1)
+    hi = hi - ((hi & 0x8) << 1)
+    return jnp.concatenate([lo, hi], axis=-1).astype(dtype)
+
+
+def _kv_dequant(blk, qdtype):
+    """Pool/cache block -> matmul operand in the query dtype: int4
+    (uint8 packed) unpacks nibbles, int8 casts, float passes through."""
+    if blk.dtype == jnp.uint8:
+        return nibble_unpack(blk, qdtype)
+    if blk.dtype == jnp.int8:
+        return blk.astype(qdtype)
+    return blk
+
+
+# ======================= 5. paged decode attention =======================
 #
 # The serving engine's ragged decode path (singa_tpu.engine): each active
 # sequence owns a host-assigned list of fixed-size KV-cache PAGES in a
@@ -897,29 +943,51 @@ def _ring_jnp(q, k, v, axis_name: str, causal=False, scale=None):
 # int8 KV is preserved: per-(head, position) scale pools ride along and
 # fold into scores/weights exactly as the dense token_step does.
 
-def _paged_factors(sc, groups, rows):
+def _paged_factors(sc, groups, rows, q_tokens=1):
     """(T?, P) per-position scales -> (rows, T?) row factors for packed
     block-diagonal queries: row q = c*groups + g reads lane block c.
-    Rows beyond P*groups (query padding) get factor 1."""
-    pg = sc.shape[-1] * groups
+    With `q_tokens` > 1 (the speculative verify step) the row layout is
+    (q_tokens, P, groups) — every token's P*G block reads the same
+    per-position factors, so the block is tiled along the row dim.
+    Rows beyond q_tokens*P*groups (query padding) get factor 1."""
     f = jnp.repeat(sc.swapaxes(-1, -2), groups, axis=-2)  # (P*G, T)
+    if q_tokens > 1:
+        f = jnp.concatenate([f] * q_tokens, axis=-2)
+    pg = sc.shape[-1] * groups * q_tokens
     if rows > pg:
         pad = jnp.ones(f.shape[:-2] + (rows - pg, f.shape[-1]), f.dtype)
         f = jnp.concatenate([f, pad], axis=-2)
     return f
 
 
+def _row_limits(lengths, Q, rows_per_token, q_tokens):
+    """(N,) final lengths -> (N, Q) per-query-row KV limits. Query rows
+    are laid out (q_tokens, P, G): token ti's rows attend positions
+    < lengths - (q_tokens - 1 - ti) — the causal ladder of the
+    multi-token verify step. q_tokens == 1 is the plain decode case
+    (every row sees `lengths` positions). Padding rows (>= q_tokens *
+    rows_per_token) inherit the LAST token's limit (outputs
+    discarded)."""
+    ti = jnp.minimum(jnp.arange(Q) // rows_per_token, q_tokens - 1)
+    return lengths[:, None] - (q_tokens - 1 - ti)[None, :]
+
+
 def paged_attention_reference(q, k_pool, v_pool, page_table, lengths,
                               page_size, scale=1.0, k_scales=None,
-                              v_scales=None, groups=1):
+                              v_scales=None, groups=1, q_tokens=1):
     """Ground-truth paged decode attention.
 
-    q:          (N, Hp, Q, PD) packed block-diagonal queries (Q = P*G)
+    q:          (N, Hp, Q, PD) packed block-diagonal queries
+                (Q = q_tokens * P * G; q_tokens > 1 is the speculative
+                verify step — token ti's rows attend q_tokens-1-ti
+                fewer positions, the causal ladder)
     k_pool/v_pool: (n_pages, Hp, page_size, PD) shared page pools
-                (int8 when k_scales/v_scales are given)
+                (int8 when k_scales/v_scales are given; packed uint8
+                (n_pages, Hp, page_size, PD/2) for int4 KV)
     page_table: (N, M) int32 — page ids per sequence, row-major in time
-    lengths:    (N,) int32 — valid KV positions per sequence (>= 1)
-    k_scales/v_scales: (n_pages, Hp, page_size, P) fp32 (int8 KV only)
+    lengths:    (N,) int32 — valid KV positions per sequence (>= 1),
+                counted at the LAST query token under q_tokens > 1
+    k_scales/v_scales: (n_pages, Hp, page_size, P) fp32 (quantized KV)
 
     Returns (N, Hp, Q, PD). The math is the dense token_step's masked
     softmax over the gathered pages — gathers materialize a copy, which
@@ -933,30 +1001,36 @@ def paged_attention_reference(q, k_pool, v_pool, page_table, lengths,
         g = jnp.moveaxis(g, 2, 1)              # (N, Hp, M, ps, ·)
         return g.reshape(N, Hp, T, g.shape[-1])
 
-    ks = gather(k_pool)
-    vs = gather(v_pool)
-    kf = ks.astype(q.dtype) if ks.dtype == jnp.int8 else ks
-    vf = vs.astype(q.dtype) if vs.dtype == jnp.int8 else vs
+    kf = _kv_dequant(gather(k_pool), q.dtype)
+    vf = _kv_dequant(gather(v_pool), q.dtype)
     s = jnp.einsum("nhqd,nhtd->nhqt", q, kf) * scale
     if k_scales is not None:
-        s = s * _paged_factors(gather(k_scales), groups, Q)
+        s = s * _paged_factors(gather(k_scales), groups, Q, q_tokens)
+    limits = _row_limits(lengths, Q, Q // max(q_tokens, 1), q_tokens)
     valid = (lax.broadcasted_iota(jnp.int32, (1, 1, 1, T), 3)
-             < lengths[:, None, None, None])
+             < limits[:, None, :, None])
     a = jax.nn.softmax(jnp.where(valid, s, -jnp.inf), axis=-1)
     if v_scales is not None:
-        a = a * _paged_factors(gather(v_scales), groups, Q)
+        a = a * _paged_factors(gather(v_scales), groups, Q, q_tokens)
     return jnp.einsum("nhqt,nhtd->nhqd", a.astype(q.dtype),
                       vf).astype(q.dtype)
 
 
 def _paged_fwd_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
-                      nM, page_size, groups, kv8):
+                      nM, page_size, groups, kvq, q_tokens,
+                      rows_per_token):
     """Grid (N, Hp, pages): stream one sequence's pages through VMEM and
     run the online softmax. Pages past the sequence length are gated
     (compute) and their DMA elided (index map re-addresses the last
-    needed page). CONTRACT: fully sequential grid — the scratch state
-    persists across the page dimension."""
-    if kv8:
+    needed page). int8 K/V cast in-kernel; int4 K/V arrive as packed
+    uint8 (ps, PD/2) blocks and UNPACK in-kernel (nibble_unpack in
+    int32 arithmetic) — the HBM stream is the packed bytes, the MXU
+    sees the query dtype. With q_tokens > 1 (speculative verify) query
+    rows are laid out (q_tokens, P, G) and token ti's rows mask
+    positions >= len - (q_tokens-1-ti): the causal ladder. CONTRACT:
+    fully sequential grid — the scratch state persists across the page
+    dimension."""
+    if kvq:
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
         o_ref, acc_ref, m_ref, l_ref = rest
@@ -975,25 +1049,34 @@ def _paged_fwd_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
 
     def _update():
         # q arrives PRE-SCALED (the wrapper folds the softmax scale in,
-        # like flash); int8 K/V cast to the query dtype for native MXU
-        # dots, scales fold in exactly as the dense kv8 token_step does
+        # like flash); quantized K/V dequant in-kernel to the query
+        # dtype for native MXU dots, scales fold in exactly as the
+        # dense quantized token_step does
         q = q_ref[0, 0]                         # (Qp, PD)
-        k_blk = k_ref[0, 0].astype(q.dtype)     # (ps, PD)
-        v_blk = v_ref[0, 0].astype(q.dtype)
+        k_blk = _kv_dequant(k_ref[0, 0], q.dtype)   # (ps, PD)
+        v_blk = _kv_dequant(v_ref[0, 0], q.dtype)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        if kv8:
-            s = s * _paged_factors(ks_ref[0, 0], groups, s.shape[0])
+        if kvq:
+            s = s * _paged_factors(ks_ref[0, 0], groups, s.shape[0],
+                                   q_tokens)
         pos = pg * page_size + lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
-        s = jnp.where(pos < ln, s, _NEG_INF)
+        if q_tokens > 1:
+            ti = jnp.minimum(
+                lax.broadcasted_iota(jnp.int32, (s.shape[0], 1), 0)
+                // rows_per_token, q_tokens - 1)
+            s = jnp.where(pos < ln - (q_tokens - 1 - ti), s, _NEG_INF)
+        else:
+            s = jnp.where(pos < ln, s, _NEG_INF)
         m_prev = m_ref[...][:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)
         l_new = l_ref[...][:, :1] * corr \
             + jnp.sum(p, axis=-1, keepdims=True)
-        if kv8:
-            p = p * _paged_factors(vs_ref[0, 0], groups, p.shape[0])
+        if kvq:
+            p = p * _paged_factors(vs_ref[0, 0], groups, p.shape[0],
+                                   q_tokens)
         acc_ref[...] = acc_ref[...] * corr + jnp.dot(
             p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32)
@@ -1009,11 +1092,15 @@ def _paged_fwd_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
 
 
 def _paged_fwd_pallas(q, k_pool, v_pool, page_table, lengths, page_size,
-                      scale, k_scales, v_scales, groups, interpret):
+                      scale, k_scales, v_scales, groups, interpret,
+                      q_tokens=1):
     N, Hp, Q, PD = q.shape
     M = page_table.shape[1]
     ps = page_size
-    kv8 = k_scales is not None
+    kvq = None
+    if k_scales is not None:
+        kvq = "int4" if k_pool.dtype == jnp.uint8 else "int8"
+    PDk = k_pool.shape[-1]          # PD, or PD/2 for packed int4
     # pad query rows to the 8-sublane alignment; extra rows are zeros
     # (their softmax output is garbage over a zero query — discarded)
     Qp = max(8, Q + (-Q) % 8)
@@ -1035,11 +1122,11 @@ def _paged_fwd_pallas(q, k_pool, v_pool, page_table, lengths, page_size,
 
     in_specs = [
         pl.BlockSpec((1, 1, Qp, PD), q_map),
-        pl.BlockSpec((1, 1, ps, PD), page_map),
-        pl.BlockSpec((1, 1, ps, PD), page_map),
+        pl.BlockSpec((1, 1, ps, PDk), page_map),
+        pl.BlockSpec((1, 1, ps, PDk), page_map),
     ]
     operands = [qf, k_pool, v_pool]
-    if kv8:
+    if kvq:
         in_specs += [pl.BlockSpec((1, 1, ps, k_scales.shape[-1]),
                                   page_map),
                      pl.BlockSpec((1, 1, ps, v_scales.shape[-1]),
@@ -1058,7 +1145,8 @@ def _paged_fwd_pallas(q, k_pool, v_pool, page_table, lengths, page_size,
     )
     out = pl.pallas_call(
         functools.partial(_paged_fwd_kernel, nM=M, page_size=ps,
-                          groups=groups, kv8=kv8),
+                          groups=groups, kvq=kvq, q_tokens=q_tokens,
+                          rows_per_token=Q // max(q_tokens, 1)),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((N, Hp, Qp, PD), q.dtype),
         interpret=interpret,
@@ -1068,26 +1156,231 @@ def _paged_fwd_pallas(q, k_pool, v_pool, page_table, lengths, page_size,
 
 def paged_attention(q, k_pool, v_pool, page_table, lengths, page_size,
                     scale=1.0, k_scales=None, v_scales=None, groups=1,
-                    use_kernel=None):
+                    use_kernel=None, q_tokens=1):
     """Paged decode attention: dispatch between the Pallas page-streaming
     kernel and the gather-based reference (see paged_attention_reference
-    for shapes). `use_kernel=None` picks the kernel only on a real TPU
-    backend — off-TPU the kernel would run in interpret mode, unrolling
-    the whole (N, Hp, pages) grid into every traced decode step;
-    `use_kernel=True` forces it (interpret off-TPU, how the agreement
-    test exercises the kernel path), False forces the reference."""
+    for shapes — int8 and packed-int4 pools dequantize in-kernel;
+    q_tokens > 1 runs the speculative verify's causal ladder over
+    (q_tokens, P, G)-laid-out query rows). `use_kernel=None` picks the
+    kernel only on a real TPU backend — off-TPU the kernel would run in
+    interpret mode, unrolling the whole (N, Hp, pages) grid into every
+    traced decode step; `use_kernel=True` forces it (interpret off-TPU,
+    how the agreement test exercises the kernel path), False forces the
+    reference."""
     N, Hp, Q, PD = q.shape
     ps = int(page_size)
-    aligned = (ps % 8 == 0 and PD % 128 == 0)
+    on_tpu = jax.default_backend() == "tpu"
+    # lane/sublane alignment gates only the COMPILED path; interpret
+    # mode (the off-TPU agreement tests, incl. int4's PD/2-lane packed
+    # pools at small test dims) has no tiling constraint
+    aligned = (ps % 8 == 0 and PD % 128 == 0
+               and k_pool.shape[-1] % 128 == 0)
     if use_kernel is None:
-        use_kernel = jax.default_backend() == "tpu" and aligned
-    if not use_kernel or not _HAS_PALLAS or not aligned:
+        use_kernel = on_tpu and aligned
+    if not use_kernel or not _HAS_PALLAS or (on_tpu and not aligned):
         return paged_attention_reference(
             q, k_pool, v_pool, page_table, lengths, ps, scale,
-            k_scales, v_scales, groups)
-    interpret = jax.default_backend() != "tpu"
+            k_scales, v_scales, groups, q_tokens)
+    interpret = not on_tpu
     return _paged_fwd_pallas(q, k_pool, v_pool, page_table, lengths, ps,
-                             scale, k_scales, v_scales, groups, interpret)
+                             scale, k_scales, v_scales, groups, interpret,
+                             q_tokens)
+
+
+# ======================= 6. dense flash-decode ===========================
+#
+# The dense serving path's decode attention (serving._DecodeCore
+# token_step / verify_step): one packed block-diagonal query row block
+# per sequence against a CONTIGUOUS (N, Hp, T, PD) head-packed cache,
+# masked to each sequence's live length. Same two-tier contract as
+# paged_attention — `flash_decode_reference` is the jnp ground truth
+# (and the off-TPU dispatch default; a decode step is tiny, an
+# interpret-mode grid unrolled into every scan step is not), the Pallas
+# kernel streams T blocks through VMEM with the online softmax, masked
+# blocks' DMA elided via a scalar-prefetched length clamp. Quantized
+# caches (int8, packed-nibble int4) dequantize IN-KERNEL: HBM streams
+# the quantized bytes — the whole point of the quantization — and the
+# MXU sees the query dtype. q_tokens > 1 runs the speculative verify
+# ladder (token ti's rows attend q_tokens-1-ti fewer positions).
+
+def flash_decode_reference(q, K, V, lengths, scale=1.0, k_scales=None,
+                           v_scales=None, groups=1, q_tokens=1):
+    """Ground-truth dense decode attention.
+
+    q:        (N, Hp, Q, PD) packed block-diagonal queries
+              (Q = q_tokens * P * G)
+    K/V:      (N, Hp, T, PD) head-packed caches (float or int8), or
+              packed uint8 (N, Hp, T, PD/2) for int4 KV
+    lengths:  (N,) int32 — live positions per sequence, counted at the
+              LAST query token under q_tokens > 1
+    k_scales/v_scales: (N, Hp, T, P) fp32 (quantized KV only)
+
+    Returns (N, Hp, Q, PD) — the dense token_step's masked softmax
+    with the quantization-scale folding of the int8/int4 cache modes."""
+    N, Hp, Q, PD = q.shape
+    T = K.shape[2]
+    kf = _kv_dequant(K, q.dtype)
+    vf = _kv_dequant(V, q.dtype)
+    s = jnp.einsum("nhqd,nhtd->nhqt", q, kf) * scale
+    if k_scales is not None:
+        s = s * _paged_factors(k_scales, groups, Q, q_tokens)
+    limits = _row_limits(lengths, Q, Q // max(q_tokens, 1), q_tokens)
+    valid = (lax.broadcasted_iota(jnp.int32, (1, 1, 1, T), 3)
+             < limits[:, None, :, None])
+    a = jax.nn.softmax(jnp.where(valid, s, -jnp.inf), axis=-1)
+    if v_scales is not None:
+        a = a * _paged_factors(v_scales, groups, Q, q_tokens)
+    return jnp.einsum("nhqt,nhtd->nhqd", a.astype(q.dtype),
+                      vf).astype(q.dtype)
+
+
+def _flash_decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest,
+                         nT, block_t, groups, kvq, q_tokens,
+                         rows_per_token):
+    """Grid (N, Hp, t_blocks): stream one sequence's cache blocks
+    through VMEM with the online softmax; blocks past the live length
+    are gated (compute) and their DMA elided (index map clamps to the
+    last needed block). Same contract as the paged kernel: fully
+    sequential grid, scratch persists across the t dimension."""
+    if kvq:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+        ks_ref = vs_ref = None
+    n = pl.program_id(0)
+    tb = pl.program_id(2)
+
+    @pl.when(tb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ln = len_ref[n]
+    needed = tb * block_t < ln
+
+    def _update():
+        q = q_ref[0, 0]                              # (Qp, PD), scaled
+        k_blk = _kv_dequant(k_ref[0, 0], q.dtype)    # (bt, PD)
+        v_blk = _kv_dequant(v_ref[0, 0], q.dtype)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if kvq:
+            s = s * _paged_factors(ks_ref[0, 0], groups, s.shape[0],
+                                   q_tokens)
+        pos = tb * block_t + lax.broadcasted_iota(
+            jnp.int32, (1, block_t), 1)
+        if q_tokens > 1:
+            ti = jnp.minimum(
+                lax.broadcasted_iota(jnp.int32, (s.shape[0], 1), 0)
+                // rows_per_token, q_tokens - 1)
+            s = jnp.where(pos < ln - (q_tokens - 1 - ti), s, _NEG_INF)
+        else:
+            s = jnp.where(pos < ln, s, _NEG_INF)
+        m_prev = m_ref[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[...][:, :1] * corr \
+            + jnp.sum(p, axis=-1, keepdims=True)
+        if kvq:
+            p = p * _paged_factors(vs_ref[0, 0], groups, p.shape[0],
+                                   q_tokens)
+        acc_ref[...] = acc_ref[...] * corr + jnp.dot(
+            p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    pl.when(needed)(_update)
+
+    @pl.when(tb == nT - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[...][:, :1], 1e-20)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _flash_decode_pallas(q, K, V, lengths, scale, k_scales, v_scales,
+                         groups, interpret, q_tokens, block_t):
+    N, Hp, Q, PD = q.shape
+    T = K.shape[2]
+    bt = block_t
+    nT = T // bt
+    kvq = None
+    if k_scales is not None:
+        kvq = "int4" if K.dtype == jnp.uint8 else "int8"
+    PDk = K.shape[-1]
+    Qp = max(8, Q + (-Q) % 8)
+    qf = (q * scale).astype(q.dtype)
+    if Qp != Q:
+        qf = jnp.concatenate(
+            [qf, jnp.zeros((N, Hp, Qp - Q, PD), qf.dtype)], axis=2)
+    lengths = jnp.maximum(lengths.astype(jnp.int32), 1)
+
+    def t_map(n, hp, tb, len_ref):
+        # clamp to the last needed block so masked steps' DMA elides
+        last = jnp.minimum((len_ref[n] - 1) // bt, nT - 1)
+        return (n, hp, jnp.minimum(tb, last), 0)
+
+    def q_map(n, hp, tb, len_ref):
+        return (n, hp, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, Qp, PD), q_map),
+        pl.BlockSpec((1, 1, bt, PDk), t_map),
+        pl.BlockSpec((1, 1, bt, PDk), t_map),
+    ]
+    operands = [qf, K, V]
+    if kvq:
+        in_specs += [pl.BlockSpec((1, 1, bt, k_scales.shape[-1]), t_map),
+                     pl.BlockSpec((1, 1, bt, v_scales.shape[-1]), t_map)]
+        operands += [k_scales, v_scales]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(N, Hp, nT),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, Qp, PD), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((Qp, PD), jnp.float32),
+            pltpu.VMEM((Qp, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((Qp, _STAT_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_flash_decode_kernel, nT=nT, block_t=bt,
+                          groups=groups, kvq=kvq, q_tokens=q_tokens,
+                          rows_per_token=Q // max(q_tokens, 1)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, Hp, Qp, PD), q.dtype),
+        interpret=interpret,
+    )(lengths, *operands)
+    return out[:, :, :Q, :]
+
+
+def flash_decode(q, K, V, lengths, scale=1.0, k_scales=None,
+                 v_scales=None, groups=1, use_kernel=None, q_tokens=1,
+                 block_t=None):
+    """Dense decode attention: dispatch between the Pallas
+    block-streaming kernel and the jnp reference (see
+    flash_decode_reference for shapes). `use_kernel=None` picks the
+    kernel only on a real TPU backend with tiling alignment;
+    `use_kernel=True` forces it (interpret off-TPU — the agreement
+    tests), False forces the reference."""
+    N, Hp, Q, PD = q.shape
+    T = K.shape[2]
+    on_tpu = jax.default_backend() == "tpu"
+    bt = block_t if block_t is not None else _fit_block(
+        T, min(256, T), floor=8)
+    aligned = (bt is not None and PD % 128 == 0
+               and K.shape[-1] % 128 == 0 and bt % 8 == 0)
+    if use_kernel is None:
+        use_kernel = on_tpu and aligned
+    if not use_kernel or not _HAS_PALLAS or bt is None \
+            or (on_tpu and not aligned):
+        return flash_decode_reference(q, K, V, lengths, scale, k_scales,
+                                      v_scales, groups, q_tokens)
+    return _flash_decode_pallas(q, K, V, lengths, scale, k_scales,
+                                v_scales, groups, not on_tpu, q_tokens,
+                                bt)
 
 
 def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False):

@@ -30,6 +30,32 @@ from __future__ import annotations
 
 import weakref
 
+#: every KV-cache storage mode the serving stack supports (the
+#: `kv_dtype=` label on singa_serve_* metrics is proven against this
+#: tuple by tools/check_metrics_names.py rule 5). "fp" is the
+#: activation-dtype cache (the kv_dtype=None API spelling), int8 the
+#: per-(head, position)-scaled byte cache, int4 the packed-nibble cache
+#: (two values per byte, same scale layout, bytes halved again).
+KV_DTYPES = ("fp", "int8", "int4")
+
+#: speculative-decoding per-token verdicts (the `verdict=` label on
+#: singa_spec_tokens_total is proven against this tuple by rule 5):
+#: "drafted" counts every draft proposal, "accepted" the proposals the
+#: target verified, "bonus" the target's own token each verify round
+#: emits for free, "wasted" = drafted - accepted (rejected proposals —
+#: the compute spent buying nothing).
+SPEC_VERDICTS = ("drafted", "accepted", "bonus", "wasted")
+
+#: quantized-KV modes (subset of KV_DTYPES the quantizer handles)
+_KVQ = ("int8", "int4")
+
+
+def kv_label(kv_dtype) -> str:
+    """Map the API spelling (None/'int8'/'int4') onto KV_DTYPES."""
+    label = kv_dtype or "fp"
+    assert label in KV_DTYPES, kv_dtype
+    return label
+
 
 def _quant8(W):
     """Per-output-channel symmetric int8 quantization of a (in, out)
@@ -99,17 +125,25 @@ class _DecodeCore:
     """
 
     def __init__(self, H, E, S0, T, scale, moe_ks=None, kv_heads=None,
-                 rope=False, rope_theta=10000.0, kv8=False):
+                 rope=False, rope_theta=10000.0, kv_dtype=None):
         self.H, self.E, self.S0, self.T, self.scale = H, E, S0, T, scale
         self.rope = bool(rope)
         self.rope_theta = float(rope_theta)
-        # kv8: int8 KV cache with per-(head, position) symmetric scales.
-        # The algebra stays exact-in-structure: K-scales multiply scores
-        # per source position after the packed matmul, and V-scales fold
-        # into the attention weights for the DIAGONAL (own-head) block —
-        # the only block the packed extraction keeps, so the off-block
-        # garbage scaling is discarded with the cross-terms.
-        self.kv8 = bool(kv8)
+        # quantized KV (kv_dtype "int8" or "int4"): per-(head, position)
+        # symmetric scales. The algebra stays exact-in-structure:
+        # K-scales multiply scores per source position after the packed
+        # matmul, and V-scales fold into the attention weights for the
+        # DIAGONAL (own-head) block — the only block the packed
+        # extraction keeps, so the off-block garbage scaling is
+        # discarded with the cross-terms. int4 packs two nibbles per
+        # byte along the lane dim (ops.attention.nibble_pack's
+        # split-half layout) with the same scale shapes; only the
+        # quantization basis (max|kv|/7) and the byte stream change.
+        assert kv_dtype in (None,) + _KVQ, kv_dtype
+        self.kv_dtype = kv_dtype
+        self.kv8 = kv_dtype == "int8"
+        self.kv4 = kv_dtype == "int4"
+        self.kvq = kv_dtype in _KVQ
         # static per-layer MoE routing degree (None = dense MLP); must be
         # static (int() under jit) so it lives here, not in the param tree
         self.moe_ks = moe_ks or []
@@ -186,16 +220,33 @@ class _DecodeCore:
             .reshape(n, Hkv // P, S, P * D)
 
     def _quant_kv(self, kv, n, S):
-        """(n,Hkv,S,D) -> (packed int8 (n,Hp,S,P*D),
-        scales (n,Hp,S,P) fp32): per-(head, position) symmetric."""
+        """(n,Hkv,S,D) -> (packed quantized cache rows, scales
+        (n,Hp,S,P) fp32): per-(head, position) symmetric. int8 mode
+        yields int8 (n,Hp,S,P*D); int4 yields packed-nibble uint8
+        (n,Hp,S,P*D/2) (two values per byte, split-half lane layout —
+        see ops.attention.nibble_pack) on a max|kv|/7 basis."""
         import jax.numpy as jnp
         P, Hkv = self.P, self.Hkv
+        qmax = 7.0 if self.kv4 else 127.0
         s = jnp.maximum(jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1),
-                        1e-8) / 127.0                       # (n,Hkv,S)
+                        1e-8) / qmax                        # (n,Hkv,S)
         q = jnp.clip(jnp.round(kv.astype(jnp.float32) / s[..., None]),
-                     -127, 127).astype(jnp.int8)
+                     -qmax, qmax).astype(jnp.int8)
         sp = s.reshape(n, Hkv // P, P, S).swapaxes(2, 3)    # (n,Hp,S,P)
-        return self._pack(q, n, S), sp
+        packed = self._pack(q, n, S)
+        if self.kv4:
+            from .ops.attention import nibble_pack
+            packed = nibble_pack(packed)
+        return packed, sp
+
+    def _dequant_cache(self, packed, dtype):
+        """Quantized cache rows -> matmul operand in `dtype` (int8 cast,
+        int4 nibble unpack) for the XLA einsum paths; the Pallas
+        kernels do the same transform in-kernel instead."""
+        if self.kv4:
+            from .ops.attention import nibble_unpack
+            return nibble_unpack(packed, dtype)
+        return packed.astype(dtype)
 
     def _scale_rows(self, sp, G):
         """(n,Hp,T,P) per-position scales -> (n,Hp,P*G,T) row factors
@@ -257,15 +308,17 @@ class _DecodeCore:
         Hkv = self.Hkv
         h, kvs = self.prefill_parts(p, prompt, n)
         caches = []
+        qw = (P * D) // 2 if self.kv4 else P * D
+        qd = jnp.uint8 if self.kv4 else jnp.int8
         for k, v in kvs:
-            if self.kv8:
+            if self.kvq:
                 k8, ks = self._quant_kv(k, n, S0)
                 v8, vs = self._quant_kv(v, n, S0)
-                Kc = (jnp.zeros((n, Hkv // P, T, P * D), jnp.int8)
+                Kc = (jnp.zeros((n, Hkv // P, T, qw), qd)
                       .at[:, :, :S0].set(k8),
                       jnp.zeros((n, Hkv // P, T, P), jnp.float32)
                       .at[:, :, :S0].set(ks))
-                Vc = (jnp.zeros((n, Hkv // P, T, P * D), jnp.int8)
+                Vc = (jnp.zeros((n, Hkv // P, T, qw), qd)
                       .at[:, :, :S0].set(v8),
                       jnp.zeros((n, Hkv // P, T, P), jnp.float32)
                       .at[:, :, :S0].set(vs))
@@ -347,7 +400,7 @@ class _DecodeCore:
             if self.rope:
                 q = apply_rope(q, rcos, rsin)
                 kn = apply_rope(kn, rcos, rsin)
-            if self.kv8:
+            if self.kvq:
                 (K8, Ks), (V8, Vs) = pool
                 k8, ks = self._quant_kv(kn[:, :, None], n, 1)
                 v8, vs = self._quant_kv(vn[:, :, None], n, 1)
@@ -380,10 +433,93 @@ class _DecodeCore:
         logits = _mm(ln(h, p["gf"], p["bf"]), p["head"])
         return logits, new_pools
 
-    def token_step(self, p, tok, caches, i, n):
+    def paged_verify_step(self, p, toks, pools, page_table, lens,
+                          active, n, page_size, n_pages, k,
+                          use_kernel=None, write_limits=None):
+        """The speculative VERIFY step against the PAGED pool: feed
+        `toks` (n, k) at per-slot positions lens[i]..lens[i]+k-1 in ONE
+        batched forward — write all k K/V rows into each slot's pages
+        (inactive slots, and positions at or past `write_limits`
+        (exclusive bound, default the page-table horizon), scatter
+        out-of-bounds and DROP), then attend via paged_attention's
+        q_tokens causal ladder. Returns (logits (n, k, V), new pools):
+        logits[:, j] equals the j-th sequential paged_token_step's
+        logits for every committed position — the engine's spec==greedy
+        anchor. Dropped-write positions only ever feed DISCARDED ladder
+        outputs (take is capped at the slot's remaining budget)."""
+        import jax.numpy as jnp
+        from .ops.attention import paged_attention
+        D, E, P = self.E // self.H, self.E, self.P
+        G = self.G
+        ln = self.ln
+        ps = page_size
+        nidx = jnp.arange(n)
+        posk = lens[:, None] + jnp.arange(k)[None, :]      # (n, k)
+        pos_emb = jnp.minimum(posk, self.T - 1)
+        h = p["emb"][toks] + (0 if self.rope else p["pos"][pos_emb])
+        if self.rope:
+            from .autograd import rope_tables, apply_rope
+            rcos, rsin = rope_tables(pos_emb.reshape(-1), D,
+                                     self.rope_theta)
+            rcos = rcos.reshape(n, k, D)[:, None]          # (n,1,k,D)
+            rsin = rsin.reshape(n, k, D)[:, None]
+        wl = write_limits if write_limits is not None \
+            else jnp.full((n,), self.T, jnp.int32)
+        ok_w = active[:, None] & (posk < wl[:, None])
+        pvec = jnp.where(ok_w, page_table[nidx[:, None],
+                                          posk // ps], n_pages)
+        off = posk % ps
+        ln_att = jnp.where(active, lens + k, 1)
+        new_pools = []
+        for li, (bp, pool) in enumerate(zip(p["blocks"], pools)):
+            x = ln(h, bp["g1"], bp["b1"])
+            q, kn, vn = self.qkv(bp, x, n, S=k)  # q (n,H,k,D)
+            if self.rope:
+                q = apply_rope(q, rcos, rsin)
+                kn = apply_rope(kn, rcos, rsin)
+            if self.kvq:
+                (K8, Ks), (V8, Vs) = pool
+                k8, ks = self._quant_kv(kn, n, k)
+                v8, vs = self._quant_kv(vn, n, k)
+                K8 = K8.at[pvec, :, off, :].set(
+                    k8.swapaxes(1, 2), mode="drop")
+                Ks = Ks.at[pvec, :, off, :].set(
+                    ks.swapaxes(1, 2), mode="drop")
+                V8 = V8.at[pvec, :, off, :].set(
+                    v8.swapaxes(1, 2), mode="drop")
+                Vs = Vs.at[pvec, :, off, :].set(
+                    vs.swapaxes(1, 2), mode="drop")
+                pool = ((K8, Ks), (V8, Vs))
+                Kmat, Vmat, Ksc, Vsc = K8, V8, Ks, Vs
+            else:
+                K, V = pool
+                kp = self._pack(kn, n, k)
+                vp = self._pack(vn, n, k)
+                K = K.at[pvec, :, off, :].set(
+                    kp.swapaxes(1, 2), mode="drop")
+                V = V.at[pvec, :, off, :].set(
+                    vp.swapaxes(1, 2), mode="drop")
+                pool = (K, V)
+                Kmat, Vmat, Ksc, Vsc = K, V, None, None
+            Q2 = self._pack_q_multi(q, n, k)
+            O2 = paged_attention(
+                Q2, Kmat, Vmat, page_table, ln_att, ps,
+                scale=self.scale, k_scales=Ksc, v_scales=Vsc,
+                groups=G, use_kernel=use_kernel, q_tokens=k)
+            o = self._unpack_o_multi(O2.astype(x.dtype), n, k)
+            h = h + _mm(o, bp["Wo"]) + bp["bo"]
+            x = ln(h, bp["g2"], bp["b2"])
+            h = h + self.mlp(bp, x, li)
+            new_pools.append(pool)
+        logits = _mm(ln(h, p["gf"], p["bf"]), p["head"])
+        return logits, new_pools
+
+    def token_step(self, p, tok, caches, i, n, use_kernel=None):
         """Feed token `tok` (n,) at generated-index `i` (position S0+i)
         through all blocks against the caches; returns (logits (n, V),
-        new caches)."""
+        new caches). `use_kernel=None` routes attention through the
+        Pallas flash-decode kernel on TPU (in-kernel dequant for
+        quantized caches) and the inline einsum math elsewhere."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -406,7 +542,7 @@ class _DecodeCore:
                 q = apply_rope(q, rcos, rsin)
                 kn = apply_rope(kn, rcos, rsin)
             # packed caches: one contiguous (P*D)-lane row per token
-            if self.kv8:
+            if self.kvq:
                 (K8, Ks), (V8, Vs) = Kc, Vc
                 k8, ks = self._quant_kv(kn[:, :, None], n, 1)
                 v8, vs = self._quant_kv(vn[:, :, None], n, 1)
@@ -415,7 +551,8 @@ class _DecodeCore:
                 V8 = lax.dynamic_update_slice(V8, v8, (0, 0, pos_idx, 0))
                 Vs = lax.dynamic_update_slice(Vs, vs, (0, 0, pos_idx, 0))
                 Kc, Vc = (K8, Ks), (V8, Vs)
-                Kmat, Vmat = K8.astype(x.dtype), V8.astype(x.dtype)
+                Kmat = self._dequant_cache(K8, x.dtype)
+                Vmat = self._dequant_cache(V8, x.dtype)
             else:
                 Kc = lax.dynamic_update_slice(
                     Kc, kn.reshape(n, Hp, 1, P * D), (0, 0, pos_idx, 0))
@@ -426,16 +563,39 @@ class _DecodeCore:
             # contraction with the packed K yields exactly the per-head
             # scores (GQA: G rows per block; MHA is the G=1 case)
             Q2 = self._pack_q(q, n)
-            s = jnp.einsum("nhqj,nhtj->nhqt", Q2, Kmat) * self.scale
-            if self.kv8:
-                # K-scales: one factor per (source position, own block)
-                s = s * self._scale_rows(Ks, G)
-            a = jax.nn.softmax(jnp.where(kmask, s, -jnp.inf), axis=-1)
-            if self.kv8:
-                # V-scales fold into the weights for the own-head block
-                # (the only one extracted below)
-                a = (a * self._scale_rows(Vs, G)).astype(x.dtype)
-            O2 = jnp.einsum("nhqt,nhtj->nhqj", a, Vmat)  # (n,Hp,P*G,P*D)
+            use_k = use_kernel if use_kernel is not None \
+                else jax.default_backend() == "tpu"
+            if use_k:
+                # TPU: the Pallas flash-decode kernel streams the cache
+                # blockwise — quantized caches stream their BYTES and
+                # dequantize in-kernel (the whole point of int8/int4);
+                # the XLA einsum below would materialize the dequant
+                from .ops.attention import flash_decode
+                lens_att = jnp.broadcast_to(pos_idx + 1, (n,)) \
+                    .astype(jnp.int32)
+                if self.kvq:
+                    O2 = flash_decode(
+                        Q2, K8, V8, lens_att, scale=self.scale,
+                        k_scales=Ks, v_scales=Vs, groups=G,
+                        use_kernel=use_k).astype(x.dtype)
+                else:
+                    O2 = flash_decode(
+                        Q2, Kc, Vc, lens_att, scale=self.scale,
+                        groups=G, use_kernel=use_k).astype(x.dtype)
+            else:
+                s = jnp.einsum("nhqj,nhtj->nhqt", Q2, Kmat) * self.scale
+                if self.kvq:
+                    # K-scales: one factor per (source position, own
+                    # block)
+                    s = s * self._scale_rows(Ks, G)
+                a = jax.nn.softmax(jnp.where(kmask, s, -jnp.inf),
+                                   axis=-1)
+                if self.kvq:
+                    # V-scales fold into the weights for the own-head
+                    # block (the only one extracted below)
+                    a = (a * self._scale_rows(Vs, G)).astype(x.dtype)
+                O2 = jnp.einsum("nhqt,nhtj->nhqj", a,
+                                Vmat)           # (n,Hp,P*G,P*D)
             o = self._unpack_o(O2, n)
             h = h + _mm(o, bp["Wo"]) + bp["bo"]
             x = ln(h, bp["g2"], bp["b2"])
@@ -443,6 +603,156 @@ class _DecodeCore:
             new_caches.append((Kc, Vc))
         logits = _mm(ln(h, p["gf"], p["bf"]), p["head"])
         return logits, new_caches
+
+    def _pack_q_multi(self, q, n, k):
+        """(n, H, k, D) per-head queries for k tokens -> packed
+        block-diagonal (n, Hp, k*P*G, P*D), token-major rows (the
+        (q_tokens, P, G) layout ops.attention's q_tokens ladder
+        expects)."""
+        import jax.numpy as jnp
+        Hp = self.Hkv // self.P
+        PG = self.P * self.G
+        PD = self.P * (self.E // self.H)
+        qf = q.swapaxes(1, 2).reshape(n * k, self.H,
+                                      self.E // self.H)
+        Q2 = self._pack_q(qf, n * k)            # (n*k, Hp, PG, PD)
+        return jnp.moveaxis(Q2.reshape(n, k, Hp, PG, PD), 1, 2) \
+            .reshape(n, Hp, k * PG, PD)
+
+    def _unpack_o_multi(self, O2, n, k):
+        """(n, Hp, k*P*G, P*D) packed attention output -> (n, k, E)."""
+        import jax.numpy as jnp
+        Hp = self.Hkv // self.P
+        PG = self.P * self.G
+        PD = self.P * (self.E // self.H)
+        O5 = jnp.moveaxis(O2.reshape(n, Hp, k, PG, PD), 2, 1) \
+            .reshape(n * k, Hp, PG, PD)
+        return self._unpack_o(O5, n * k).reshape(n, k, self.E)
+
+    def verify_step(self, p, toks, caches, pos, active, n, k,
+                    use_kernel=None):
+        """The speculative VERIFY step: feed `toks` (n, k) at per-row
+        positions pos[i]..pos[i]+k-1 through all blocks in ONE batched
+        forward — writes all k KV rows (per-row scatter; inactive rows
+        and positions past the cache drop), then attends with the
+        causal ladder (token j sees cache positions <= pos+j) via
+        ops.attention.flash_decode's q_tokens mode. Returns (logits
+        (n, k, V), new caches): logits[:, j] is the target's own next
+        token after consuming toks[:, :j+1] — exactly the j-th
+        sequential token_step's logits, which is what makes
+        longest-accepted-prefix speculative decoding greedy-exact.
+        k == 1 with a scalar-broadcast `pos` is token_step's math at
+        per-row positions (the draft loop uses it that way)."""
+        import jax
+        import jax.numpy as jnp
+        from .ops.attention import flash_decode
+        D, E, P = self.E // self.H, self.E, self.P
+        G, Hkv = self.G, self.Hkv
+        Hp = Hkv // P
+        ln = self.ln
+        nidx = jnp.arange(n)
+        posk = pos[:, None] + jnp.arange(k)[None, :]       # (n, k)
+        pos_emb = jnp.minimum(posk, self.T - 1)
+        h = p["emb"][toks] + (0 if self.rope
+                              else p["pos"][pos_emb])      # (n, k, E)
+        if self.rope:
+            from .autograd import rope_tables, apply_rope
+            rcos, rsin = rope_tables(pos_emb.reshape(-1), D,
+                                     self.rope_theta)
+            rcos = rcos.reshape(n, k, D)[:, None]          # (n,1,k,D)
+            rsin = rsin.reshape(n, k, D)[:, None]
+        # inactive rows and positions past the cache scatter to row T
+        # and are DROPPED (the cache time dim is T)
+        posw = jnp.where(active[:, None] & (posk < self.T), posk,
+                         self.T)                           # (n, k)
+        # NOT clamped to T: the ladder limit for token ti is
+        # lens_att - (k-1-ti); clamping would truncate the LAST
+        # tokens' masks in the final rounds near the cache end
+        # (token ti must always see its own position pos+ti — the
+        # positions past T it can also "see" were drop-written and
+        # only ever feed discarded outputs)
+        lens_att = pos + k                                 # (n,)
+        new_caches = []
+        for li, ((Kc, Vc), bp) in enumerate(zip(caches, p["blocks"])):
+            x = ln(h, bp["g1"], bp["b1"])
+            q, kn, vn = self.qkv(bp, x, n, S=k)  # q (n,H,k,D)
+            if self.rope:
+                q = apply_rope(q, rcos, rsin)
+                kn = apply_rope(kn, rcos, rsin)
+            if self.kvq:
+                (K8, Ks), (V8, Vs) = Kc, Vc
+                k8, ks = self._quant_kv(kn, n, k)   # (n,Hp,k,·)
+                v8, vs = self._quant_kv(vn, n, k)
+                K8 = K8.at[nidx[:, None], :, posw, :].set(
+                    k8.swapaxes(1, 2), mode="drop")
+                Ks = Ks.at[nidx[:, None], :, posw, :].set(
+                    ks.swapaxes(1, 2), mode="drop")
+                V8 = V8.at[nidx[:, None], :, posw, :].set(
+                    v8.swapaxes(1, 2), mode="drop")
+                Vs = Vs.at[nidx[:, None], :, posw, :].set(
+                    vs.swapaxes(1, 2), mode="drop")
+                Kc, Vc = (K8, Ks), (V8, Vs)
+                Kq, Vq, Ksc, Vsc = K8, V8, Ks, Vs
+            else:
+                kp = self._pack(kn, n, k)           # (n,Hp,k,P*D)
+                vp = self._pack(vn, n, k)
+                Kc = Kc.at[nidx[:, None], :, posw, :].set(
+                    kp.swapaxes(1, 2), mode="drop")
+                Vc = Vc.at[nidx[:, None], :, posw, :].set(
+                    vp.swapaxes(1, 2), mode="drop")
+                Kq, Vq, Ksc, Vsc = Kc, Vc, None, None
+            Q2 = self._pack_q_multi(q, n, k)
+            O2 = flash_decode(Q2, Kq, Vq, lens_att, scale=self.scale,
+                              k_scales=Ksc, v_scales=Vsc, groups=G,
+                              q_tokens=k, use_kernel=use_kernel)
+            o = self._unpack_o_multi(O2.astype(x.dtype), n, k)
+            h = h + _mm(o, bp["Wo"]) + bp["bo"]
+            x = ln(h, bp["g2"], bp["b2"])
+            h = h + self.mlp(bp, x, li)
+            new_caches.append((Kc, Vc))
+        logits = _mm(ln(h, p["gf"], p["bf"]), p["head"])
+        return logits, new_caches
+
+
+def _spec_metrics():
+    """Speculative-decoding metrics, spelled out for the static lint
+    (verdict= values are members of SPEC_VERDICTS; kv_dtype= values of
+    KV_DTYPES via kv_label)."""
+    from . import observe
+    return {
+        "tokens": observe.counter(
+            "singa_spec_tokens_total",
+            "speculative-decoding tokens by verdict (drafted / "
+            "accepted / bonus / wasted)"),
+        "rounds": observe.counter(
+            "singa_spec_rounds_total",
+            "speculative verify rounds (one draft+verify cycle)"),
+        "acceptance": observe.gauge(
+            "singa_spec_acceptance_rate",
+            "last call/sync's accepted-over-drafted fraction"),
+    }
+
+
+def record_spec(drafted: int, accepted: int, bonus: int, rounds: int):
+    """Book one spec-decoding call/sync's draft economics into the
+    singa_spec_* metrics. Returns the acceptance fraction (None when
+    nothing was drafted)."""
+    from . import observe
+    rate = accepted / drafted if drafted > 0 else None
+    if not observe.is_enabled():
+        return rate
+    m = _spec_metrics()
+    if drafted:
+        m["tokens"].inc(float(drafted), verdict="drafted")
+        m["tokens"].inc(float(accepted), verdict="accepted")
+        m["tokens"].inc(float(drafted - accepted), verdict="wasted")
+    if bonus:
+        m["tokens"].inc(float(bonus), verdict="bonus")
+    if rounds:
+        m["rounds"].inc(float(rounds))
+    if rate is not None:
+        m["acceptance"].set(rate)
+    return rate
 
 
 def _set_col(buf, i, vals):
@@ -468,7 +778,7 @@ def _pool_merge(pool_tok, pool_norm, pool_raw, cand_tok, cand_norm,
     return new_tok, top_norm, new_raw
 
 
-def _decode_core(m, S0, max_new, moe_capacity_factor=None, kv8=False):
+def _decode_core(m, S0, max_new, moe_capacity_factor=None, kv_dtype=None):
     """Build the _DecodeCore matching model `m`'s static config."""
     H = m.blocks[0].attn.num_heads
     kv = m.blocks[0].attn.num_kv_heads
@@ -490,7 +800,7 @@ def _decode_core(m, S0, max_new, moe_capacity_factor=None, kv8=False):
                        rope=(getattr(m, "pos_encoding", "learned")
                              == "rope"),
                        rope_theta=getattr(m, "rope_theta", 10000.0),
-                       kv8=kv8)
+                       kv_dtype=kv_dtype)
 
 
 # ---- decode-param preparation + memo ------------------------------------
@@ -647,7 +957,7 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
     from . import observe
 
     core = _decode_core(m, S0, max_new, moe_capacity_factor,
-                        kv8=(kv_dtype == "int8"))
+                        kv_dtype=kv_dtype)
 
     def sample(logits, key):
         logits = logits.astype(jnp.float32)
@@ -771,6 +1081,183 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
     return decode
 
 
+def build_spec_decode(m, draft, B, S0, max_new, spec_k, dtype=None,
+                      moe_capacity_factor=None, kv_dtype=None,
+                      use_kernel=None):
+    """Draft-model speculative GREEDY decode fn:
+    (target_params, draft_params, prompt) -> (ids, stats).
+
+    Each round: the small draft model proposes `spec_k` tokens
+    sequentially against its own KV cache, the target verifies ALL of
+    them in ONE batched forward (verify_step: spec_k+1 tokens through
+    the cache, the causal ladder), and the longest accepted prefix plus
+    the target's own next token commit — 1..spec_k+1 tokens per round
+    at ~one decode step's weight traffic. Greedy-equivalence is exact
+    by construction: every committed token IS the target's argmax given
+    the committed prefix (the spec==greedy test enforces token-for-token
+    identity with build_decode's output). Per-row variable acceptance
+    rides an active mask + per-row positions, so the verify executable
+    compiles ONCE (a single lax.while_loop program).
+
+    The draft runs an fp KV cache regardless of the target's
+    `kv_dtype` — draft proposals only gate ACCEPTANCE, never
+    correctness, and the draft cache is small."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import observe
+
+    assert spec_k >= 1, spec_k
+    K = int(spec_k)
+    core = _decode_core(m, S0, max_new, moe_capacity_factor,
+                        kv_dtype=kv_dtype)
+    core_d = _decode_core(draft, S0, max_new, moe_capacity_factor,
+                          kv_dtype=None)
+
+    def prefill_stage(pt, pd, prompt):
+        logits0, caches = core.prefill(pt, prompt, B)
+        _dl, dcaches = core_d.prefill(pd, prompt, B)   # logits unused:
+        # the first token is the TARGET's — the draft only fills its
+        # own KV cache over the prompt here
+        tok0 = jnp.argmax(logits0.astype(jnp.float32),
+                          axis=-1).astype(jnp.int32)
+        nf0 = jnp.sum((~jnp.isfinite(logits0)).astype(jnp.int32))
+        return tok0, caches, dcaches, nf0
+
+    def spec_stage(pt, pd, tok0, caches, dcaches, nf0):
+        nidx = jnp.arange(B)
+        buf = jnp.zeros((B, max_new), jnp.int32).at[:, 0].set(tok0)
+        zero = jnp.int32(0)
+
+        def cond(c):
+            return jnp.any(c[1] < max_new)
+
+        def body(c):
+            buf, cnt, tok, caches, dcaches, nf, drafted, accepted, \
+                bonus, rounds = c
+            active = cnt < max_new
+            pos = S0 + cnt - 1          # the pending token's position
+
+            def dstep(carry, j):
+                dt, dc = carry
+                lg, dc = core_d.verify_step(
+                    pd, dt[:, None], dc, pos + j, active, B, 1,
+                    use_kernel=use_kernel)
+                nxt = jnp.argmax(lg[:, 0].astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                return (nxt, dc), nxt
+
+            # K+1 draft steps for K proposals: the extra step feeds
+            # d_K so the draft cache writes row pos+K too — when all
+            # K drafts accept (take = K+1, the bonus token commits at
+            # pos+K+1), that row would otherwise stay a ZERO hole the
+            # draft attends over forever after, silently degrading
+            # every later proposal's acceptance
+            (_, dcaches), drafts = lax.scan(
+                dstep, (tok, dcaches), jnp.arange(K + 1))
+            drafts = drafts[:K].T                   # (B, K)
+            feed = jnp.concatenate([tok[:, None], drafts], axis=1)
+            logits, caches = core.verify_step(
+                pt, feed, caches, pos, active, B, K + 1,
+                use_kernel=use_kernel)
+            g = jnp.argmax(logits.astype(jnp.float32),
+                           axis=-1).astype(jnp.int32)  # (B, K+1)
+            match = (g[:, :K] == drafts).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # (B,)
+            take = jnp.where(active,
+                             jnp.minimum(a + 1, max_new - cnt), 0)
+            j = jnp.arange(K + 1)[None, :]
+            idx = jnp.where(j < take[:, None], cnt[:, None] + j,
+                            max_new)
+            buf = buf.at[nidx[:, None], idx].set(g, mode="drop")
+            tok = jnp.where(active,
+                            g[nidx, jnp.clip(take - 1, 0, K)], tok)
+            cnt = cnt + take
+            # nf: only logits whose tokens commit (the rest are
+            # ladder positions past this row's budget — garbage by
+            # construction, not a health signal)
+            nf = nf + jnp.sum(((~jnp.isfinite(logits))
+                               & (j < take[:, None])[..., None])
+                              .astype(jnp.int32))
+            n_act = jnp.sum(active.astype(jnp.int32))
+            drafted = drafted + K * n_act
+            # a budget-truncated round (take <= a) commits ONLY
+            # accepted draft tokens — the bonus token exists only
+            # when the full a+1 window committed
+            bo_i = ((take > 0) & (take > a)).astype(jnp.int32)
+            accepted = accepted + jnp.sum(take - bo_i)
+            bonus = bonus + jnp.sum(bo_i)
+            return (buf, cnt, tok, caches, dcaches, nf, drafted,
+                    accepted, bonus, rounds + 1)
+
+        init = (buf, jnp.full((B,), 1, jnp.int32), tok0, caches,
+                dcaches, nf0, zero, zero, zero, zero)
+        buf, _, _, _, _, nf, drafted, accepted, bonus, rounds = \
+            lax.while_loop(cond, body, init) if max_new > 1 else init
+        return buf, nf, drafted, accepted, bonus, rounds
+
+    from . import introspect
+    prefill_jit = introspect.AotExecutor(
+        jax.jit(prefill_stage), "serving.spec_prefill",
+        names=("params", "draft_params", "prompt"))
+    spec_jit = introspect.AotExecutor(
+        jax.jit(spec_stage), "serving.spec_verify",
+        names=("params", "draft_params", "tok0", "caches",
+               "draft_caches", "nf"))
+
+    def decode(pt, pd, prompt):
+        from . import resilience, slo, watchdog
+        obs = observe.is_enabled()
+        sample = obs or slo.get_tracker() is not None
+        with watchdog.guard("decode", batch=B), \
+                observe.span("serving.decode", batch=B,
+                             new_tokens=max_new, spec_k=K):
+            resilience.fault_point("serving.decode", batch=B)
+            t0 = _time.perf_counter()
+            ttft = None
+            with observe.span("serving.prefill", batch=B,
+                              prompt_tokens=S0):
+                tok0, caches, dcaches, nf = prefill_jit(pt, pd, prompt)
+                if sample:
+                    jax.block_until_ready(tok0)
+                    ttft = _time.perf_counter() - t0
+            from . import memory
+            if memory.get_ledger() is not None and \
+                    not memory.region_has_provider(
+                        memory.REGION_KV_CACHE):
+                memory.note_arrays(memory.REGION_KV_CACHE,
+                                   (caches, dcaches))
+            with observe.span("serving.spec_verify", batch=B,
+                              new_tokens=max_new):
+                toks, nf, drafted, accepted, bonus, rounds = spec_jit(
+                    pt, pd, tok0, caches, dcaches, nf)
+            ids = jnp.concatenate(
+                [prompt if isinstance(prompt, jax.Array)
+                 else jnp.asarray(prompt), toks], axis=1)
+            if sample:
+                jax.block_until_ready(ids)
+                total = _time.perf_counter() - t0
+                drafted, accepted, bonus, rounds = (
+                    int(v) for v in jax.device_get(
+                        (drafted, accepted, bonus, rounds)))
+                record_spec(drafted, accepted, bonus, rounds)
+                if obs:
+                    observe.record_decode(
+                        "spec", total, new_tokens=B * max_new,
+                        batch=B, ttft=ttft, prompt_tokens=B * S0)
+                    from . import health
+                    health.record_nan_logits(int(jax.device_get(nf)),
+                                             "spec")
+                slo.note_decode("spec", total, B * max_new, ttft=ttft,
+                                batch=B)
+        return ids
+
+    return decode
+
+
 def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
                       eos_id, dtype, pad_id=None, moe_capacity_factor=None,
                       kv_dtype=None):
@@ -782,7 +1269,7 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
     V = m.vocab_size
     K = num_beams
     core = _decode_core(m, S0, max_new, moe_capacity_factor,
-                        kv8=(kv_dtype == "int8"))
+                        kv_dtype=kv_dtype)
     NEG = jnp.float32(-1e9)
     pad = 0 if eos_id is None else (pad_id if pad_id is not None
                                     else eos_id)
@@ -923,5 +1410,6 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
     return run
 
 
-__all__ = ["build_decode", "build_beam_decode", "decode_state",
-           "decode_params", "decode_raw"]
+__all__ = ["build_decode", "build_beam_decode", "build_spec_decode",
+           "decode_state", "decode_params", "decode_raw",
+           "KV_DTYPES", "SPEC_VERDICTS", "kv_label", "record_spec"]

@@ -204,3 +204,36 @@ def test_attainment_fixture_regression_flagged():
     rnd, v, best_r, best, delta = regs["toy_serve_slo_attainment_pct"]
     assert (rnd, v, best_r, best) == (2, 90.0, 1, 100.0)
     assert abs(delta - 0.1) < 1e-9
+
+
+def test_acceptance_metrics_higher_is_better():
+    """ISSUE-13 satellite: speculative-decoding `accept`/`acceptance`
+    metrics are higher-is-better even when percentile-suffixed or
+    unit-less — a falling acceptance rate is the regression; rate units
+    and plain percentiles keep their directions."""
+    assert not bench_trend.lower_is_better(
+        "gpt_specdec_acceptance_rate_pct_cfg", "pct")
+    assert not bench_trend.lower_is_better("toy_spec_accepted_tokens", "")
+    assert not bench_trend.lower_is_better(
+        "toy_spec_acceptance_rate_pct", "")
+    # non-accept percentiles/TTFTs still regress UP
+    assert bench_trend.lower_is_better("toy_spec_ttft_p99", "")
+    assert bench_trend.lower_is_better("gpt_specdec_step_ms", "ms")
+
+
+def test_acceptance_fixture_regression_flagged():
+    """The checked-in SPEC fixtures carry an acceptance-rate series:
+    improving in clean/ (82 -> 88, no flag), dropping in regress/
+    (88 -> 66, flagged DOWN against the best prior round)."""
+    clean = bench_trend.trend_table(bench_trend.collect([CLEAN]))
+    assert clean["toy_spec_acceptance_rate_pct"]["by_round"] \
+        == {1: 82.0, 2: 88.0}
+    assert not [r for r in bench_trend.find_regressions(clean)
+                if r[0] == "toy_spec_acceptance_rate_pct"]
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = {m: (rnd, v, best_r, best, delta)
+            for m, rnd, v, best_r, best, delta
+            in bench_trend.find_regressions(table, threshold=0.05)}
+    rnd, v, best_r, best, delta = regs["toy_spec_acceptance_rate_pct"]
+    assert (rnd, v, best_r, best) == (2, 66.0, 1, 88.0)
+    assert abs(delta - 22.0 / 88.0) < 1e-9

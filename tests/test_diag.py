@@ -527,3 +527,45 @@ def test_slo_endpoint_golden_sections():
     finally:
         diag.stop_diag_server()
         slo.reset()
+
+
+def test_statusz_serving_spec_lines(served):
+    """ISSUE-13: the == serving == section renders the spec lines with
+    the explicit no-data convention — 'spec: off' on a draftless
+    engine, 'spec acceptance: no data' on a fresh spec engine, and the
+    acceptance + draft-overhead lines once verify rounds ran."""
+    from singa_tpu import device, models, tensor as stensor
+    from singa_tpu import engine as eng
+    srv = served[0]
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=61, max_seq=48, dim=32,
+                            num_heads=2, num_layers=1)
+    ids = stensor.from_numpy(
+        np.random.RandomState(0).randint(0, 61, (1, 6))
+        .astype(np.int32), device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    e = eng.ServingEngine(m, max_slots=1, page_size=8,
+                          max_ctx=48).start()
+    try:
+        _st, _h, body = _get(srv, "/statusz")
+        assert "== serving ==" in body
+        assert "spec: off (no draft model)" in body
+    finally:
+        e.stop()
+    d = models.create_model("gpt", vocab_size=61, max_seq=48, dim=32,
+                            num_heads=2, num_layers=1)
+    d.compile([ids], is_train=False, use_graph=False)
+    d.eval()
+    e = eng.ServingEngine(m, max_slots=1, page_size=8, max_ctx=48,
+                          draft_model=d, spec_k=2).start()
+    try:
+        _st, _h, body = _get(srv, "/statusz")
+        assert "spec acceptance: no data (0 verify rounds, k=2)" in body
+        r = e.submit(np.arange(5, dtype=np.int32), 6)
+        assert r.wait(300) and r.outcome == "completed"
+        _st, _h, body = _get(srv, "/statusz")
+        assert "spec acceptance " in body
+        assert "spec draft overhead: params" in body
+    finally:
+        e.stop()

@@ -503,3 +503,59 @@ def test_phase_label_proven_against_request_phases(tmp_path):
     problems = check_metrics_names.check([str(f)])
     assert len(problems) == 1, problems
     assert "'teardown'" in problems[0]
+
+
+def test_lint_covers_spec_metric_names():
+    """ISSUE-13: rule 5 extends to the speculative-decoding layer's
+    `verdict=` and `kv_dtype=` labels — SPEC_VERDICTS / KV_DTYPES are
+    recognized as declared enum tuples, every singa_spec_* /
+    singa_serve_spec-era registration in serving.py and engine.py
+    passes the full lint, and the new kwargs are enforced."""
+    srv_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                          "serving.py")
+    eng_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                          "engine.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(srv_py)}
+    assert {"singa_spec_tokens_total", "singa_spec_rounds_total",
+            "singa_spec_acceptance_rate"} <= names
+    eng_names = {n for n, _t, _h, _l
+                 in check_metrics_names.registrations_in(eng_py)}
+    assert "singa_serve_kv_pool_bytes" in eng_names
+    assert check_metrics_names.check([srv_py]) == []
+    assert check_metrics_names.check([eng_py]) == []
+    import ast
+    enums, _consts = check_metrics_names._module_enum_info(
+        ast.parse(open(srv_py).read()))
+    assert enums["KV_DTYPES"] == ("fp", "int8", "int4")
+    assert enums["SPEC_VERDICTS"] == ("drafted", "accepted", "bonus",
+                                      "wasted")
+    eng_enums, _ = check_metrics_names._module_enum_info(
+        ast.parse(open(eng_py).read()))
+    assert eng_enums["KV_DTYPES"] == enums["KV_DTYPES"], \
+        "engine.py's KV_DTYPES mirror drifted from serving.py's"
+    assert "verdict" in check_metrics_names.ENUM_LABEL_KWARGS
+    assert "kv_dtype" in check_metrics_names.ENUM_LABEL_KWARGS
+
+
+def test_verdict_and_kv_dtype_label_rules(tmp_path):
+    """A verdict=/kv_dtype= literal not in a declared enum tuple is a
+    violation; members and enum-guarded dynamic values pass."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "SPEC_VERDICTS = ('drafted', 'accepted')\n"
+        "KV_DTYPES = ('fp', 'int8', 'int4')\n"
+        "observe.counter('singa_x_total', 'a').inc(verdict='drafted')\n"
+        "observe.counter('singa_x_total', 'a').inc(verdict='guessed')\n"
+        "observe.gauge('singa_y', 'b').set(1.0, kv_dtype='int4')\n"
+        "observe.gauge('singa_y', 'b').set(1.0, kv_dtype='nf4')\n"
+        "def guarded(v):\n"
+        "    assert v in KV_DTYPES\n"
+        "    observe.gauge('singa_y', 'b').set(1.0, kv_dtype=v)\n"
+        "def unguarded(v):\n"
+        "    observe.gauge('singa_y', 'b').set(1.0, kv_dtype=v)\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 3, problems
+    assert any("'guessed'" in p for p in problems)
+    assert any("'nf4'" in p for p in problems)
+    assert any("dynamic" in p for p in problems)

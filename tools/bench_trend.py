@@ -26,9 +26,10 @@ records), and serving latencies (any metric naming `ttft` or a
 `*_p50`/`*_p99` percentile — BENCHDEC_r06's engine TTFT records, even
 when unit-less) regress UP, everything else (throughput, ratios,
 ok-flags) regresses DOWN. Rate units ("tokens/s") always win over the
-name heuristics, and SLO `attainment` metrics are higher-is-better
-even though they end in percentile-looking suffixes (`_pct`) — a drop
-in attainment is the regression (SLO_r*.json records).
+name heuristics, and SLO `attainment` metrics plus speculative-decode
+`accept`/`acceptance` rates are higher-is-better even though they may
+end in percentile-looking suffixes (`_pct`) — a drop in attainment or
+acceptance is the regression (SLO_r*.json / BENCHDEC_r07 records).
 
 Usage: `python tools/bench_trend.py [DIR|FILES...] [--threshold 0.05]`
 (default DIR = the repo root). `--latest-only` restricts regression
@@ -59,8 +60,10 @@ LOWER_BETTER_SUBSTRINGS = ("ttft",)
 #: name substrings that mark a higher-is-better metric even when a
 #: lower-better suffix would otherwise match — SLO attainment records
 #: end in `_pct` (and the percentile suffixes), but a DROP in
-#: attainment is the regression
-HIGHER_BETTER_SUBSTRINGS = ("attainment",)
+#: attainment is the regression; speculative-decoding `accept`/
+#: `acceptance` rates (BENCHDEC_r07's spec records) likewise regress
+#: DOWN even when written unit-less or percentile-suffixed
+HIGHER_BETTER_SUBSTRINGS = ("attainment", "accept")
 
 
 def parse_records(path: str, family: str):
