@@ -604,21 +604,14 @@ def serve_main(args):
     m.eval()
     dt = None if args.dtype == "float32" else args.dtype
 
-    # ---- the workload (shared by both arms, fully seeded) ---------------
-    rng = np.random.RandomState(args.serve_seed)
-    arrivals = np.cumsum(rng.exponential(1.0 / rps, n_req))
-    prompts = [rng.randint(0, args.vocab,
-                           (rng.randint(p_lo, p_hi + 1),)).astype(np.int32)
-               for _ in range(n_req)]
-    if args.serve_new_dist == "bimodal":
-        short_hi = max(n_lo + 1, n_lo + (n_hi - n_lo) // 4)
-        long_lo = max(short_hi, n_hi - (n_hi - n_lo) // 8)
-        is_long = rng.rand(n_req) < 0.25
-        new_lens = np.where(is_long,
-                            rng.randint(long_lo, n_hi + 1, n_req),
-                            rng.randint(n_lo, short_hi + 1, n_req))
-    else:
-        new_lens = rng.randint(n_lo, n_hi + 1, n_req)
+    # ---- the workload (shared by both arms, fully seeded; the same
+    # generator the router's kill-and-replace harness replays) ------------
+    from singa_tpu import serving
+    wl = serving.poisson_workload(
+        args.serve_seed, n_req, rps, args.vocab, (p_lo, p_hi),
+        (n_lo, n_hi), new_dist=args.serve_new_dist)
+    arrivals, prompts, new_lens = \
+        wl["arrivals"], wl["prompts"], wl["new_lens"]
     useful = int(np.sum(new_lens))
 
     def replay(submit_fn):

@@ -93,6 +93,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "/flightz": self._flightz,
                 "/fleetz": self._fleetz,
                 "/fleetz/trace": self._fleetz_trace,
+                "/routerz": self._routerz,
                 "/memz": self._memz,
                 "/slo": self._sloz,
                 "/stackz": self._stackz,
@@ -118,6 +119,8 @@ class _Handler(BaseHTTPRequestHandler):
             "  /flightz      flight-bundle index; ?name=<bundle> fetches\n"
             "  /fleetz       aggregated per-host fleet status (text)\n"
             "  /fleetz/trace merged Perfetto/Chrome trace (JSON)\n"
+            "  /routerz      serving control plane: replica states, "
+            "shed/failover/retry counters (text)\n"
             "  /memz         live device-memory ledger breakdown; "
             "?json=1 for the timeline JSON\n"
             "  /slo          serving SLO attainment + error-budget "
@@ -229,6 +232,16 @@ class _Handler(BaseHTTPRequestHandler):
         from . import fleet
         self._send(fleet.fleet_report() + "\n",
                    status=200 if fleet.get_aggregator() is not None
+                   else 503)
+
+    def _routerz(self, q):
+        """The serving control plane: per-replica state
+        (live/draining/dead), router queue depth, shed/failover/retry
+        counters — served from the process's installed router.Router
+        (singa_tpu.router)."""
+        from . import router
+        self._send(router.router_report() + "\n",
+                   status=200 if router.get_router() is not None
                    else 503)
 
     def _fleetz_trace(self, q):

@@ -825,6 +825,10 @@ class FleetAggregator:
                         "slo_breaching":
                             ((w.serve.get("slo") or {})
                              .get("breaching") or []),
+                        # graceful-drain visibility (ROADMAP item 5):
+                        # the router shows a replica as draining the
+                        # moment its engine stops admitting
+                        "draining": bool(w.serve.get("draining")),
                     } if isinstance(w.serve, dict) else None,
                 })
             # worst-HBM host: max live bytes across workers that
@@ -1123,7 +1127,15 @@ def fleet_report() -> str:
                 f"{r['host']:<12} {s.get('rps') or 0.0:>7.2f} "
                 f"{s.get('queue_depth') or 0:>6} {occ:>7} {pu:>7} "
                 f"{p50:>12} {p99:>12} {kv:>8} {att:>8} "
-                f"{','.join(s.get('slo_breaching') or []) or 'none'}")
+                f"{','.join(s.get('slo_breaching') or []) or 'none'}"
+                + (" [draining]" if s.get("draining") else ""))
+    # the serving control plane, when one is installed in this process
+    # (the router coordinator is usually also the fleet coordinator)
+    try:
+        from . import router as _router_mod
+        lines.extend(_router_mod.fleetz_lines())
+    except Exception:
+        pass
     steps_total = 0
     for s in (roll["metrics"].get("singa_steps_total") or
               {}).get("series", {}).values():

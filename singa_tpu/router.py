@@ -1,0 +1,1412 @@
+"""Serving control plane: the multi-replica router (ROADMAP item 5).
+
+One `ServingEngine` is a single point of loss: its death takes every
+in-flight and queued request with it. This module fronts N serving
+REPLICAS — each a subprocess running its own engine, diag server and
+fleet `ShardWriter` — behind one `Router` that owns the request's
+fate end to end:
+
+  - **Load balancing**: each dispatch picks the live replica with the
+    lowest load score — the router's own in-flight count per replica
+    plus the occupancy/queue-depth columns of that replica's fleet
+    shard (the `fleet_serve` line `slo.fleet_serve_snapshot` publishes)
+    when an aggregator over the shared spool is available.
+  - **Admission control**: the router queue is BOUNDED (`queue_limit`);
+    a submit over it is shed immediately as outcome "rejected", reason
+    "shed" — bounded latency instead of an unbounded queue.
+  - **Request failover**: the router keeps every routed request's
+    prompt + sampling config (greedy, `max_new`) until a terminal
+    outcome. A replica that misses its health deadline — watchdog-style
+    calibrated liveness over its shard publish intervals
+    (`watchdog.calibrated_deadline`) confirmed by a failed `/healthz`
+    probe, or simply an exited process — is marked DEAD, and its
+    in-flight and queued requests are resubmitted to surviving replicas
+    with bounded decorrelated-jitter retries (resilience.py's backoff
+    shape). Greedy decode is deterministic and every replica builds the
+    byte-identical model (seeded init), so a retried request returns
+    token-identical output: failover is invisible to the caller.
+  - **Graceful drain**: `drain_replica()` stops routing to a replica,
+    asks it to `ServingEngine.stop(drain=True)` — in-flight requests
+    finish naturally, queued ones are handed BACK — and the router
+    re-routes every handed-back request to the surviving replicas. A
+    rolling restart loses nothing and produces no "evicted" terminals.
+
+Request outcomes at the router are exactly `ROUTE_OUTCOMES`:
+"completed" (tokens attached) or "rejected" (reason + detail) — never
+silence. Replica states are exactly `REPLICA_STATES`: live / draining /
+dead. Reasons on shed/failover/retry paths are exactly `ROUTE_REASONS`
+(shed, replica_dead, drain, retry_exhausted) — all three tuples are the
+enums tools/check_metrics_names.py rule 5 proves the `singa_route_*`
+label values against.
+
+CLI: `python -m singa_tpu.router --replica` runs one replica process
+(engine + diag + shard writer + the HTTP control surface the router
+drives); `--ab` is the kill-and-replace harness: 3 replicas under the
+seeded Poisson workload from `bench_decode --serve`
+(`serving.poisson_workload`), SIGKILL one mid-traffic, a standby
+replica joins, and the run asserts ZERO lost requests (every submit
+terminal, failover outputs token-identical to a clean arm) plus the
+p99 TTFT delta through the event -> SERVE_rNN.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from . import observe
+
+#: terminal outcomes a routed request can reach — "completed" with
+#: tokens, or "rejected" with a reason; there is no third state, which
+#: is the zero-loss contract (a lost request would be outcome None
+#: forever, and the --ab harness fails on exactly that)
+ROUTE_OUTCOMES = ("completed", "rejected")
+OUTCOME_COMPLETED = "completed"
+OUTCOME_REJECTED = "rejected"
+
+#: why the router shed, failed over, or gave up — the low-cardinality
+#: `reason=` label set on singa_route_* counters (lint rule 5; the
+#: aliases below are literal re-statements, the form the lint's
+#: constant-resolution proves membership from)
+ROUTE_REASONS = ("shed", "replica_dead", "drain", "retry_exhausted")
+REASON_SHED = "shed"
+REASON_REPLICA_DEAD = "replica_dead"
+REASON_DRAIN = "drain"
+REASON_RETRY_EXHAUSTED = "retry_exhausted"
+
+#: replica lifecycle at the router: live (routable), draining (finishing
+#: in-flight, not routable), dead (failed or retired; never revived —
+#: a replacement JOINS instead)
+REPLICA_STATES = ("live", "draining", "dead")
+STATE_LIVE = "live"
+STATE_DRAINING = "draining"
+STATE_DEAD = "dead"
+
+#: engine-side rejection details that are worth retrying on another
+#: replica (transient/local conditions); anything else (over-length
+#: prompt, page budget) would fail identically everywhere and is
+#: passed through to the caller as a terminal rejection
+RETRYABLE_DETAILS = ("queue full", "not running", "draining")
+
+_metrics_cache = None
+
+
+def _metrics():
+    # same memoize-with-revalidation shape as engine._metrics: cheap on
+    # the per-request hot path, rebuilt after a registry reset
+    global _metrics_cache
+    c = _metrics_cache
+    if c is not None and observe.get_registry().get(
+            "singa_route_requests_total") is c["requests"]:
+        return c
+    _metrics_cache = c = {
+        "requests": observe.counter(
+            "singa_route_requests_total",
+            "routed requests finished, by terminal outcome"),
+        "rejects": observe.counter(
+            "singa_route_rejects_total",
+            "router-minted rejections by reason (shed at admission, "
+            "retry budget exhausted, router drain)"),
+        "failover": observe.counter(
+            "singa_route_failover_total",
+            "requests resubmitted away from a replica, by cause "
+            "(replica death or graceful drain)"),
+        "retries": observe.counter(
+            "singa_route_retries_total",
+            "re-dispatch attempts after the first, all causes"),
+        "queue_depth": observe.gauge(
+            "singa_route_queue_depth",
+            "requests waiting in the router admission queue"),
+        "replicas_live": observe.gauge(
+            "singa_route_replicas_live",
+            "replicas currently in the live state"),
+        "replica_inflight": observe.gauge(
+            "singa_route_replica_inflight",
+            "requests dispatched to one replica and not yet terminal"),
+        "request_s": observe.histogram(
+            "singa_route_request_seconds",
+            "router submit-to-terminal wall seconds per request"),
+    }
+    return c
+
+
+def _http_json(url: str, payload=None, timeout: float = 10.0) -> dict:
+    """One JSON round-trip (GET without payload, POST with)."""
+    import urllib.request
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+# ---- the routed request -----------------------------------------------------
+
+class RouterRequest:
+    """One request's router-side record: the prompt + sampling config
+    are KEPT here until a terminal outcome, which is what makes
+    failover possible at all — a dead replica takes nothing with it
+    that the router cannot resubmit."""
+
+    __slots__ = ("id", "prompt", "max_new", "submitted", "finished_ts",
+                 "outcome", "reason", "detail", "tokens", "replica",
+                 "attempts", "ttft_s", "events", "_done")
+
+    def __init__(self, rid: int, prompt, max_new: int):
+        self.id = rid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new = int(max_new)
+        self.submitted = time.monotonic()
+        self.finished_ts = None
+        self.outcome = None     # member of ROUTE_OUTCOMES when terminal
+        self.reason = None      # member of ROUTE_REASONS when router-minted
+        self.detail = None
+        self.tokens: "list[int]" = []
+        self.replica = None     # name of the replica that completed it
+        self.attempts = 0
+        self.ttft_s = None      # router-side: submit -> first token
+        self.events: "list[tuple]" = []
+        self._done = threading.Event()
+
+    def mark(self, event: str, **info):
+        self.events.append((event, round(time.monotonic(), 7), info))
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout=None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout=None) -> "list[int]":
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not terminal")
+        if self.outcome != OUTCOME_COMPLETED:
+            raise RuntimeError(
+                f"request {self.id} {self.outcome}: {self.detail}")
+        return list(self.tokens)
+
+
+class Replica:
+    """Router-side record of one serving replica. `proc` is the
+    subprocess when the router (or harness) spawned it — `None` for an
+    externally managed or in-process (test stub) replica."""
+
+    def __init__(self, name: str, ctl_url: str, *, host=None,
+                 diag_url=None, proc=None):
+        self.name = name
+        self.ctl_url = ctl_url.rstrip("/")
+        self.host = host or name
+        self.diag_url = diag_url
+        self.proc = proc
+        self.state = STATE_LIVE
+        self.state_detail = None
+        self.inflight: "set[int]" = set()
+        self.dispatched = 0
+        self.completed = 0
+        self.joined_ts = time.monotonic()
+        # liveness calibration over shard publish intervals
+        self.last_seq = None
+        self.last_seq_change = None
+        self.publish_intervals: "deque[float]" = deque(maxlen=256)
+        self.liveness_deadline_s = None
+
+
+# ---- the router -------------------------------------------------------------
+
+class Router:
+    """The control plane over N replicas (module docstring has the
+    contract). All router threads are named `singa-route-*` (the
+    conftest leak assert keys on the prefix)."""
+
+    _seq = 0
+    _seq_lock = threading.Lock()
+
+    def __init__(self, fleet_dir=None, *, queue_limit=64,
+                 max_attempts=6, retry_base_s=0.05, retry_max_s=2.0,
+                 retry_total_s=120.0, retry_seed=None,
+                 poll_wait_s=2.0, health_interval_s=0.1,
+                 liveness_multiplier=10.0, liveness_floor_s=1.0,
+                 liveness_ceiling_s=30.0, liveness_min_samples=5,
+                 probe_timeout_s=2.0):
+        from . import fleet
+        self.fleet_dir = fleet_dir
+        self.queue_limit = int(queue_limit)
+        self.max_attempts = int(max_attempts)
+        self.retry_base_s = float(retry_base_s)
+        self.retry_max_s = float(retry_max_s)
+        self.retry_total_s = float(retry_total_s)
+        self.retry_seed = retry_seed
+        self.poll_wait_s = float(poll_wait_s)
+        self.health_interval_s = float(health_interval_s)
+        self.liveness_multiplier = float(liveness_multiplier)
+        self.liveness_floor_s = float(liveness_floor_s)
+        self.liveness_ceiling_s = float(liveness_ceiling_s)
+        self.liveness_min_samples = int(liveness_min_samples)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: "deque[RouterRequest]" = deque()
+        self._pending: "dict[int, RouterRequest]" = {}
+        self._replicas: "dict[str, Replica]" = {}
+        self._rid = 0
+        self._rr = 0
+        self._stop_evt = threading.Event()
+        self._stopping = False
+        self._threads: "list[threading.Thread]" = []
+        self._senders: "list[threading.Thread]" = []
+        self._terminal = {o: 0 for o in ROUTE_OUTCOMES}
+        self._reasons = {r: 0 for r in ROUTE_REASONS}
+        self._failovers = {REASON_REPLICA_DEAD: 0, REASON_DRAIN: 0}
+        self._retries = 0
+        # balance on the installed aggregator when there is one (the
+        # --ab coordinator installs it so /fleetz works too); otherwise
+        # a private one over fleet_dir, polled from the health loop
+        self._own_agg = None
+        if fleet_dir is not None and fleet.get_aggregator() is None:
+            self._own_agg = fleet.FleetAggregator(
+                fleet_dir, stale_after_s=max(5.0, liveness_ceiling_s),
+                poll_interval_s=min(0.25, health_interval_s))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Router":
+        with Router._seq_lock:
+            Router._seq += 1
+            n = Router._seq
+        for target, name in ((self._dispatch_loop, "dispatch"),
+                             (self._health_loop, "health")):
+            t = threading.Thread(target=target,
+                                 name=f"singa-route-{name}-{n}",
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
+        install_router(self)
+        self._export_gauges()
+        return self
+
+    def stop(self, timeout_s: float = 30.0):
+        """Tear the router down: loops joined, every queued and pending
+        request finished with a TERMINAL outcome (rejected, reason
+        "drain" — never silence), replica subprocesses killed and
+        reaped. Idempotent."""
+        with self._lock:
+            if self._stopping and not self._threads:
+                return
+            self._stopping = True
+            self._stop_evt.set()
+            self._cond.notify_all()
+            leftover = list(self._queue)
+            self._queue.clear()
+        for req in leftover:
+            self._finish(req, OUTCOME_REJECTED, reason=REASON_DRAIN,
+                         detail="router stopped")
+        deadline = time.monotonic() + float(timeout_s)
+        for t in self._threads + self._senders:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        self._threads = []
+        self._senders = []
+        # any request a sender could not terminate in time still gets a
+        # terminal outcome — zero-loss holds through shutdown too
+        with self._lock:
+            pending = list(self._pending.values())
+        for req in pending:
+            self._finish(req, OUTCOME_REJECTED, reason=REASON_DRAIN,
+                         detail="router stopped")
+        for rep in self.replicas():
+            if rep.proc is not None and rep.proc.poll() is None:
+                rep.proc.kill()
+            if rep.proc is not None:
+                try:
+                    rep.proc.wait(timeout=10.0)
+                except Exception:
+                    pass
+        if self._own_agg is not None:
+            self._own_agg.stop_polling()
+        if observe.is_enabled():
+            m = _metrics()
+            m["queue_depth"].set(0.0)
+            m["replicas_live"].set(0.0)
+
+    # -- replica registry --------------------------------------------------
+    def add_replica(self, name: str, ctl_url: str, *, host=None,
+                    diag_url=None, proc=None) -> Replica:
+        rep = Replica(name, ctl_url, host=host, diag_url=diag_url,
+                      proc=proc)
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            self._replicas[name] = rep
+            self._cond.notify_all()
+        self._export_gauges()
+        return rep
+
+    def replicas(self) -> "list[Replica]":
+        with self._lock:
+            return list(self._replicas.values())
+
+    def get_replica(self, name: str) -> "Replica | None":
+        with self._lock:
+            return self._replicas.get(name)
+
+    def mark_dead(self, rep: Replica, detail: str):
+        """Flip a replica to DEAD (idempotent): no further dispatches
+        go to it, waiting senders re-pick, and its process (if any) is
+        killed and reaped so nothing leaks."""
+        with self._lock:
+            if rep.state == STATE_DEAD:
+                return
+            rep.state = STATE_DEAD
+            rep.state_detail = detail
+            self._cond.notify_all()
+        if rep.proc is not None:
+            if rep.proc.poll() is None:
+                rep.proc.kill()
+            try:
+                rep.proc.wait(timeout=10.0)
+            except Exception:
+                pass
+        if observe.is_enabled():
+            observe.get_registry().emit({
+                "kind": "route", "event": "replica_dead",
+                "replica": rep.name, "detail": detail})
+        self._export_gauges()
+
+    def drain_replica(self, name: str, *, timeout_s: float = 120.0,
+                      shutdown: bool = True) -> dict:
+        """Graceful rolling-restart step for one replica: stop routing
+        to it, ask its engine to finish in-flight work and hand queued
+        requests back (`ServingEngine.stop(drain=True)`), wait for the
+        router-side in-flight set to clear (the handed-back requests
+        re-route themselves to surviving replicas), then optionally
+        shut the replica process down. Returns the replica's drain
+        response (handed_back ids etc.)."""
+        rep = self.get_replica(name)
+        if rep is None:
+            raise ValueError(f"no replica {name!r}")
+        with self._lock:
+            if rep.state != STATE_LIVE:
+                raise ValueError(
+                    f"replica {name!r} is {rep.state}, not live")
+            rep.state = STATE_DRAINING
+            rep.state_detail = "drain requested"
+        self._export_gauges()
+        out = _http_json(rep.ctl_url + "/drain",
+                         {"timeout_s": timeout_s},
+                         timeout=timeout_s + 10.0)
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not rep.inflight:
+                    break
+            time.sleep(0.02)
+        if shutdown:
+            try:
+                _http_json(rep.ctl_url + "/shutdown", {}, timeout=10.0)
+            except Exception:
+                pass
+            if rep.proc is not None:
+                try:
+                    rep.proc.wait(timeout=30.0)
+                except Exception:
+                    rep.proc.kill()
+                    rep.proc.wait(timeout=10.0)
+            self.mark_dead(rep, "drained and retired")
+        return out
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt, max_new: int) -> RouterRequest:
+        """Route one greedy request. Returns the handle immediately; a
+        full router queue (or a stopped router) REJECTS it on the spot
+        — reason "shed" / "drain" — instead of queueing unboundedly."""
+        with self._lock:
+            self._rid += 1
+            req = RouterRequest(self._rid, prompt, max_new)
+            if self._stopping:
+                shed_reason, detail = REASON_DRAIN, "router stopped"
+            elif len(self._queue) >= self.queue_limit:
+                shed_reason = REASON_SHED
+                detail = f"router queue full ({self.queue_limit})"
+            else:
+                shed_reason = None
+                self._pending[req.id] = req
+                self._queue.append(req)
+                req.mark("queued", depth=len(self._queue))
+                self._cond.notify_all()
+                qd = len(self._queue)
+        if shed_reason is not None:
+            self._finish(req, OUTCOME_REJECTED, reason=shed_reason,
+                         detail=detail)
+        elif observe.is_enabled():
+            _metrics()["queue_depth"].set(float(qd))
+        return req
+
+    # -- terminal bookkeeping ----------------------------------------------
+    def _finish(self, req: RouterRequest, outcome: str, *, tokens=None,
+                reason=None, detail=None, replica=None):
+        assert outcome in ROUTE_OUTCOMES, outcome
+        assert reason is None or reason in ROUTE_REASONS, reason
+        with self._lock:
+            if req.outcome is not None:
+                return
+            req.outcome = outcome
+            req.reason = reason
+            req.detail = detail
+            req.replica = replica
+            if tokens is not None:
+                req.tokens = [int(t) for t in tokens]
+            req.finished_ts = time.monotonic()
+            req.mark("terminal", outcome=outcome, reason=reason)
+            self._terminal[outcome] += 1
+            if reason is not None:
+                self._reasons[reason] += 1
+            self._pending.pop(req.id, None)
+        if observe.is_enabled():
+            m = _metrics()
+            m["requests"].inc(outcome=outcome)
+            if reason is not None:
+                m["rejects"].inc(reason=reason)
+            m["request_s"].observe(req.finished_ts - req.submitted)
+            observe.get_registry().emit({
+                "kind": "route", "event": "terminal", "id": req.id,
+                "outcome": outcome, "reason": reason,
+                "replica": replica, "attempts": req.attempts,
+                "detail": detail})
+        req._done.set()
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch_loop(self):
+        while True:
+            with self._lock:
+                while not self._queue and not self._stopping:
+                    self._cond.wait(timeout=0.1)
+                if self._stopping:
+                    return
+                req = self._queue.popleft()
+                qd = len(self._queue)
+            if observe.is_enabled():
+                _metrics()["queue_depth"].set(float(qd))
+            t = threading.Thread(target=self._run_request, args=(req,),
+                                 name=f"singa-route-req-{req.id}",
+                                 daemon=True)
+            with self._lock:
+                self._senders.append(t)
+                # reap finished sender threads so the list stays bounded
+                self._senders = [s for s in self._senders if s.is_alive()
+                                 or s is t]
+            t.start()
+
+    def _load_rows(self) -> dict:
+        """host -> fleet rollup row, best effort (empty without an
+        aggregator — balancing then rides the in-flight counts)."""
+        from . import fleet
+        agg = fleet.get_aggregator() or self._own_agg
+        if agg is None:
+            return {}
+        try:
+            agg.poll_if_due()
+            roll = agg.rollup()
+            return {r["host"]: r for r in roll["workers"]}
+        except Exception:
+            return {}
+
+    def _score(self, rep: Replica, rows: dict) -> float:
+        score = float(len(rep.inflight))
+        row = rows.get(rep.host)
+        serve = (row or {}).get("serve")
+        if isinstance(serve, dict) and not (row or {}).get("stale"):
+            score += float(serve.get("queue_depth") or 0)
+            score += float(serve.get("occupancy") or 0)
+        return score
+
+    def _pick_replica(self, exclude=(), wait_until=None):
+        """Lowest-load LIVE replica, preferring ones not in `exclude`
+        (the replica that just failed). Blocks until `wait_until` for
+        one to appear — a replacement may be joining — and returns None
+        only when the wait budget is spent."""
+        rows = self._load_rows()
+        while True:
+            with self._lock:
+                live = [r for r in self._replicas.values()
+                        if r.state == STATE_LIVE]
+                cands = [r for r in live if r not in exclude] or live
+                if cands:
+                    self._rr += 1
+                    lo = min(self._score(r, rows) for r in cands)
+                    best = [r for r in cands
+                            if self._score(r, rows) <= lo]
+                    return best[self._rr % len(best)]
+                if self._stopping or (
+                        wait_until is not None
+                        and time.monotonic() >= wait_until):
+                    return None
+                self._cond.wait(timeout=0.1)
+
+    def _probe(self, rep: Replica) -> bool:
+        try:
+            out = _http_json(rep.ctl_url + "/healthz",
+                             timeout=self.probe_timeout_s)
+            return bool(out.get("ok"))
+        except Exception:
+            return False
+
+    def _dispatch(self, rep: Replica, req: RouterRequest) -> dict:
+        """Drive one attempt on one replica to a classifiable result:
+        submit, then bounded /poll rounds until terminal. Every return
+        is a dict with "outcome" plus "cause" for retryable failures
+        ("transport", "requeued", "retryable_reject")."""
+        payload = {"rid": req.id,
+                   "prompt": [int(t) for t in req.prompt],
+                   "max_new": req.max_new, "wait_s": self.poll_wait_s}
+        path = "/submit"
+        while True:
+            if self._stop_evt.is_set():
+                return {"outcome": "error", "cause": "transport",
+                        "detail": "router stopping"}
+            if rep.state == STATE_DEAD:
+                return {"outcome": "error", "cause": "transport",
+                        "detail": "replica marked dead"}
+            try:
+                out = _http_json(rep.ctl_url + path, payload,
+                                 timeout=self.poll_wait_s + 10.0)
+            except Exception as e:
+                return {"outcome": "error", "cause": "transport",
+                        "detail": f"{type(e).__name__}: {e}"}
+            st = out.get("outcome")
+            if st == "pending":
+                # bounded poll rounds keep every sender interruptible:
+                # no thread ever blocks longer than one wait_s window
+                path = "/submit"
+                payload["resume"] = True
+                continue
+            if st in ("requeued", "unknown"):
+                return {"outcome": "error", "cause": "requeued",
+                        "detail": "handed back by drain"
+                        if st == "requeued"
+                        else "replica lost request state"}
+            if st == "rejected" and out.get("retryable"):
+                return {"outcome": "error",
+                        "cause": "retryable_reject",
+                        "detail": out.get("detail")}
+            if st == "evicted":
+                # the replica engine's crash path drained it — the
+                # request is safe to resubmit (greedy determinism)
+                return {"outcome": "error", "cause": "transport",
+                        "detail": out.get("detail") or "evicted"}
+            if st == "timeout":
+                return {"outcome": "rejected", "retryable": False,
+                        "detail": out.get("detail")
+                        or "request deadline exceeded"}
+            return out
+
+    def _run_request(self, req: RouterRequest):
+        rng = random.Random(
+            None if self.retry_seed is None
+            else (int(self.retry_seed) * 1_000_003 + req.id))
+        t0 = time.monotonic()
+        wait_until = t0 + self.retry_total_s
+        prev_delay = self.retry_base_s
+        last_rep = None
+        while not self._stop_evt.is_set():
+            elapsed = time.monotonic() - t0
+            if req.attempts >= self.max_attempts \
+                    or elapsed >= self.retry_total_s:
+                return self._finish(
+                    req, OUTCOME_REJECTED,
+                    reason=REASON_RETRY_EXHAUSTED,
+                    detail=f"{req.attempts} attempts over "
+                           f"{elapsed:.1f}s")
+            rep = self._pick_replica(
+                exclude=(last_rep,) if last_rep is not None else (),
+                wait_until=wait_until)
+            if rep is None:
+                if self._stop_evt.is_set():
+                    break
+                return self._finish(
+                    req, OUTCOME_REJECTED,
+                    reason=REASON_RETRY_EXHAUSTED,
+                    detail="no live replica")
+            req.attempts += 1
+            if req.attempts > 1:
+                self._retries += 1
+                if observe.is_enabled():
+                    _metrics()["retries"].inc()
+            dispatch_ts = time.monotonic()
+            req.mark("dispatch", replica=rep.name,
+                     attempt=req.attempts)
+            with self._lock:
+                rep.inflight.add(req.id)
+                rep.dispatched += 1
+            self._export_gauges()
+            try:
+                out = self._dispatch(rep, req)
+            finally:
+                with self._lock:
+                    rep.inflight.discard(req.id)
+                self._export_gauges()
+            st = out.get("outcome")
+            if st == OUTCOME_COMPLETED:
+                with self._lock:
+                    rep.completed += 1
+                if out.get("ttft_s") is not None:
+                    # router-side TTFT: queue + failed attempts + the
+                    # final replica's own submit->first-token time
+                    req.ttft_s = (dispatch_ts - req.submitted
+                                  + float(out["ttft_s"]))
+                return self._finish(req, OUTCOME_COMPLETED,
+                                    tokens=out.get("tokens") or [],
+                                    replica=rep.name)
+            if st == OUTCOME_REJECTED and not out.get("retryable"):
+                return self._finish(req, OUTCOME_REJECTED,
+                                    detail=out.get("detail"),
+                                    replica=rep.name)
+            cause = out.get("cause")
+            req.mark("failover", replica=rep.name, cause=cause,
+                     detail=out.get("detail"))
+            if cause == "transport":
+                # SIGKILL shows up here first (connection reset long
+                # before the shard goes stale): confirm with a probe so
+                # failover is prompt, not a liveness-deadline later
+                if rep.state == STATE_LIVE and not self._probe(rep):
+                    self.mark_dead(
+                        rep, f"dispatch failed ({out.get('detail')}) "
+                             "and /healthz probe failed")
+                if rep.state == STATE_DEAD:
+                    with self._lock:
+                        self._failovers[REASON_REPLICA_DEAD] += 1
+                    if observe.is_enabled():
+                        _metrics()["failover"].inc(
+                            reason=REASON_REPLICA_DEAD)
+            elif cause == "requeued":
+                fo = REASON_DRAIN if rep.state == STATE_DRAINING \
+                    else REASON_REPLICA_DEAD
+                with self._lock:
+                    self._failovers[fo] += 1
+                if observe.is_enabled():
+                    if fo == REASON_DRAIN:
+                        _metrics()["failover"].inc(reason=REASON_DRAIN)
+                    else:
+                        _metrics()["failover"].inc(
+                            reason=REASON_REPLICA_DEAD)
+            last_rep = rep
+            delay = min(rng.uniform(self.retry_base_s,
+                                    max(self.retry_base_s,
+                                        prev_delay * 3.0)),
+                        self.retry_max_s)
+            prev_delay = delay
+            self._stop_evt.wait(delay)
+        self._finish(req, OUTCOME_REJECTED, reason=REASON_DRAIN,
+                     detail="router stopped")
+
+    # -- health ------------------------------------------------------------
+    def _health_loop(self):
+        from . import watchdog
+        while not self._stop_evt.wait(self.health_interval_s):
+            rows = self._load_rows()
+            now = time.monotonic()
+            for rep in self.replicas():
+                if rep.state == STATE_DEAD:
+                    continue
+                if rep.proc is not None and rep.proc.poll() is not None:
+                    self.mark_dead(
+                        rep, "process exited "
+                             f"rc={rep.proc.returncode}")
+                    continue
+                row = rows.get(rep.host)
+                if row is None:
+                    continue
+                seq = row.get("seq")
+                if seq != rep.last_seq:
+                    if rep.last_seq is not None \
+                            and rep.last_seq_change is not None:
+                        rep.publish_intervals.append(
+                            now - rep.last_seq_change)
+                    rep.last_seq = seq
+                    rep.last_seq_change = now
+                    continue
+                # watchdog-style calibrated liveness: armed only after
+                # enough publish intervals establish "normal", then a
+                # shard older than clamp(p99 x multiplier, floor,
+                # ceiling) makes the replica a SUSPECT — confirmed dead
+                # only when the /healthz probe fails too (a slow
+                # publisher with a live control surface keeps serving)
+                dl = watchdog.calibrated_deadline(
+                    rep.publish_intervals,
+                    multiplier=self.liveness_multiplier,
+                    floor_s=self.liveness_floor_s,
+                    ceiling_s=self.liveness_ceiling_s,
+                    min_samples=self.liveness_min_samples)
+                rep.liveness_deadline_s = dl
+                if dl is not None and rep.last_seq_change is not None \
+                        and now - rep.last_seq_change > dl \
+                        and not self._probe(rep):
+                    self.mark_dead(
+                        rep, f"shard age "
+                             f"{now - rep.last_seq_change:.2f}s > "
+                             f"liveness deadline {dl:.2f}s and "
+                             "/healthz probe failed")
+
+    # -- introspection -----------------------------------------------------
+    def _export_gauges(self):
+        if not observe.is_enabled():
+            return
+        m = _metrics()
+        with self._lock:
+            reps = list(self._replicas.values())
+            qd = len(self._queue)
+        live = 0
+        for rep in reps:
+            assert rep.state in REPLICA_STATES, rep.state
+            if rep.state == STATE_LIVE:
+                live += 1
+            m["replica_inflight"].set(float(len(rep.inflight)),
+                                      replica=rep.name)
+        m["replicas_live"].set(float(live))
+        m["queue_depth"].set(float(qd))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            reps = []
+            for rep in self._replicas.values():
+                reps.append({
+                    "name": rep.name, "state": rep.state,
+                    "state_detail": rep.state_detail,
+                    "host": rep.host,
+                    "inflight": len(rep.inflight),
+                    "dispatched": rep.dispatched,
+                    "completed": rep.completed,
+                    "liveness_deadline_s": rep.liveness_deadline_s,
+                })
+            return {
+                "queue_depth": len(self._queue),
+                "queue_limit": self.queue_limit,
+                "pending": len(self._pending),
+                "terminal": dict(self._terminal),
+                "reasons": dict(self._reasons),
+                "failovers": dict(self._failovers),
+                "retries": self._retries,
+                "replicas": reps,
+            }
+
+
+# ---- module singleton -------------------------------------------------------
+
+_router: "Router | None" = None
+_registry_lock = threading.Lock()
+
+
+def install_router(router: Router) -> Router:
+    global _router
+    with _registry_lock:
+        _router = router
+    return router
+
+
+def get_router() -> "Router | None":
+    return _router
+
+
+def reset():
+    """Stop and drop the process router (conftest contract: router
+    threads joined, replica subprocesses reaped, pending requests
+    drained with a terminal outcome)."""
+    global _router
+    with _registry_lock:
+        r = _router
+        _router = None
+    if r is not None:
+        r.stop()
+
+
+# ---- report surfaces --------------------------------------------------------
+
+def serving_lines() -> "list[str]":
+    """Router rows for /statusz's `== serving ==` section (empty
+    without an installed router)."""
+    r = get_router()
+    if r is None:
+        return []
+    s = r.snapshot()
+    by_state = {st: 0 for st in REPLICA_STATES}
+    for rep in s["replicas"]:
+        by_state[rep["state"]] += 1
+    t, reasons = s["terminal"], s["reasons"]
+    lines = [
+        f"router: replicas {by_state['live']} live / "
+        f"{by_state['draining']} draining / {by_state['dead']} dead, "
+        f"queue {s['queue_depth']}/{s['queue_limit']} "
+        f"(pending {s['pending']})",
+        f"  routed: completed {t['completed']}, rejected "
+        f"{t['rejected']} (shed {reasons['shed']}, retry_exhausted "
+        f"{reasons['retry_exhausted']}, drain {reasons['drain']}), "
+        f"retries {s['retries']}, failover replica_dead "
+        f"{s['failovers']['replica_dead']} / drain "
+        f"{s['failovers']['drain']}",
+    ]
+    for rep in s["replicas"]:
+        dl = rep["liveness_deadline_s"]
+        lines.append(
+            f"  replica {rep['name']}: {rep['state']}, inflight "
+            f"{rep['inflight']}, dispatched {rep['dispatched']}, "
+            f"completed {rep['completed']}, liveness deadline "
+            + (f"{dl:.2f}s" if dl is not None else "uncalibrated")
+            + (f" ({rep['state_detail']})"
+               if rep["state_detail"] else ""))
+    return lines
+
+
+def fleetz_lines() -> "list[str]":
+    """Router section for /fleetz (empty without an installed
+    router): per-replica state plus the shed/failover/retry counters —
+    the control-plane view next to the data-plane serving table."""
+    r = get_router()
+    if r is None:
+        return []
+    s = r.snapshot()
+    t, reasons = s["terminal"], s["reasons"]
+    lines = [
+        "== router ==",
+        f"queue {s['queue_depth']}/{s['queue_limit']}   completed "
+        f"{t['completed']}   rejected {t['rejected']}   shed "
+        f"{reasons['shed']}   failover(replica_dead) "
+        f"{s['failovers']['replica_dead']}   failover(drain) "
+        f"{s['failovers']['drain']}   retry_exhausted "
+        f"{reasons['retry_exhausted']}   retries {s['retries']}",
+        f"{'replica':<12} {'state':>9} {'inflight':>9} "
+        f"{'dispatched':>11} {'completed':>10} deadline",
+    ]
+    for rep in s["replicas"]:
+        dl = rep["liveness_deadline_s"]
+        lines.append(
+            f"{rep['name']:<12} {rep['state']:>9} "
+            f"{rep['inflight']:>9} {rep['dispatched']:>11} "
+            f"{rep['completed']:>10} "
+            + (f"{dl:.2f}s" if dl is not None else "uncalibrated"))
+    return lines
+
+
+def router_report() -> str:
+    """Text block for /routerz."""
+    lines = fleetz_lines()
+    if not lines:
+        return ("no Router installed "
+                "(singa_tpu.router.Router(...).start())")
+    return "\n".join(lines)
+
+
+# ---- the replica process ----------------------------------------------------
+
+class ReplicaControl:
+    """The HTTP control surface a replica exposes to the router (and to
+    in-process test stubs): /submit with bounded waits, /healthz,
+    /drain (graceful engine stop, handed-back ids reported), and
+    /shutdown. Threads are daemonized and the server thread is named
+    `singa-route-ctl-<port>` so the conftest leak assert covers it."""
+
+    def __init__(self, eng, host="127.0.0.1", port=0):
+        self.eng = eng
+        self.draining = False
+        self._reqs: "dict[int, object]" = {}  # rid -> EngineRequest
+        self._handed: "set[int]" = set()
+        self._lock = threading.Lock()
+        self.shutdown_evt = threading.Event()
+        ctl = self
+
+        class _CtlHandler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A002
+                pass
+
+            def _reply(self, obj, status=200):
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") == "/healthz":
+                    self._reply({"ok": True, "pid": os.getpid(),
+                                 "draining": ctl.draining})
+                else:
+                    self._reply({"error": f"no endpoint {self.path}"},
+                                status=404)
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._reply({"error": "bad json"}, status=400)
+                    return
+                path = self.path.rstrip("/")
+                try:
+                    if path == "/submit":
+                        self._reply(ctl.handle_submit(body))
+                    elif path == "/drain":
+                        self._reply(ctl.handle_drain(body))
+                    elif path == "/shutdown":
+                        ctl.shutdown_evt.set()
+                        self._reply({"ok": True})
+                    else:
+                        self._reply(
+                            {"error": f"no endpoint {self.path}"},
+                            status=404)
+                except Exception as e:  # surface, don't kill the thread
+                    self._reply({"error":
+                                 f"{type(e).__name__}: {e}"},
+                                status=500)
+
+        self.httpd = ThreadingHTTPServer((host, int(port)), _CtlHandler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name=f"singa-route-ctl-{self.port}", daemon=True)
+        self._thread.start()
+
+    # -- handlers ----------------------------------------------------------
+    def handle_submit(self, body: dict) -> dict:
+        rid = int(body["rid"])
+        wait_s = float(body.get("wait_s", 2.0))
+        with self._lock:
+            req = self._reqs.get(rid)
+        if req is None:
+            if self.draining:
+                return {"outcome": "rejected", "retryable": True,
+                        "detail": "replica draining"}
+            req = self.eng.submit(
+                np.asarray(body["prompt"], np.int32),
+                int(body["max_new"]))
+            with self._lock:
+                self._reqs[rid] = req
+        deadline = time.monotonic() + wait_s
+        while req.outcome is None and time.monotonic() < deadline:
+            with self._lock:
+                if rid in self._handed:
+                    # drained out of the queue before admission: hand
+                    # it back to the router (it re-routes; the rid is
+                    # forgotten so a forced same-replica resubmit makes
+                    # a FRESH engine request)
+                    self._handed.discard(rid)
+                    self._reqs.pop(rid, None)
+                    return {"outcome": "requeued"}
+            req.wait(timeout=0.05)
+        if req.outcome is None:
+            return {"outcome": "pending"}
+        with self._lock:
+            self._reqs.pop(rid, None)
+            self._handed.discard(rid)
+        out = {"outcome": req.outcome, "detail": req.detail}
+        if req.outcome == "completed":
+            out["tokens"] = [int(t) for t in req.tokens]
+            out["ttft_s"] = req.ttft_s
+        elif req.outcome == "rejected":
+            out["retryable"] = any(
+                s in (req.detail or "") for s in RETRYABLE_DETAILS)
+        return out
+
+    def handle_drain(self, body: dict) -> dict:
+        self.draining = True
+        handed = self.eng.stop(
+            drain=True,
+            drain_timeout_s=float(body.get("timeout_s", 120.0)))
+        handed_ids = {id(r) for r in handed}
+        with self._lock:
+            ids = [rid for rid, r in self._reqs.items()
+                   if id(r) in handed_ids]
+            self._handed.update(ids)
+        return {"ok": True, "handed_back": sorted(ids),
+                "drained": len(handed)}
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def _build_replica_model(vocab: int, dim: int, layers: int,
+                         max_seq: int):
+    """Deterministic serving model: every replica builds THIS — same
+    architecture, same seeded init (device.py's default key(0) RNG) —
+    so greedy decode is token-identical across replicas and failover
+    resubmission is invisible to the caller."""
+    from . import device, models, tensor
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=vocab, max_seq=max_seq,
+                            dim=dim, num_heads=4, num_layers=layers)
+    rng0 = np.random.RandomState(0)
+    ids = tensor.from_numpy(
+        rng0.randint(0, vocab, (2, 8)).astype(np.int32), device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    return m
+
+
+def _replica_main(args) -> int:
+    """One serving replica: engine + fleet shard writer + diag server +
+    the control surface, announced on stdout as a JSON "ready" line."""
+    from . import diag, engine, fleet, slo
+    T = args.prompt_hi + args.new_hi
+    m = _build_replica_model(args.vocab, args.dim, args.layers, T)
+    eng = engine.ServingEngine(
+        m, max_slots=args.slots, page_size=args.page_size, max_ctx=T,
+        queue_limit=max(128, 8 * args.slots),
+        steps_per_sync=2).start()
+    # warm every prompt bucket the workload can hit (plus the decode
+    # executable) BEFORE announcing ready: the router's p99 TTFT must
+    # measure serving, not XLA compiles
+    for b in sorted({eng._bucket(s)
+                     for s in (args.prompt_lo, args.prompt_hi)}):
+        w = eng.submit(np.zeros(min(b, T - 2), np.int32) + 1, 2)
+        if not w.wait(600):
+            raise RuntimeError(f"replica warmup (bucket {b}) stalled")
+    tracker = slo.SLOTracker(slo.SLOConfig(), capacity=8192).install()
+    assert tracker is not None
+    fleet.start_shard_writer(args.fleet_dir,
+                             interval_s=args.publish_interval)
+    dsrv = diag.start_diag_server(port=0)
+    ctl = ReplicaControl(eng)
+    print(json.dumps({
+        "event": "ready", "name": args.name, "pid": os.getpid(),
+        "ctl_port": ctl.port, "diag_port": dsrv.port}), flush=True)
+    try:
+        while not ctl.shutdown_evt.wait(0.2):
+            pass
+    except KeyboardInterrupt:
+        pass
+    ctl.stop()
+    eng.stop()
+    fleet.uninstall()
+    diag.stop_diag_server()
+    slo.reset()
+    print(json.dumps({"event": "exit", "name": args.name, "ok": True}),
+          flush=True)
+    return 0
+
+
+# ---- spawn + handshake ------------------------------------------------------
+
+def spawn_replica(name: str, fleet_dir: str, args, *,
+                  ready_timeout_s: float = 900.0):
+    """Spawn `python -m singa_tpu.router --replica` and wait for its
+    "ready" line. Returns (proc, ready_dict). The child's stdout keeps
+    flowing to OUR stderr afterwards via a daemon reader thread (named
+    singa-route-io-*; it exits on child EOF)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SINGA_FLEET_HOST=name)
+    env.pop("SINGA_TPU_DIAG_PORT", None)
+    cmd = [sys.executable, "-m", "singa_tpu.router", "--replica",
+           "--name", name, "--fleet-dir", fleet_dir,
+           "--vocab", str(args.vocab), "--dim", str(args.dim),
+           "--layers", str(args.layers),
+           "--prompt-lo", str(args.prompt_lo),
+           "--prompt-hi", str(args.prompt_hi),
+           "--new-hi", str(args.new_hi),
+           "--slots", str(args.slots),
+           "--page-size", str(args.page_size),
+           "--publish-interval", str(args.publish_interval)]
+    proc = subprocess.Popen(cmd, cwd=root, env=env,
+                            stdout=subprocess.PIPE, stderr=sys.stderr,
+                            text=True)
+    ready_box = {}
+    ready_evt = threading.Event()
+
+    def _read():
+        for line in proc.stdout:
+            line = line.strip()
+            if not ready_evt.is_set() and line.startswith("{"):
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    obj = None
+                if isinstance(obj, dict) \
+                        and obj.get("event") == "ready":
+                    ready_box.update(obj)
+                    ready_evt.set()
+                    continue
+            if line:
+                print(f"[{name}] {line}", file=sys.stderr)
+        proc.stdout.close()
+
+    t = threading.Thread(target=_read, name=f"singa-route-io-{name}",
+                         daemon=True)
+    t.start()
+    deadline = time.monotonic() + ready_timeout_s
+    while not ready_evt.wait(0.2):
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"replica {name} exited rc={proc.returncode} before "
+                "ready")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError(f"replica {name} not ready after "
+                               f"{ready_timeout_s}s")
+    return proc, dict(ready_box)
+
+
+# ---- the kill-and-replace A/B harness ---------------------------------------
+
+def _ab_arm(args, workdir: str, *, kill: bool) -> dict:
+    """One harness arm: N replicas under the seeded Poisson workload.
+    With `kill`, SIGKILL one replica mid-traffic and join a (pre-warmed)
+    standby in its place. Returns per-request outcomes/tokens plus the
+    router's counters — the caller does the cross-arm asserts."""
+    from . import diag, fleet, serving
+    fleet_dir = os.path.join(workdir, "spool")
+    os.makedirs(fleet_dir, exist_ok=True)
+    fleet.install_aggregator(fleet_dir, stale_after_s=60.0,
+                             poll_interval_s=0.05)
+    diag.start_diag_server(port=0)
+    r = Router(fleet_dir=fleet_dir,
+               queue_limit=max(64, 4 * args.requests),
+               max_attempts=8, retry_base_s=0.05, retry_max_s=1.0,
+               retry_total_s=args.timeout, retry_seed=args.seed,
+               health_interval_s=0.05, liveness_floor_s=1.0,
+               liveness_ceiling_s=15.0).start()
+    arm = {"kill": kill}
+    try:
+        names = [f"r{i}" for i in range(args.replicas)]
+        spawn_names = names + ([f"r{args.replicas}"] if kill else [])
+        spawned = {}
+        threads = []
+        errs = {}
+
+        def _spawn_one(n):
+            try:
+                spawned[n] = spawn_replica(n, fleet_dir, args)
+            except Exception as e:  # surfaced after the join below
+                errs[n] = e
+
+        for n in spawn_names:
+            t = threading.Thread(target=_spawn_one, args=(n,),
+                                 name=f"singa-route-spawn-{n}",
+                                 daemon=True)
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise RuntimeError(f"replica spawn failed: {errs}")
+        for n in names:
+            proc, ready = spawned[n]
+            r.add_replica(
+                n, f"http://127.0.0.1:{ready['ctl_port']}", host=n,
+                diag_url=f"http://127.0.0.1:{ready['diag_port']}",
+                proc=proc)
+        standby = spawned.get(f"r{args.replicas}")
+
+        wl = serving.poisson_workload(
+            args.seed, args.requests, args.rps, args.vocab,
+            (args.prompt_lo, args.prompt_hi), (4, args.new_hi))
+        kill_at = max(1, int(args.kill_frac * args.requests))
+        victim = names[1 % len(names)]
+        handles = []
+        t0 = time.perf_counter()
+        killed_ts = None
+        for i in range(args.requests):
+            dt = t0 + wl["arrivals"][i] - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            handles.append(r.submit(wl["prompts"][i],
+                                    int(wl["new_lens"][i])))
+            if kill and killed_ts is None and i >= kill_at:
+                # SIGKILL, not terminate: the replica gets no chance to
+                # drain — this is the crash the failover path exists
+                # for. Prefer the moment the victim has a request IN
+                # FLIGHT (spin briefly after the submit; at low rps the
+                # request would otherwise finish between arrivals), so
+                # the run provably exercises mid-request failover, and
+                # force the kill within a few arrivals regardless.
+                vrep = r.get_replica(victim)
+                spin = time.perf_counter() + 0.25
+                while time.perf_counter() < spin \
+                        and not vrep.inflight:
+                    time.sleep(0.001)
+                if not vrep.inflight and i < kill_at + 4 \
+                        and i < args.requests - 1:
+                    continue
+                vrep.proc.kill()
+                killed_ts = time.perf_counter() - t0
+                sproc, sready = standby
+                r.add_replica(
+                    f"r{args.replicas}",
+                    f"http://127.0.0.1:{sready['ctl_port']}",
+                    host=f"r{args.replicas}",
+                    diag_url=f"http://127.0.0.1:{sready['diag_port']}",
+                    proc=sproc)
+        stuck = [h.id for h in handles if not h.wait(args.timeout)]
+        snap = r.snapshot()
+        fleetz = fleet.fleet_report()
+        arm.update({
+            "stuck": stuck,
+            "outcomes": {h.id: h.outcome for h in handles},
+            "tokens": {h.id: list(h.tokens) for h in handles
+                       if h.outcome == OUTCOME_COMPLETED},
+            "served_by": sorted({h.replica for h in handles
+                                 if h.replica is not None}),
+            "ttfts": [h.ttft_s for h in handles
+                      if h.ttft_s is not None],
+            "attempts_max": max((h.attempts for h in handles),
+                                default=0),
+            "failovers": snap["failovers"]["replica_dead"]
+            + snap["failovers"]["drain"],
+            "retries": snap["retries"],
+            "reasons": snap["reasons"],
+            "replica_states": {rep["name"]: rep["state"]
+                               for rep in snap["replicas"]},
+            "killed_at_s": killed_ts,
+            "victim": victim if kill else None,
+            "fleetz_has_router": "== router ==" in fleetz,
+        })
+        if kill and standby is not None \
+                and f"r{args.replicas}" not in {
+                    rep["name"] for rep in snap["replicas"]}:
+            # kill_at was never reached (tiny workloads): retire the
+            # unused standby so nothing leaks
+            standby[0].kill()
+            standby[0].wait(timeout=10.0)
+        return arm
+    finally:
+        r.stop()
+        reset()
+        fleet.uninstall()
+        diag.stop_diag_server()
+
+
+def _ab_main(args) -> int:
+    from . import engine
+    base = tempfile.mkdtemp(prefix="singa_router_ab_")
+    rec = {"replicas": args.replicas, "requests": args.requests,
+           "rps": args.rps, "seed": args.seed, "ok": False}
+    try:
+        clean = _ab_arm(args, os.path.join(base, "clean"), kill=False)
+        kill = _ab_arm(args, os.path.join(base, "kill"), kill=True)
+    finally:
+        import shutil
+        shutil.rmtree(base, ignore_errors=True)
+    n = args.requests
+    clean_done = sum(1 for o in clean["outcomes"].values()
+                     if o == OUTCOME_COMPLETED)
+    kill_done = sum(1 for o in kill["outcomes"].values()
+                    if o == OUTCOME_COMPLETED)
+    # zero loss: every submit terminal, and through the kill every one
+    # COMPLETED (the retry budget is sized so nothing exhausts)
+    lost = len(kill["stuck"]) + sum(
+        1 for o in kill["outcomes"].values() if o is None)
+    matched = all(kill["tokens"].get(rid) == toks
+                  for rid, toks in clean["tokens"].items())
+    victim_dead = kill["replica_states"].get(kill["victim"]) \
+        == STATE_DEAD
+    standby_served = f"r{args.replicas}" in kill["served_by"]
+    p99_clean = engine.pctile(clean["ttfts"], 0.99)
+    p99_kill = engine.pctile(kill["ttfts"], 0.99)
+    rec.update({
+        "clean_completed": clean_done, "kill_completed": kill_done,
+        "lost_requests": lost,
+        "kill_outcomes": {o: sum(1 for v in kill["outcomes"].values()
+                                 if v == o) for o in ROUTE_OUTCOMES},
+        "failovers": kill["failovers"], "retries": kill["retries"],
+        "tokens_match_clean_arm": matched,
+        "victim_marked_dead": victim_dead,
+        "standby_served": standby_served,
+        "killed_at_s": kill["killed_at_s"],
+        "fleetz_has_router_rows": bool(clean["fleetz_has_router"]
+                                       and kill["fleetz_has_router"]),
+        "ttft_p99_clean_s": p99_clean, "ttft_p99_kill_s": p99_kill,
+        "ttft_p99_delta_s": (round(p99_kill - p99_clean, 6)
+                             if p99_clean is not None
+                             and p99_kill is not None else None),
+    })
+    rec["ok"] = bool(
+        clean_done == n and kill_done == n and lost == 0 and matched
+        and victim_dead and standby_served
+        and kill["failovers"] >= 1
+        and rec["fleetz_has_router_rows"]
+        and p99_clean is not None and p99_kill is not None)
+    lines = [
+        {"metric": "router_lost_requests", "value": float(lost),
+         "unit": "count"},
+        {"metric": "router_failover_requests",
+         "value": float(kill["failovers"]), "unit": "count"},
+        {"metric": "router_ttft_p99_clean_s",
+         "value": float(p99_clean or 0.0), "unit": "s"},
+        {"metric": "router_ttft_p99_kill_s",
+         "value": float(p99_kill or 0.0), "unit": "s"},
+        rec,
+    ]
+    with open(args.out, "w", encoding="utf-8") as f:
+        for obj in lines:
+            f.write(json.dumps(obj, sort_keys=True) + "\n")
+    print(json.dumps(rec, indent=2, sort_keys=True))
+    return 0 if rec["ok"] else 1
+
+
+# ---- CLI --------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="python -m singa_tpu.router",
+        description="serving control plane: --replica runs one serving "
+                    "replica; --ab runs the kill-and-replace harness")
+    p.add_argument("--replica", action="store_true")
+    p.add_argument("--ab", action="store_true")
+    p.add_argument("--name", default="r0")
+    p.add_argument("--fleet-dir", default=None)
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--rps", type=float, default=4.0)
+    p.add_argument("--seed", type=int, default=1234)
+    p.add_argument("--kill-frac", type=float, default=0.35,
+                   help="kill the victim after this fraction of "
+                        "submits (kill arm)")
+    p.add_argument("--vocab", type=int, default=211)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--prompt-lo", type=int, default=4)
+    p.add_argument("--prompt-hi", type=int, default=12)
+    p.add_argument("--new-hi", type=int, default=24)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--page-size", type=int, default=8)
+    p.add_argument("--publish-interval", type=float, default=0.1)
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--out", default="SERVE_r01.json")
+    args = p.parse_args(argv)
+    if args.replica:
+        if not args.fleet_dir:
+            p.error("--replica needs --fleet-dir")
+        return _replica_main(args)
+    if args.ab:
+        return _ab_main(args)
+    p.error("pick a mode: --replica or --ab")
+    return 2
+
+
+__all__ = [
+    "ROUTE_OUTCOMES", "ROUTE_REASONS", "REPLICA_STATES",
+    "Router", "RouterRequest", "Replica", "ReplicaControl",
+    "install_router", "get_router", "reset",
+    "serving_lines", "fleetz_lines", "router_report",
+    "spawn_replica",
+]
+
+if __name__ == "__main__":
+    # run under the CANONICAL module (not the runpy __main__ alias): the
+    # CLI installs module singletons the diag/fleet layers reach via
+    # `import singa_tpu.router`
+    from singa_tpu.router import main as _main
+    sys.exit(_main())

@@ -1410,6 +1410,43 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
     return run
 
 
+def poisson_workload(seed, n_req, rps, vocab, prompt_lens, new_lens,
+                     new_dist="bimodal"):
+    """The seeded Poisson serving workload shared by `bench_decode
+    --serve`, `slo --ab`, and the router's kill-and-replace harness:
+    exponential inter-arrival times at `rps`, uniform prompt lengths in
+    `prompt_lens = (lo, hi)`, and output lengths in `new_lens = (lo,
+    hi)` — bimodal by default (75% short / 25% long, the mix that keeps
+    a continuous-batching engine's slots ragged). Fully determined by
+    `seed`: two arms replaying the same workload submit byte-identical
+    prompts at identical offsets, which is what makes A/B comparisons
+    (and the router's token-identity failover assert) meaningful.
+
+    Returns {"arrivals": float array of cumulative offsets (s),
+    "prompts": list of int32 prompt arrays, "new_lens": int array}.
+    """
+    import numpy as np
+    p_lo, p_hi = (int(x) for x in prompt_lens)
+    n_lo, n_hi = (int(x) for x in new_lens)
+    n_req = int(n_req)
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / float(rps), n_req))
+    prompts = [rng.randint(0, int(vocab),
+                           (rng.randint(p_lo, p_hi + 1),)).astype(np.int32)
+               for _ in range(n_req)]
+    if new_dist == "bimodal":
+        short_hi = max(n_lo + 1, n_lo + (n_hi - n_lo) // 4)
+        long_lo = max(short_hi, n_hi - (n_hi - n_lo) // 8)
+        is_long = rng.rand(n_req) < 0.25
+        lens = np.where(is_long,
+                        rng.randint(long_lo, n_hi + 1, n_req),
+                        rng.randint(n_lo, short_hi + 1, n_req))
+    else:
+        lens = rng.randint(n_lo, n_hi + 1, n_req)
+    return {"arrivals": arrivals, "prompts": prompts, "new_lens": lens}
+
+
 __all__ = ["build_decode", "build_beam_decode", "build_spec_decode",
            "decode_state", "decode_params", "decode_raw",
-           "KV_DTYPES", "SPEC_VERDICTS", "kv_label", "record_spec"]
+           "KV_DTYPES", "SPEC_VERDICTS", "kv_label", "record_spec",
+           "poisson_workload"]

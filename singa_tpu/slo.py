@@ -897,6 +897,11 @@ def fleet_serve_snapshot(max_timelines: int = _SHARD_TIMELINES,
         }
     return {
         "engines": len(engines),
+        # graceful drain in flight: the engine has stopped admitting
+        # but is finishing its slots — the router/fleet view shows the
+        # replica as draining rather than merely quiet
+        "draining": any(getattr(e, "_draining", False)
+                        for e in engines),
         "rps": round(rps, 3),
         "queue_depth": queue_depth,
         "occupancy": occupancy,

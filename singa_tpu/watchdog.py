@@ -204,6 +204,23 @@ def format_stacks(stacks=None) -> str:
 
 # ---- per-op deadline state -------------------------------------------------
 
+def calibrated_deadline(samples, *, multiplier=10.0, floor_s=1.0,
+                        ceiling_s=600.0, min_samples=8):
+    """The watchdog's calibration rule as a reusable function:
+    clamp(p99(samples) x multiplier, floor_s, ceiling_s), or None while
+    there are fewer than `min_samples` observations (DISARMED — a
+    breach verdict needs evidence of what "normal" looks like). Shared
+    by OpDeadline below and the serving router's replica-liveness
+    deadline (router.py calibrates over observed fleet-shard publish
+    intervals with the same rule)."""
+    s = sorted(float(x) for x in samples)
+    if len(s) < int(min_samples):
+        return None
+    p99 = s[min(len(s) - 1, int(0.99 * len(s)))]
+    return min(max(p99 * float(multiplier), float(floor_s)),
+               float(ceiling_s))
+
+
 class OpDeadline:
     """Deadline state for one DEADLINE_OPS member.
 
@@ -233,11 +250,12 @@ class OpDeadline:
         if self.static is not None:
             return
         self.samples.append(float(seconds))
-        if len(self.samples) >= self.min_samples:
-            s = sorted(self.samples)
-            p99 = s[min(len(s) - 1, int(0.99 * len(s)))]
-            self._cached = min(max(p99 * self.multiplier, self.floor_s),
-                               self.ceiling_s)
+        d = calibrated_deadline(
+            self.samples, multiplier=self.multiplier,
+            floor_s=self.floor_s, ceiling_s=self.ceiling_s,
+            min_samples=self.min_samples)
+        if d is not None:
+            self._cached = d
 
     def deadline(self) -> "float | None":
         """Armed deadline in seconds, or None while uncalibrated."""
@@ -1183,6 +1201,7 @@ def main(argv=None) -> int:
 
 __all__ = [
     "DEADLINE_OPS", "ESCALATION", "HangError", "OpDeadline", "Watchdog",
+    "calibrated_deadline",
     "guard", "install_watchdog", "uninstall_watchdog", "get_watchdog",
     "hang_report", "thread_stacks", "format_stacks", "load_hang_bundle",
     "watchdog_report",

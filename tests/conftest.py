@@ -53,9 +53,11 @@ def _metrics_isolation():
     asserts the test left no async checkpoint pending, no prefetcher
     thread alive, and no stray non-daemon thread behind."""
     from singa_tpu import (diag, engine, fleet, goodput, health,
-                           introspect, memory, observe, slo, watchdog)
+                           introspect, memory, observe, router, slo,
+                           watchdog)
     diag.stop_diag_server()
     goodput.uninstall()
+    router.reset()
     fleet.uninstall()
     engine.reset()
     slo.reset()
@@ -82,6 +84,22 @@ def _metrics_isolation():
     assert not leaked_wd, (
         f"watchdog thread(s) left running: {leaked_wd} — call "
         "watchdog.uninstall_watchdog() before the test ends")
+    # router teardown (ISSUE-15): the installed router stopped — its
+    # dispatcher/health/sender threads joined, replica subprocesses
+    # reaped, and every still-pending request drained with a TERMINAL
+    # outcome (rejected, reason "drain" — the zero-loss contract holds
+    # even through test teardown). Runs BEFORE the engine check because
+    # a router-owned ReplicaControl wraps an engine. Capture-then-clean:
+    # the leak is recorded first and cleaned regardless, so one leaky
+    # test fails itself without cascading into the suite.
+    leaked_route = [t.name for t in threading.enumerate()
+                    if t.is_alive()
+                    and t.name.startswith("singa-route")]
+    router.reset()
+    assert not leaked_route, (
+        f"router thread(s) left running: {leaked_route} — call "
+        "Router.stop() / ReplicaControl.stop() (or router.reset()) "
+        "before the test ends")
     # serving-engine teardown (ISSUE-11): every live engine stopped —
     # the admission queue drained (in-flight requests finished
     # "evicted"), the singa-serve-* decode thread joined, the page pool

@@ -77,9 +77,12 @@ def test_regressions_flagged_against_best_prior_round():
     # a harness flipping ok->not-ok is a regression too
     assert "harn_ok" in by_metric
     # a looser threshold forgives the throughput slide but not the
-    # ok-flag collapse
+    # ok-flag collapse — nor the router reliability records (0->2 lost
+    # is delta inf, 1->4 failovers is +300%; reliability slides are
+    # built to outlive any sane threshold)
     loose = bench_trend.find_regressions(table, threshold=0.5)
-    assert {m for m, *_ in loose} == {"harn_ok"}
+    assert {m for m, *_ in loose} == {"harn_ok", "router_lost_requests",
+                                      "router_failover_requests"}
 
 
 def test_cli_exit_codes(capsys):
@@ -237,3 +240,39 @@ def test_acceptance_fixture_regression_flagged():
     rnd, v, best_r, best, delta = regs["toy_spec_acceptance_rate_pct"]
     assert (rnd, v, best_r, best) == (2, 66.0, 1, 88.0)
     assert abs(delta - 22.0 / 88.0) < 1e-9
+
+
+def test_loss_and_failover_counts_lower_is_better():
+    """ISSUE-15: the router harness's dropped/lost/failover counts are
+    lower-better regardless of unit — a reliability slide is a
+    regression even though the records are plain counts — while rate
+    units still win (a hypothetical failovers-handled/s throughput)."""
+    assert bench_trend.lower_is_better("router_lost_requests", "count")
+    assert bench_trend.lower_is_better("router_failover_requests",
+                                       "count")
+    assert bench_trend.lower_is_better("requests_dropped", "")
+    assert not bench_trend.lower_is_better("failover_handled_per_s",
+                                           "items/s")
+
+
+def test_router_loss_fixture_regression_flagged():
+    """The SERVE r03/r04 fixture rounds carry the router reliability
+    records: flat-at-zero loss in clean/ (no flag — zero staying zero
+    is the contract), and in regress/ a 0->2 lost-request jump (delta
+    inf: zero-to-nonzero is always flagged) plus a 1->4 failover
+    rise."""
+    clean = bench_trend.trend_table(bench_trend.collect([CLEAN]))
+    assert clean["router_lost_requests"]["by_round"] == {3: 0.0,
+                                                        4: 0.0}
+    assert not [r for r in bench_trend.find_regressions(clean)
+                if r[0].startswith("router_")]
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = {m: (rnd, v, best_r, best, delta)
+            for m, rnd, v, best_r, best, delta
+            in bench_trend.find_regressions(table, threshold=0.05)}
+    rnd, v, best_r, best, delta = regs["router_lost_requests"]
+    assert (rnd, v, best_r, best) == (4, 2.0, 3, 0.0)
+    assert delta == float("inf")
+    rnd, v, best_r, best, delta = regs["router_failover_requests"]
+    assert (rnd, v, best_r, best) == (4, 4.0, 3, 1.0)
+    assert abs(delta - 3.0) < 1e-9

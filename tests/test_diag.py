@@ -569,3 +569,106 @@ def test_statusz_serving_spec_lines(served):
         assert "spec draft overhead: params" in body
     finally:
         e.stop()
+
+
+# ---- the serving control plane on the diag surface (ISSUE-15) --------------
+
+def _stub_routed_router():
+    """An installed router with one live stub replica and one finished
+    request — enough state for every golden router row."""
+    import threading
+
+    from singa_tpu import router as rt
+
+    class _Req:
+        outcome, detail, ttft_s = "completed", None, 0.001
+        tokens = [1, 2]
+
+        def wait(self, timeout=None):
+            return True
+
+    class _Eng:
+        def submit(self, prompt, max_new):
+            return _Req()
+
+        def stop(self, *a, **k):
+            return []
+
+    ctl = rt.ReplicaControl(_Eng())
+    r = rt.Router(queue_limit=8, retry_total_s=10.0,
+                  poll_wait_s=0.3).start()
+    r.add_replica("ra", ctl.url, host="ra")
+    h = r.submit(np.array([1, 2], np.int32), 2)
+    assert h.wait(30) and h.outcome == "completed"
+    return r, ctl
+
+
+def test_routerz_golden_sections(served):
+    """/routerz: 503 + guidance without a router; with one installed,
+    the replica table carries state/inflight/dispatched/completed plus
+    the shed/failover/retry counter line."""
+    from singa_tpu import router as rt
+    srv = served[0]
+    status, _, body = _get(srv, "/routerz")
+    assert status == 503
+    assert "no Router installed" in body
+    r, ctl = _stub_routed_router()
+    try:
+        status, _, body = _get(srv, "/routerz")
+        assert status == 200
+        assert "== router ==" in body
+        assert re.search(r"queue 0/8\s+completed 1\s+rejected 0", body)
+        assert "failover(replica_dead) 0" in body
+        assert "failover(drain) 0" in body
+        assert "retry_exhausted 0" in body
+        assert re.search(r"ra\s+live\s+0\s+1\s+1", body)
+        assert "uncalibrated" in body   # no shard intervals yet
+    finally:
+        r.stop()
+        rt.reset()
+        ctl.stop()
+
+
+def test_statusz_serving_carries_router_rows(served):
+    """The `== serving ==` section shows the router's control-plane
+    rows (replica states + routed counts) even in a process with no
+    local ServingEngine — the coordinator case."""
+    from singa_tpu import router as rt
+    srv = served[0]
+    r, ctl = _stub_routed_router()
+    try:
+        status, _, body = _get(srv, "/statusz")
+        assert status == 200
+        assert "== serving ==" in body
+        assert "router: replicas 1 live / 0 draining / 0 dead" in body
+        assert "routed: completed 1, rejected 0 (shed 0" in body
+        assert "replica ra: live" in body
+        # the no-engine hint yields to the router rows
+        assert "no ServingEngine running" not in body
+    finally:
+        r.stop()
+        rt.reset()
+        ctl.stop()
+
+
+def test_fleetz_carries_router_section(served, tmp_path):
+    """/fleetz appends the `== router ==` block after the fleet tables
+    when a router is installed alongside the aggregator."""
+    from singa_tpu import fleet
+    from singa_tpu import router as rt
+    srv = served[0]
+    fleet.install_aggregator(str(tmp_path / "spool"))
+    r, ctl = _stub_routed_router()
+    try:
+        status, _, body = _get(srv, "/fleetz")
+        assert status == 200
+        assert "== fleet ==" in body
+        assert "== router ==" in body
+        assert re.search(r"ra\s+live", body)
+        # control plane renders after the data plane
+        assert body.index("== router ==") > body.index("== fleet ==")
+    finally:
+        r.stop()
+        rt.reset()
+        ctl.stop()
+        fleet.uninstall()

@@ -331,3 +331,72 @@ def test_engine_reset_joins_threads(gpt):
     time.sleep(0.05)
     assert not any(t.name.startswith("singa-serve") and t.is_alive()
                    for t in threading.enumerate())
+
+
+# ---- graceful drain (ISSUE-15) ---------------------------------------------
+
+def test_graceful_drain_finishes_inflight_and_hands_back_queue(gpt):
+    """stop(drain=True): in-flight slots finish "completed", queued-
+    but-unadmitted requests come back to the caller STILL non-terminal
+    (outcome None — the router re-routes them), and a graceful stop of
+    a healthy engine produces zero "evicted" terminals."""
+    e = eng.ServingEngine(gpt, max_slots=2, page_size=8, max_ctx=64,
+                          queue_limit=64).start()
+    w = e.submit(np.ones(8, np.int32), 2)
+    assert w.wait(300)
+    reqs = [e.submit(np.ones(6, np.int32), 50) for _ in range(10)]
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and e.report()["active"] == 0:
+        time.sleep(0.002)     # let the loop admit into the slots
+    handed = e.stop(drain=True, drain_timeout_s=300.0)
+    done = [r for r in reqs if r.outcome == "completed"]
+    back = [r for r in reqs if r.outcome is None]
+    assert not [r for r in reqs if r.outcome == "evicted"], \
+        "graceful drain must not evict"
+    assert done, "the in-flight slots must finish"
+    assert len(done) + len(back) == len(reqs)
+    assert {id(r) for r in handed} == {id(r) for r in back}
+    for r in back:      # handed-back requests are re-routable as-is
+        assert r.outcome is None and not r.tokens
+
+
+def test_drain_rejects_new_submissions_while_draining(gpt):
+    """The admission gate flips the moment the drain starts: a submit
+    racing the drain is rejected with a draining detail (retryable at
+    the router), never silently queued into a stopping engine."""
+    e = eng.ServingEngine(gpt, max_slots=1, page_size=8, max_ctx=64,
+                          queue_limit=64).start()
+    w = e.submit(np.ones(8, np.int32), 2)
+    assert w.wait(300)
+    busy = e.submit(np.ones(6, np.int32), 50)
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline and e.report()["active"] == 0:
+        time.sleep(0.002)
+    t = threading.Thread(target=lambda: e.stop(drain=True,
+                                               drain_timeout_s=300.0))
+    t.start()
+    deadline = time.monotonic() + 10.0
+    late = None
+    while time.monotonic() < deadline:
+        late = e.submit(np.ones(4, np.int32), 2)
+        if late.outcome == "rejected" and "draining" in late.detail:
+            break
+        time.sleep(0.002)
+    t.join(timeout=300.0)
+    assert late is not None and late.outcome == "rejected"
+    assert "draining" in late.detail or "not running" in late.detail
+    assert busy.outcome == "completed"
+
+
+def test_plain_stop_still_evicts(gpt):
+    """The default stop() keeps its old contract: queued work is
+    terminally evicted (nothing handed back) — drain is opt-in."""
+    e = eng.ServingEngine(gpt, max_slots=1, page_size=8, max_ctx=64,
+                          queue_limit=64).start()
+    w = e.submit(np.ones(8, np.int32), 2)
+    assert w.wait(300)
+    reqs = [e.submit(np.ones(6, np.int32), 50) for _ in range(4)]
+    handed = e.stop()
+    assert handed == []
+    for r in reqs:
+        assert r.outcome in ("completed", "evicted")

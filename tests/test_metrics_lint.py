@@ -559,3 +559,59 @@ def test_verdict_and_kv_dtype_label_rules(tmp_path):
     assert any("'guessed'" in p for p in problems)
     assert any("'nf4'" in p for p in problems)
     assert any("dynamic" in p for p in problems)
+
+
+def test_lint_covers_router_metric_names():
+    """ISSUE-15: rule 5 extends to the router's `reason=`/`replica=`
+    labels — ROUTE_REASONS / ROUTE_OUTCOMES / REPLICA_STATES are
+    recognized as declared enum tuples and every singa_route_*
+    registration in router.py passes the full lint."""
+    router_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                             "router.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(router_py)}
+    assert {"singa_route_requests_total", "singa_route_rejects_total",
+            "singa_route_failover_total", "singa_route_retries_total",
+            "singa_route_queue_depth", "singa_route_replicas_live",
+            "singa_route_replica_inflight",
+            "singa_route_request_seconds"} <= names
+    assert all(n.startswith("singa_route_") for n in names)
+    assert check_metrics_names.check([router_py]) == []
+    import ast
+    enums, consts = check_metrics_names._module_enum_info(
+        ast.parse(open(router_py).read()))
+    assert enums["ROUTE_REASONS"] == ("shed", "replica_dead", "drain",
+                                      "retry_exhausted")
+    assert enums["ROUTE_OUTCOMES"] == ("completed", "rejected")
+    assert enums["REPLICA_STATES"] == ("live", "draining", "dead")
+    # the literal aliases resolve as proven members
+    assert consts["REASON_SHED"] == "shed"
+    assert consts["REASON_REPLICA_DEAD"] == "replica_dead"
+    assert "replica" in check_metrics_names.ENUM_LABEL_KWARGS
+
+
+def test_route_reason_and_replica_label_rules(tmp_path):
+    """A reason= literal outside the declared router enum is rejected;
+    declared members, resolved constants, and REPLICA_STATES-guarded
+    dynamic replica= names pass — unguarded dynamics fail."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "ROUTE_REASONS = ('shed', 'replica_dead', 'drain',"
+        " 'retry_exhausted')\n"
+        "REPLICA_STATES = ('live', 'draining', 'dead')\n"
+        "REASON_SHED = 'shed'\n"
+        "observe.counter('singa_r_total', 'a').inc(reason='shed')\n"
+        "observe.counter('singa_r_total', 'a').inc(reason=REASON_SHED)\n"
+        "observe.counter('singa_r_total', 'a').inc(reason='oom')\n"
+        "observe.gauge('singa_g', 'b').set(1.0, replica='r0')\n"
+        "def guarded(rep):\n"
+        "    assert rep.state in REPLICA_STATES\n"
+        "    observe.gauge('singa_g', 'b').set(1.0, replica=rep.name)\n"
+        "def unguarded(rep):\n"
+        "    observe.gauge('singa_g', 'b').set(1.0, replica=rep.name)\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 3, problems
+    assert any("'oom'" in p for p in problems)
+    # a replica= string literal is not a member of any declared enum
+    assert any("'r0'" in p for p in problems)
+    assert any("dynamic" in p for p in problems)

@@ -1,0 +1,437 @@
+"""Serving control plane (ISSUE-15): the multi-replica router balances
+on live occupancy, sheds over its bounded queue, fails requests over
+from dead replicas with token-identical results, re-routes everything a
+graceful drain hands back, and reaches a TERMINAL outcome for every
+submit — including through its own teardown."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import device, models, observe, tensor
+from singa_tpu import engine as eng
+from singa_tpu import router as rt
+
+
+# ---- stub replica plumbing -------------------------------------------------
+# A stub engine behind a REAL ReplicaControl HTTP surface: deterministic
+# canned tokens (a pure function of the prompt, like greedy decode on
+# identical replicas) without paying for model compiles. Every router
+# behavior except the decode itself is exercised at full fidelity.
+
+def _canned(prompt, max_new):
+    s = int(np.sum(np.asarray(prompt, np.int64)))
+    return [(s + i) % 97 for i in range(int(max_new))]
+
+
+class _StubReq:
+    def __init__(self, prompt, max_new, delay=0.0, outcome="completed",
+                 detail=None):
+        self.outcome = outcome
+        self.tokens = _canned(prompt, max_new) \
+            if outcome == "completed" else []
+        self.detail = detail
+        self.ttft_s = 0.001
+        self._delay = delay
+
+    def wait(self, timeout=None):
+        if self._delay:
+            time.sleep(self._delay)
+        return True
+
+
+class _StubEngine:
+    def __init__(self, delay=0.0, outcome="completed", detail=None):
+        self.delay = delay
+        self.outcome = outcome
+        self.detail = detail
+        self.submitted = 0
+
+    def submit(self, prompt, max_new):
+        self.submitted += 1
+        return _StubReq(prompt, max_new, self.delay, self.outcome,
+                        self.detail)
+
+    def stop(self, *a, **k):
+        return []
+
+
+def _mk_router(**kw):
+    kw.setdefault("queue_limit", 64)
+    kw.setdefault("retry_total_s", 30.0)
+    kw.setdefault("poll_wait_s", 0.3)
+    kw.setdefault("retry_seed", 0)
+    return rt.Router(**kw).start()
+
+
+@pytest.fixture
+def stubs():
+    """Two live stub replicas behind a started router; everything is
+    torn down even when the test body raises."""
+    ctls = [rt.ReplicaControl(_StubEngine()) for _ in range(2)]
+    r = _mk_router()
+    for i, c in enumerate(ctls):
+        r.add_replica(f"s{i}", c.url, host=f"s{i}")
+    try:
+        yield r, ctls
+    finally:
+        r.stop()
+        rt.reset()
+        for c in ctls:
+            c.stop()
+
+
+# ---- routing + terminal outcomes -------------------------------------------
+
+def test_routes_and_completes_with_deterministic_tokens(stubs):
+    r, _ = stubs
+    hs = [r.submit(np.array([i, 2, 3], np.int32), 4) for i in range(6)]
+    for i, h in enumerate(hs):
+        assert h.wait(30)
+        assert h.outcome == "completed", (h.outcome, h.detail)
+        assert h.tokens == _canned([i, 2, 3], 4)
+        assert h.replica in ("s0", "s1")
+    snap = r.snapshot()
+    assert snap["terminal"]["completed"] == 6
+    assert snap["pending"] == 0
+
+
+def test_result_raises_on_rejected_and_returns_tokens_on_completed(
+        stubs):
+    r, _ = stubs
+    h = r.submit(np.array([5], np.int32), 3)
+    assert h.result(30) == _canned([5], 3)
+    r.stop()
+    h2 = r.submit(np.array([5], np.int32), 3)
+    assert h2.done() and h2.outcome == "rejected"
+    with pytest.raises(RuntimeError):
+        h2.result(1)
+
+
+def test_balances_across_replicas(stubs):
+    r, ctls = stubs
+    hs = [r.submit(np.array([i], np.int32), 2) for i in range(16)]
+    for h in hs:
+        assert h.wait(30) and h.outcome == "completed"
+    # both stubs served a sane share (scores tie at 0 between
+    # dispatches, so the round-robin tiebreak spreads the load)
+    assert ctls[0].eng.submitted > 0 and ctls[1].eng.submitted > 0
+
+
+# ---- admission control -----------------------------------------------------
+
+def test_sheds_over_bounded_queue():
+    slow = rt.ReplicaControl(_StubEngine(delay=0.5))
+    r = _mk_router(queue_limit=1)
+    r.add_replica("slow", slow.url, host="slow")
+    try:
+        hs = [r.submit(np.array([1], np.int32), 1) for _ in range(12)]
+        for h in hs:
+            assert h.wait(30)
+        outs = {h.outcome for h in hs}
+        shed = [h for h in hs if h.reason == "shed"]
+        assert shed, "queue_limit=1 under burst must shed"
+        assert all(h.outcome == "rejected" for h in shed)
+        assert "queue full" in shed[0].detail
+        assert "completed" in outs  # the admitted ones still finish
+    finally:
+        r.stop()
+        rt.reset()
+        slow.stop()
+
+
+def test_retry_exhausted_without_any_live_replica():
+    r = _mk_router(retry_total_s=0.5, poll_wait_s=0.1)
+    try:
+        h = r.submit(np.array([1], np.int32), 1)
+        assert h.wait(30)
+        assert h.outcome == "rejected"
+        assert h.reason == "retry_exhausted"
+    finally:
+        r.stop()
+        rt.reset()
+
+
+def test_structural_rejection_passes_through_without_retry():
+    """A rejection that would repeat on every identical replica (e.g.
+    over-length) is terminal at the router — not a retry loop."""
+    ctl = rt.ReplicaControl(_StubEngine(
+        outcome="rejected",
+        detail="prompt 99 + max_new 99 exceeds max_ctx 36"))
+    r = _mk_router()
+    r.add_replica("s0", ctl.url, host="s0")
+    try:
+        h = r.submit(np.array([1], np.int32), 1)
+        assert h.wait(30)
+        assert h.outcome == "rejected"
+        assert h.reason is None          # replica-minted, not router-
+        assert "max_ctx" in h.detail
+        assert h.attempts == 1
+    finally:
+        r.stop()
+        rt.reset()
+        ctl.stop()
+
+
+# ---- failover --------------------------------------------------------------
+
+def test_failover_from_dead_replica_is_token_identical():
+    """Dispatches to a connection-refused replica fail over to the
+    survivor; the dead replica is probed, marked dead, and the final
+    tokens are exactly what a clean route would have produced."""
+    dead = rt.ReplicaControl(_StubEngine())
+    dead_url = dead.url
+    dead.stop()                      # port closed: dispatches refuse
+    live = rt.ReplicaControl(_StubEngine())
+    r = _mk_router()
+    r.add_replica("dead", dead_url, host="dead")
+    r.add_replica("live", live.url, host="live")
+    try:
+        hs = [r.submit(np.array([i, 1], np.int32), 3)
+              for i in range(8)]
+        for i, h in enumerate(hs):
+            assert h.wait(30), f"request {i} stuck"
+            assert h.outcome == "completed", (h.outcome, h.detail)
+            assert h.tokens == _canned([i, 1], 3)
+            assert h.replica == "live"
+        assert r.get_replica("dead").state == "dead"
+        snap = r.snapshot()
+        assert snap["failovers"]["replica_dead"] >= 1
+        assert any(h.attempts > 1 for h in hs)
+    finally:
+        r.stop()
+        rt.reset()
+        live.stop()
+
+
+def test_drain_handback_reroutes_to_survivor():
+    """A replica whose control surface hands requests back ("requeued",
+    the graceful-drain protocol) gets its work re-routed, counted as a
+    drain failover — and with the replica marked draining, nothing
+    routes back to it."""
+
+    class _Requeueing(_StubEngine):
+        def submit(self, prompt, max_new):
+            raise AssertionError("drained replica must not admit")
+
+    draining = rt.ReplicaControl(_Requeueing())
+    draining.draining = True          # /submit now answers "requeued"
+    survivor = rt.ReplicaControl(_StubEngine())
+    r = _mk_router()
+    rep = r.add_replica("d0", draining.url, host="d0")
+    r.add_replica("ok", survivor.url, host="ok")
+    with r._lock:
+        rep.state = "draining"
+    try:
+        hs = [r.submit(np.array([i], np.int32), 2) for i in range(6)]
+        for i, h in enumerate(hs):
+            assert h.wait(30)
+            assert h.outcome == "completed", (h.outcome, h.detail)
+            assert h.tokens == _canned([i], 2)
+            assert h.replica == "ok"
+    finally:
+        r.stop()
+        rt.reset()
+        draining.stop()
+        survivor.stop()
+
+
+def test_replacement_replica_joins_mid_wait():
+    """With zero live replicas, senders WAIT (bounded) instead of
+    failing — a replacement that joins inside the window picks the
+    requests up."""
+    r = _mk_router(retry_total_s=30.0)
+    late = None
+    try:
+        hs = [r.submit(np.array([i], np.int32), 2) for i in range(3)]
+        time.sleep(0.2)
+        assert all(not h.done() for h in hs)   # waiting, not rejected
+        late = rt.ReplicaControl(_StubEngine())
+        r.add_replica("late", late.url, host="late")
+        for i, h in enumerate(hs):
+            assert h.wait(30)
+            assert h.outcome == "completed"
+            assert h.tokens == _canned([i], 2)
+    finally:
+        r.stop()
+        rt.reset()
+        if late is not None:
+            late.stop()
+
+
+# ---- teardown terminality --------------------------------------------------
+
+def test_stop_terminates_every_pending_request():
+    """Zero-loss through shutdown: stop() leaves no request without a
+    terminal outcome, and post-stop submits reject immediately."""
+    slow = rt.ReplicaControl(_StubEngine(delay=0.4))
+    r = _mk_router(queue_limit=32)
+    r.add_replica("slow", slow.url, host="slow")
+    hs = [r.submit(np.array([1], np.int32), 1) for _ in range(8)]
+    r.stop()
+    try:
+        for h in hs:
+            assert h.done(), "stop() left a request non-terminal"
+            assert h.outcome in rt.ROUTE_OUTCOMES
+        post = r.submit(np.array([1], np.int32), 1)
+        assert post.done() and post.outcome == "rejected"
+        assert post.reason == "drain"
+    finally:
+        rt.reset()
+        slow.stop()
+
+
+def test_reset_is_the_conftest_contract():
+    ctl = rt.ReplicaControl(_StubEngine())
+    r = _mk_router()
+    r.add_replica("s0", ctl.url, host="s0")
+    assert rt.get_router() is r
+    rt.reset()
+    assert rt.get_router() is None
+    ctl.stop()
+    alive = [t.name for t in threading.enumerate()
+             if t.is_alive() and t.name.startswith("singa-route")]
+    assert not alive, alive
+
+
+# ---- metrics + reports -----------------------------------------------------
+
+def test_route_metrics_registered_and_counted(stubs):
+    r, _ = stubs
+    h = r.submit(np.array([3], np.int32), 2)
+    assert h.wait(30) and h.outcome == "completed"
+    reg = observe.get_registry()
+    names = set(reg.names())
+    for n in ("singa_route_requests_total", "singa_route_queue_depth",
+              "singa_route_replicas_live",
+              "singa_route_replica_inflight",
+              "singa_route_request_seconds"):
+        assert n in names, n
+    req = reg.get("singa_route_requests_total")
+    assert req.value(outcome="completed") >= 1
+    assert reg.get("singa_route_replicas_live").value() == 2.0
+
+
+def test_report_surfaces(stubs):
+    r, _ = stubs
+    h = r.submit(np.array([3], np.int32), 2)
+    assert h.wait(30)
+    txt = rt.router_report()
+    assert "== router ==" in txt
+    assert "s0" in txt and "s1" in txt and "live" in txt
+    sl = rt.serving_lines()
+    assert any("router: replicas 2 live" in ln for ln in sl)
+    # the serving report carries the router rows even with no local
+    # engine (the coordinator case)
+    rep = eng.serving_report()
+    assert "router: replicas 2 live" in rep
+
+
+def test_reports_empty_without_router():
+    rt.reset()
+    assert rt.serving_lines() == []
+    assert rt.fleetz_lines() == []
+    assert "no Router installed" in rt.router_report()
+
+
+# ---- real-engine integration ----------------------------------------------
+
+def test_router_matches_direct_engine_tokens():
+    """One REAL ServingEngine behind the control surface: routed greedy
+    tokens are byte-identical to a direct engine submit — the
+    determinism anchor the failover guarantee stands on."""
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=101, max_seq=36, dim=32,
+                            num_heads=4, num_layers=2)
+    ids = tensor.from_numpy(
+        np.random.RandomState(0).randint(0, 101, (2, 8))
+        .astype(np.int32), device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    e = eng.ServingEngine(m, max_slots=2, page_size=8, max_ctx=36,
+                          queue_limit=32).start()
+    w = e.submit(np.ones(8, np.int32), 2)
+    assert w.wait(300)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 101, rng.randint(4, 12)).astype(np.int32)
+               for _ in range(4)]
+    direct = []
+    for p in prompts:
+        d = e.submit(p, 6)
+        assert d.wait(300) and d.outcome == "completed"
+        direct.append(list(d.tokens))
+    ctl = rt.ReplicaControl(e)
+    r = _mk_router()
+    r.add_replica("real", ctl.url, host="real")
+    try:
+        for p, want in zip(prompts, direct):
+            h = r.submit(p, 6)
+            assert h.wait(300)
+            assert h.outcome == "completed", (h.outcome, h.detail)
+            assert h.tokens == want
+            assert h.ttft_s is not None and h.ttft_s >= 0.0
+    finally:
+        r.stop()
+        rt.reset()
+        ctl.stop()
+        e.stop()
+
+
+def test_rolling_restart_drains_without_loss_or_evictions():
+    """Rolling restart under load, the real thing: two engines behind
+    the router, one drained mid-traffic via drain_replica() — its
+    in-flight requests finish, its queued requests are handed back and
+    re-routed to the survivor, every submit completes, and NO request
+    anywhere terminates "evicted"."""
+    dev = device.best_device()
+    m = models.create_model("gpt", vocab_size=101, max_seq=64, dim=32,
+                            num_heads=4, num_layers=2)
+    ids = tensor.from_numpy(
+        np.random.RandomState(0).randint(0, 101, (2, 8))
+        .astype(np.int32), device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    engines = [eng.ServingEngine(m, max_slots=1, page_size=8,
+                                 max_ctx=64, queue_limit=64).start()
+               for _ in range(2)]
+    for e in engines:
+        w = e.submit(np.ones(8, np.int32), 2)
+        assert w.wait(300)
+    ctls = [rt.ReplicaControl(e) for e in engines]
+    r = _mk_router(retry_total_s=120.0, poll_wait_s=0.5)
+    for i, c in enumerate(ctls):
+        r.add_replica(f"r{i}", c.url, host=f"r{i}")
+    try:
+        hs = [r.submit(np.ones(6, np.int32), 40) for _ in range(8)]
+        rep0 = r.get_replica("r0")
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and not rep0.inflight:
+            time.sleep(0.005)    # drain mid-traffic, not before it
+        out = r.drain_replica("r0", timeout_s=120.0)
+        assert out.get("ok")
+        for i, h in enumerate(hs):
+            assert h.wait(300), f"request {i} stuck through drain"
+            assert h.outcome == "completed", (i, h.outcome, h.detail)
+        assert rep0.state == "dead"          # drained, then retired
+        assert rep0.state_detail == "drained and retired"
+        for e in engines:
+            assert e.report()["finished"]["evicted"] == 0, \
+                "graceful drain must not evict"
+        # anything r0 handed back was re-routed and counted as a
+        # drain failover (the drain may also land between requests,
+        # in which case nothing needed to move — both are loss-free)
+        snap = r.snapshot()
+        handed = len(out.get("handed_back") or [])
+        assert snap["failovers"]["drain"] >= (1 if handed else 0)
+        late = r.submit(np.ones(4, np.int32), 4)
+        assert late.wait(300) and late.outcome == "completed"
+        assert late.replica == "r1"
+    finally:
+        r.stop()
+        rt.reset()
+        for c in ctls:
+            c.stop()
+        for e in engines:
+            e.stop()

@@ -55,8 +55,11 @@ LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_latency", "_bytes",
                          "_p50", "_p99")
 #: name substrings that mark a latency metric regardless of unit — the
 #: serving bench's TTFT records must trip the gate even when a round
-#: wrote them unit-less
-LOWER_BETTER_SUBSTRINGS = ("ttft",)
+#: wrote them unit-less; `dropped`/`lost`/`failover` are the router
+#: harness's loss-and-disruption counts (SERVE_rNN's
+#: router_lost_requests / router_failover_requests), where any rise —
+#: including zero-to-nonzero — is the regression
+LOWER_BETTER_SUBSTRINGS = ("ttft", "dropped", "lost", "failover")
 #: name substrings that mark a higher-is-better metric even when a
 #: lower-better suffix would otherwise match — SLO attainment records
 #: end in `_pct` (and the percentile suffixes), but a DROP in
