@@ -648,6 +648,10 @@ def serve_main(args):
     # would silently score only the tail of a bigger workload
     tracker = slo.SLOTracker(slo_cfg,
                              capacity=max(4096, 2 * n_req)).install()
+    # tail attribution rides the same terminal-request stream: the
+    # engine arm's record reports which LATENCY_ATTR bucket owned the
+    # measured p99 (the /tailz view, folded into BENCHDEC)
+    slo.install_tail()
     _t0, handles = replay(
         lambda i: eng.submit(prompts[i], int(new_lens[i])))
     stuck = [h.id for _, h in handles if not h.wait(600)]
@@ -669,6 +673,12 @@ def serve_main(args):
     eng_verdict = tracker.evaluate()
     eng_slo = _slo_fields(eng_verdict["objectives"], slo_cfg)
     eng_slo["slo_breaching"] = eng_verdict["breaching"]
+    eng_tail = slo.tail_summary()
+    if eng_tail["requests"]:
+        eng_slo["tail_top_bucket"] = eng_tail["top"]
+        top = eng_tail["buckets"].get(eng_tail["top"]) or {}
+        eng_slo["tail_top_p99_contrib_s"] = top.get("p99_s")
+        eng_slo["tail_attributed_requests"] = eng_tail["requests"]
     slo.reset()
 
     # ---- arm 2: static batching over the same schedule ------------------

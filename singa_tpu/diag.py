@@ -94,6 +94,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "/fleetz": self._fleetz,
                 "/fleetz/trace": self._fleetz_trace,
                 "/routerz": self._routerz,
+                "/tailz": self._tailz,
                 "/memz": self._memz,
                 "/slo": self._sloz,
                 "/stackz": self._stackz,
@@ -120,7 +121,11 @@ class _Handler(BaseHTTPRequestHandler):
             "  /fleetz       aggregated per-host fleet status (text)\n"
             "  /fleetz/trace merged Perfetto/Chrome trace (JSON)\n"
             "  /routerz      serving control plane: replica states, "
-            "shed/failover/retry counters (text)\n"
+            "shed/failover/retry counters + recent request "
+            "timelines; ?json=1 for the structured form\n"
+            "  /tailz        tail-latency attribution: p99 "
+            "contribution per LATENCY_ATTR bucket; ?json=1 for "
+            "the structured form\n"
             "  /memz         live device-memory ledger breakdown; "
             "?json=1 for the timeline JSON\n"
             "  /slo          serving SLO attainment + error-budget "
@@ -237,12 +242,30 @@ class _Handler(BaseHTTPRequestHandler):
     def _routerz(self, q):
         """The serving control plane: per-replica state
         (live/draining/dead), router queue depth, shed/failover/retry
-        counters — served from the process's installed router.Router
-        (singa_tpu.router)."""
+        counters, and a bounded tail of recent request timelines —
+        served from the process's installed router.Router
+        (singa_tpu.router). `?json=1` returns the snapshot plus the
+        per-request timelines (trace ids, hop marks, attribution)."""
         from . import router
-        self._send(router.router_report() + "\n",
-                   status=200 if router.get_router() is not None
-                   else 503)
+        status = 200 if router.get_router() is not None else 503
+        if (q.get("json") or ["0"])[0] not in ("0", "", "false"):
+            self._send_json(router.router_json(), status=status)
+            return
+        self._send(router.router_report() + "\n", status=status)
+
+    def _tailz(self, q):
+        """Tail-latency attribution: every terminal request's wall
+        time decomposed into slo.LATENCY_ATTR buckets, aggregated as
+        each bucket's p99 CONTRIBUTION to the fleet tail — the
+        one-page answer to "where did the p99 go". `?json=1` returns
+        the summary plus a bounded tail of per-request records. 503
+        until any request has been attributed."""
+        from . import slo
+        status = 200 if slo.tail_records() else 503
+        if (q.get("json") or ["0"])[0] not in ("0", "", "false"):
+            self._send_json(slo.tail_json(), status=status)
+            return
+        self._send(slo.tail_report() + "\n", status=status)
 
     def _fleetz_trace(self, q):
         """The merged Perfetto/Chrome trace (Trace Event Format JSON,

@@ -879,11 +879,17 @@ class FleetAggregator:
                                "pid": w.pid, "tid": 0,
                                "args": {"sort_index": i}})
                 off = w.clock_offset
+                startup_tids = set()
                 for rec in w.spans.values():
                     t0 = rec.get("t0")
                     dur = rec.get("dur")
                     if t0 is None or dur is None:
                         continue
+                    if (rec.get("span_kind") or "span") == "startup":
+                        # the replica cold-start observatory's phase
+                        # slices ride the span ring on a synthetic tid
+                        # — name the track once below
+                        startup_tids.add(int(rec.get("tid") or 0))
                     events.append({
                         "name": (rec.get("name") or "?"
                                  ).rsplit("/", 1)[-1],
@@ -896,6 +902,10 @@ class FleetAggregator:
                         "args": {"path": rec.get("name"),
                                  "host": w.host},
                     })
+                for tid in sorted(startup_tids):
+                    events.append({"ph": "M", "name": "thread_name",
+                                   "pid": w.pid, "tid": tid,
+                                   "args": {"name": "startup"}})
                 if isinstance(w.serve, dict):
                     # the request-level serving view: per-request
                     # queued/prefill/decode spans + decode-step slices
@@ -911,13 +921,27 @@ class FleetAggregator:
                         (rec.get("name") or "").rsplit("/", 1)[-1]
                         == "serving.engine_step"
                         for rec in w.spans.values())
-                    timelines = w.serve.get("timelines") or []
+                    # finished timelines PLUS the in-flight ones the
+                    # shard carried at publish: a replica SIGKILLed
+                    # mid-request leaves its partial work (the victim
+                    # track of a failover trace) in `active`
+                    timelines = list(w.serve.get("timelines") or [])
+                    timelines.extend(w.serve.get("active") or [])
                     syncs = w.serve.get("syncs") or []
                     events.extend(slo._track_metadata(
                         timelines, syncs, w.pid))
                     events.extend(slo.request_trace_events(
                         timelines, syncs, w.pid, offset=off,
                         emit_sync_slices=not have_step_spans))
+        # the router's own track (queue + dispatch hops + the
+        # cross-process trace_ctx flow ends), when this process IS the
+        # routing coordinator — replicas join the flow by trace id
+        try:
+            from . import router as router_mod
+            if router_mod.get_router() is not None:
+                events.extend(router_mod.router_trace_events())
+        except Exception:
+            pass
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def export_trace(self, path: str) -> str:

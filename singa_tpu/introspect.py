@@ -294,6 +294,24 @@ def _observe_phase(phase, key, seconds):
         ).observe(seconds, phase=phase, key=key)
 
 
+def compile_phase_totals() -> dict:
+    """{phase: total wall seconds} accumulated so far in
+    singa_compile_phase_seconds, summed across build keys — the
+    replica cold-start observatory diffs two samples of this to know
+    how much of a startup window went to trace/lower/compile (vs the
+    python-side model build around them). Zeros before any build (or
+    with observe disabled)."""
+    out = {p: 0.0 for p in COMPILE_PHASES}
+    h = observe.get_registry().get("singa_compile_phase_seconds")
+    if h is None:
+        return out
+    for row in h.snapshot():
+        ph = (row.get("labels") or {}).get("phase")
+        if ph in out:
+            out[ph] += float(row.get("sum") or 0.0)
+    return out
+
+
 def _set_hbm_gauges(mem, key):
     # spelled out (no loop over a name table) so the static metric-name
     # lint sees every registration
@@ -820,6 +838,7 @@ __all__ = [
     "signature", "blame", "build_compiled", "AotExecutor",
     "note_step_flops",
     "capture_hlo", "executable_manifest", "last_build", "blame_history",
+    "compile_phase_totals",
     "explain", "format_explain", "reset", "main",
 ]
 

@@ -570,6 +570,23 @@ def _record_span_entry(name, t0, dur, kind="span"):
                      "tid": threading.get_ident(), "kind": kind})
 
 
+def note_span(name, t0, dur, kind="span", tid=None):
+    """Append one SYNTHETIC finished-span record to the span ring — a
+    region measured by other means (wall stamps across a process
+    startup, a reconstructed phase) that should ride the same
+    shard -> merged-trace pipeline as `span()` regions. `t0` is a
+    perf_counter stamp (the clock the fleet handshake aligns); `tid`
+    places the slice on a chosen track (default: the calling thread).
+    No-op while the ring is off, like every span exit."""
+    ring = _span_records
+    if ring is not None:
+        ring.append({"name": str(name), "t0": round(float(t0), 7),
+                     "dur": round(max(0.0, float(dur)), 7),
+                     "tid": int(tid) if tid is not None
+                     else threading.get_ident(),
+                     "kind": str(kind)})
+
+
 def current_span() -> "str | None":
     stack = getattr(_tls, "span_stack", None)
     return stack[-1] if stack else None
@@ -941,7 +958,7 @@ __all__ = [
     "add_step_listener", "remove_step_listener",
     "start_diag_server",
     "enable_span_records", "disable_span_records", "span_records",
-    "span_records_enabled",
+    "span_records_enabled", "note_span",
     "record_step", "record_step_build", "record_step_fenced",
     "record_compile", "record_hbm", "record_opt_update", "record_comm",
     "record_comm_host",

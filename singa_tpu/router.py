@@ -98,6 +98,37 @@ STATE_DEAD = "dead"
 #: passed through to the caller as a terminal rejection
 RETRYABLE_DETAILS = ("queue full", "not running", "draining")
 
+#: the replica cold-start phases, in lifecycle order — the `phase=`
+#: label on singa_replica_startup_seconds (lint rule 5). spawn =
+#: fork-to-process-entry, import = the singa/jax stack, build = model
+#: construction + engine start MINUS the XLA compile phases (trace/
+#: lower/compile, introspect's compile-phase telemetry diffed across
+#: the window), warm = bucket warmup minus ITS compile share, ready =
+#: post-warm wiring (tracker/shard writer/diag/control surface) up to
+#: the ready announcement
+STARTUP_PHASES = ("spawn", "import", "build", "trace", "lower",
+                  "compile", "warm", "ready")
+
+#: synthetic tid for the startup-phase slices in the merged trace —
+#: same far-above-real-idents convention as slo.QUEUE_TID
+STARTUP_TID = 800_000
+
+#: synthetic tids for the router's own trace track
+ROUTER_QUEUE_TID = 910_000
+ROUTER_DISPATCH_TID = 910_001
+
+
+def _observe_startup(phase: str, seconds: float):
+    """One cold-start phase duration into the startup histogram (the
+    observatory's metric surface; the span ring carries the trace
+    slices separately)."""
+    assert phase in STARTUP_PHASES, phase
+    observe.histogram(
+        "singa_replica_startup_seconds",
+        "replica cold-start wall seconds per startup phase "
+        "(spawn/import/build/trace/lower/compile/warm/ready)").observe(
+        max(0.0, float(seconds)), phase=phase)
+
 _metrics_cache = None
 
 
@@ -163,13 +194,17 @@ class RouterRequest:
 
     __slots__ = ("id", "prompt", "max_new", "submitted", "finished_ts",
                  "outcome", "reason", "detail", "tokens", "replica",
-                 "attempts", "ttft_s", "events", "_done")
+                 "attempts", "ttft_s", "events", "trace",
+                 "replica_attr", "attr", "_done")
 
     def __init__(self, rid: int, prompt, max_new: int):
         self.id = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new = int(max_new)
-        self.submitted = time.monotonic()
+        # perf_counter, NOT monotonic: these stamps feed the merged
+        # trace, and perf_counter is the clock the fleet (epoch, perf)
+        # handshake aligns across processes
+        self.submitted = time.perf_counter()
         self.finished_ts = None
         self.outcome = None     # member of ROUTE_OUTCOMES when terminal
         self.reason = None      # member of ROUTE_REASONS when router-minted
@@ -179,10 +214,13 @@ class RouterRequest:
         self.attempts = 0
         self.ttft_s = None      # router-side: submit -> first token
         self.events: "list[tuple]" = []
+        self.trace = None        # fleet-unique trace-context id
+        self.replica_attr = None  # winning replica's LATENCY_ATTR split
+        self.attr = None          # full route decomposition at terminal
         self._done = threading.Event()
 
     def mark(self, event: str, **info):
-        self.events.append((event, round(time.monotonic(), 7), info))
+        self.events.append((event, round(time.perf_counter(), 7), info))
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -271,6 +309,9 @@ class Router:
         self._reasons = {r: 0 for r in ROUTE_REASONS}
         self._failovers = {REASON_REPLICA_DEAD: 0, REASON_DRAIN: 0}
         self._retries = 0
+        # finished routed-request timelines (trace id, hop events,
+        # LATENCY_ATTR decomposition) — the /routerz?json=1 surface
+        self._timelines: "deque[dict]" = deque(maxlen=256)
         # balance on the installed aggregator when there is one (the
         # --ab coordinator installs it so /fleetz works too); otherwise
         # a private one over fleet_dir, polled from the health loop
@@ -433,6 +474,11 @@ class Router:
         with self._lock:
             self._rid += 1
             req = RouterRequest(self._rid, prompt, max_new)
+            # the fleet-unique trace context, minted at the front door:
+            # pid-scoped so two routers (tests, a restart) never
+            # collide, carried through every dispatch into the winning
+            # replica's engine timeline
+            req.trace = f"t{os.getpid():x}-{req.id}"
             if self._stopping:
                 shed_reason, detail = REASON_DRAIN, "router stopped"
             elif len(self._queue) >= self.queue_limit:
@@ -457,6 +503,7 @@ class Router:
                 reason=None, detail=None, replica=None):
         assert outcome in ROUTE_OUTCOMES, outcome
         assert reason is None or reason in ROUTE_REASONS, reason
+        from . import slo
         with self._lock:
             if req.outcome is not None:
                 return
@@ -466,12 +513,35 @@ class Router:
             req.replica = replica
             if tokens is not None:
                 req.tokens = [int(t) for t in tokens]
-            req.finished_ts = time.monotonic()
+            req.finished_ts = time.perf_counter()
             req.mark("terminal", outcome=outcome, reason=reason)
             self._terminal[outcome] += 1
             if reason is not None:
                 self._reasons[reason] += 1
             self._pending.pop(req.id, None)
+        # the tail-latency decomposition: pure math over the hop marks
+        # (+ the winning replica's own engine-side split), summing to
+        # the request's total wall time — computed OUTSIDE the lock
+        # (the request is terminal, its events are stable)
+        req.attr = slo.attribute_route(
+            req.submitted, req.finished_ts, list(req.events),
+            replica_attr=req.replica_attr)
+        total_s = round(req.finished_ts - req.submitted, 6)
+        tlrec = {
+            "id": req.id, "trace": req.trace, "outcome": outcome,
+            "reason": reason, "detail": detail, "replica": replica,
+            "attempts": req.attempts, "ttft_s": req.ttft_s,
+            "submitted": round(req.submitted, 7),
+            "finished": round(req.finished_ts, 7),
+            "total_s": total_s, "attr": req.attr,
+            "events": [(e, round(float(t), 7), i)
+                       for e, t, i in list(req.events)],
+        }
+        with self._lock:
+            self._timelines.append(tlrec)
+        slo.note_attribution({"id": req.id, "outcome": outcome,
+                              "trace": req.trace, "total_s": total_s,
+                              "attr": req.attr})
         if observe.is_enabled():
             m = _metrics()
             m["requests"].inc(outcome=outcome)
@@ -568,42 +638,56 @@ class Router:
         ("transport", "requeued", "retryable_reject")."""
         payload = {"rid": req.id,
                    "prompt": [int(t) for t in req.prompt],
-                   "max_new": req.max_new, "wait_s": self.poll_wait_s}
+                   "max_new": req.max_new, "wait_s": self.poll_wait_s,
+                   "trace": req.trace}
         path = "/submit"
+        # once a poll round returned "pending" the replica had ACCEPTED
+        # the work (an engine request exists, tokens may be flowing) —
+        # a later failure is a REPLAY of accepted work, not a dispatch
+        # that never started; the tail attribution books the two
+        # differently (failover_replay vs dispatch_retry)
+        accepted = False
         while True:
             if self._stop_evt.is_set():
                 return {"outcome": "error", "cause": "transport",
-                        "detail": "router stopping"}
+                        "detail": "router stopping",
+                        "pending": accepted}
             if rep.state == STATE_DEAD:
                 return {"outcome": "error", "cause": "transport",
-                        "detail": "replica marked dead"}
+                        "detail": "replica marked dead",
+                        "pending": accepted}
             try:
                 out = _http_json(rep.ctl_url + path, payload,
                                  timeout=self.poll_wait_s + 10.0)
             except Exception as e:
                 return {"outcome": "error", "cause": "transport",
-                        "detail": f"{type(e).__name__}: {e}"}
+                        "detail": f"{type(e).__name__}: {e}",
+                        "pending": accepted}
             st = out.get("outcome")
             if st == "pending":
                 # bounded poll rounds keep every sender interruptible:
                 # no thread ever blocks longer than one wait_s window
                 path = "/submit"
                 payload["resume"] = True
+                accepted = True
                 continue
             if st in ("requeued", "unknown"):
                 return {"outcome": "error", "cause": "requeued",
                         "detail": "handed back by drain"
                         if st == "requeued"
-                        else "replica lost request state"}
+                        else "replica lost request state",
+                        "pending": accepted}
             if st == "rejected" and out.get("retryable"):
                 return {"outcome": "error",
                         "cause": "retryable_reject",
-                        "detail": out.get("detail")}
+                        "detail": out.get("detail"),
+                        "pending": accepted}
             if st == "evicted":
                 # the replica engine's crash path drained it — the
                 # request is safe to resubmit (greedy determinism)
                 return {"outcome": "error", "cause": "transport",
-                        "detail": out.get("detail") or "evicted"}
+                        "detail": out.get("detail") or "evicted",
+                        "pending": accepted}
             if st == "timeout":
                 return {"outcome": "rejected", "retryable": False,
                         "detail": out.get("detail")
@@ -642,7 +726,7 @@ class Router:
                 self._retries += 1
                 if observe.is_enabled():
                     _metrics()["retries"].inc()
-            dispatch_ts = time.monotonic()
+            dispatch_ts = time.perf_counter()
             req.mark("dispatch", replica=rep.name,
                      attempt=req.attempts)
             with self._lock:
@@ -664,6 +748,7 @@ class Router:
                     # final replica's own submit->first-token time
                     req.ttft_s = (dispatch_ts - req.submitted
                                   + float(out["ttft_s"]))
+                req.replica_attr = out.get("attr")
                 return self._finish(req, OUTCOME_COMPLETED,
                                     tokens=out.get("tokens") or [],
                                     replica=rep.name)
@@ -672,16 +757,25 @@ class Router:
                                     detail=out.get("detail"),
                                     replica=rep.name)
             cause = out.get("cause")
-            req.mark("failover", replica=rep.name, cause=cause,
-                     detail=out.get("detail"))
+            probe_s = 0.0
             if cause == "transport":
                 # SIGKILL shows up here first (connection reset long
                 # before the shard goes stale): confirm with a probe so
                 # failover is prompt, not a liveness-deadline later
-                if rep.state == STATE_LIVE and not self._probe(rep):
-                    self.mark_dead(
-                        rep, f"dispatch failed ({out.get('detail')}) "
-                             "and /healthz probe failed")
+                if rep.state == STATE_LIVE:
+                    p0 = time.perf_counter()
+                    alive = self._probe(rep)
+                    probe_s = time.perf_counter() - p0
+                    if not alive:
+                        self.mark_dead(
+                            rep,
+                            f"dispatch failed ({out.get('detail')}) "
+                            "and /healthz probe failed")
+            req.mark("failover", replica=rep.name, cause=cause,
+                     detail=out.get("detail"),
+                     probe_s=round(probe_s, 7),
+                     pending=bool(out.get("pending")))
+            if cause == "transport":
                 if rep.state == STATE_DEAD:
                     with self._lock:
                         self._failovers[REASON_REPLICA_DEAD] += 1
@@ -774,6 +868,13 @@ class Router:
                                       replica=rep.name)
         m["replicas_live"].set(float(live))
         m["queue_depth"].set(float(qd))
+
+    def request_timelines(self) -> "list[dict]":
+        """Locked copy of the bounded terminal-request timeline ring
+        (newest last). Diag threads read this while the dispatch loop
+        appends — the copy-under-lock keeps them from racing."""
+        with self._lock:
+            return [dict(t) for t in self._timelines]
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -897,12 +998,120 @@ def fleetz_lines() -> "list[str]":
 
 
 def router_report() -> str:
-    """Text block for /routerz."""
+    """Text block for /routerz: the fleetz table plus a bounded tail
+    of recent terminal requests (id / outcome / hops / wall / top
+    latency bucket) read via the locked timeline copy."""
     lines = fleetz_lines()
     if not lines:
         return ("no Router installed "
                 "(singa_tpu.router.Router(...).start())")
+    r = get_router()
+    recent = r.request_timelines()[-8:] if r is not None else []
+    if recent:
+        lines.append("recent requests:")
+        for tl in recent:
+            attr = tl.get("attr") or {}
+            top = max(attr.items(), key=lambda kv: kv[1],
+                      default=(None, 0.0))
+            where = tl.get("replica") or tl.get("reason") or "-"
+            lines.append(
+                f"  req {tl['id']} [{tl.get('trace')}] "
+                f"{tl['outcome']} via {where}, "
+                f"{tl['attempts']} attempt(s), "
+                f"{tl['total_s']:.4f}s"
+                + (f", top {top[0]} {top[1]:.4f}s"
+                   if top[0] is not None else ""))
     return "\n".join(lines)
+
+
+def router_json() -> dict:
+    """JSON body for /routerz?json=1: the snapshot plus a bounded tail
+    of terminal request timelines (trace id, hop marks, attribution)."""
+    r = get_router()
+    if r is None:
+        return {"installed": False}
+    return {"installed": True, "snapshot": r.snapshot(),
+            "requests": r.request_timelines()[-64:]}
+
+
+def router_trace_events() -> "list[dict]":
+    """Chrome-trace events for the router's own track in the merged
+    fleet trace: a synthetic "router" process (sorted above the
+    replicas) with a queue thread and a dispatch thread, one X slice
+    per request's queue wait, one per dispatch hop, and the trace_ctx
+    flow "s"/"f" endpoints that stitch each request to the winning
+    replica's engine slices. Perf-counter stamps map to wall time via
+    this process's own clock offset — the same pairing the replica
+    shard headers use, so the tracks align."""
+    r = get_router()
+    if r is None:
+        return []
+    from .slo import TRACE_CTX_CAT
+    pid = os.getpid()
+    off = time.time() - time.perf_counter()
+
+    def us(t_perf):
+        return (float(t_perf) + off) * 1e6
+
+    events: "list[dict]" = [
+        {"ph": "M", "name": "process_name", "pid": pid,
+         "args": {"name": f"router (pid {pid})"}},
+        {"ph": "M", "name": "process_sort_index", "pid": pid,
+         "args": {"sort_index": -1}},
+        {"ph": "M", "name": "thread_name", "pid": pid,
+         "tid": ROUTER_QUEUE_TID, "args": {"name": "router queue"}},
+        {"ph": "M", "name": "thread_name", "pid": pid,
+         "tid": ROUTER_DISPATCH_TID,
+         "args": {"name": "router dispatch"}},
+    ]
+    for tl in r.request_timelines():
+        rid = tl["id"]
+        sub = float(tl["submitted"])
+        fin = float(tl["finished"])
+        evs = [(e, float(t), i) for e, t, i in tl.get("events") or []]
+        dispatches = [(t, i) for e, t, i in evs if e == "dispatch"]
+        failovers = [(t, i) for e, t, i in evs if e == "failover"]
+        q_end = dispatches[0][0] if dispatches else fin
+        events.append({
+            "ph": "X", "cat": "route", "name": f"req {rid} queued",
+            "ts": us(sub), "dur": max(0.0, (q_end - sub) * 1e6),
+            "pid": pid, "tid": ROUTER_QUEUE_TID,
+            "args": {"trace": tl.get("trace"),
+                     "outcome": tl["outcome"],
+                     "reason": tl.get("reason")}})
+        for k, (t_d, info) in enumerate(dispatches):
+            end = dispatches[k + 1][0] if k + 1 < len(dispatches) \
+                else fin
+            args = {"trace": tl.get("trace"),
+                    "replica": info.get("replica"),
+                    "attempt": info.get("attempt")}
+            if k < len(failovers):
+                args["cause"] = failovers[k][1].get("cause")
+            else:
+                args["outcome"] = tl["outcome"]
+                args["reason"] = tl.get("reason")
+            events.append({
+                "ph": "X", "cat": "route",
+                "name": f"req {rid} hop {k + 1} -> "
+                        f"{info.get('replica')}",
+                "ts": us(t_d), "dur": max(0.0, (end - t_d) * 1e6),
+                "pid": pid, "tid": ROUTER_DISPATCH_TID, "args": args})
+        if dispatches and tl.get("trace") and fin > q_end:
+            # flow start just inside the first hop slice, finish just
+            # inside the last hop slice: the winning replica's binding
+            # step (admitted AFTER dispatch, bound BEFORE the router
+            # saw the terminal outcome) lands strictly between them
+            eps = min(1e-6, (fin - q_end) / 4.0)
+            events.append({
+                "ph": "s", "cat": TRACE_CTX_CAT, "name": "trace",
+                "id": str(tl["trace"]), "ts": us(q_end + eps),
+                "pid": pid, "tid": ROUTER_DISPATCH_TID})
+            events.append({
+                "ph": "f", "cat": TRACE_CTX_CAT, "name": "trace",
+                "id": str(tl["trace"]), "bp": "e",
+                "ts": us(fin - eps),
+                "pid": pid, "tid": ROUTER_DISPATCH_TID})
+    return events
 
 
 # ---- the replica process ----------------------------------------------------
@@ -988,11 +1197,30 @@ class ReplicaControl:
             if self.draining:
                 return {"outcome": "rejected", "retryable": True,
                         "detail": "replica draining"}
-            req = self.eng.submit(
-                np.asarray(body["prompt"], np.int32),
-                int(body["max_new"]))
+            try:
+                req = self.eng.submit(
+                    np.asarray(body["prompt"], np.int32),
+                    int(body["max_new"]),
+                    trace_ctx=body.get("trace"))
+            except TypeError:
+                # test stubs model a 2-arg submit; the trace id is
+                # merely lost, not load-bearing
+                req = self.eng.submit(
+                    np.asarray(body["prompt"], np.int32),
+                    int(body["max_new"]))
             with self._lock:
                 self._reqs[rid] = req
+            # push the in-flight timeline to disk NOW: if the router
+            # SIGKILLs this replica mid-request, the merged trace still
+            # shows the victim's partial track (shard files outlive
+            # the process)
+            try:
+                from . import fleet
+                w = fleet.get_shard_writer()
+                if w is not None:
+                    w.publish()
+            except Exception:
+                pass
         deadline = time.monotonic() + wait_s
         while req.outcome is None and time.monotonic() < deadline:
             with self._lock:
@@ -1014,6 +1242,14 @@ class ReplicaControl:
         if req.outcome == "completed":
             out["tokens"] = [int(t) for t in req.tokens]
             out["ttft_s"] = req.ttft_s
+            try:
+                from . import slo
+                evs = list(getattr(req, "events", []) or [])
+                if evs:
+                    out["attr"] = slo.attribute_timeline(
+                        {"events": evs})
+            except Exception:
+                pass
         elif req.outcome == "rejected":
             out["retryable"] = any(
                 s in (req.detail or "") for s in RETRYABLE_DETAILS)
@@ -1058,31 +1294,102 @@ def _build_replica_model(vocab: int, dim: int, layers: int,
 
 def _replica_main(args) -> int:
     """One serving replica: engine + fleet shard writer + diag server +
-    the control surface, announced on stdout as a JSON "ready" line."""
-    from . import diag, engine, fleet, slo
+    the control surface, announced on stdout as a JSON "ready" line.
+
+    The cold-start observatory stamps every startup phase
+    (STARTUP_PHASES: spawn -> import -> build -> trace -> lower ->
+    compile -> warm -> ready) into `singa_replica_startup_seconds`,
+    notes a span per phase on the STARTUP_TID track (the merged fleet
+    trace renders them as a "startup" thread), and reports the
+    breakdown — plus spawn-to-first-token — in the ready line. The
+    trace/lower/compile splits come from diffing introspect's
+    `compile_phase_totals()` around the build and warm windows, so
+    build/warm report pure non-XLA wall time."""
+    t_entry = time.time()
+    t0 = time.time()
+    from . import diag, engine, fleet, introspect, resilience, slo
+    startup = {"import": time.time() - t0}
+    spawned_at = getattr(args, "spawned_at", None)
+    if spawned_at is not None:
+        startup["spawn"] = max(0.0, t_entry - float(spawned_at))
+    observe.enable(True)
+    observe.enable_span_records()
     T = args.prompt_hi + args.new_hi
+    c0 = introspect.compile_phase_totals()
+    t0 = time.time()
     m = _build_replica_model(args.vocab, args.dim, args.layers, T)
     eng = engine.ServingEngine(
         m, max_slots=args.slots, page_size=args.page_size, max_ctx=T,
         queue_limit=max(128, 8 * args.slots),
         steps_per_sync=2).start()
+    build_wall = time.time() - t0
+    c1 = introspect.compile_phase_totals()
     # warm every prompt bucket the workload can hit (plus the decode
     # executable) BEFORE announcing ready: the router's p99 TTFT must
     # measure serving, not XLA compiles
+    t0 = time.time()
+    first_token_wall = None
     for b in sorted({eng._bucket(s)
                      for s in (args.prompt_lo, args.prompt_hi)}):
         w = eng.submit(np.zeros(min(b, T - 2), np.int32) + 1, 2)
         if not w.wait(600):
             raise RuntimeError(f"replica warmup (bucket {b}) stalled")
+        if first_token_wall is None \
+                and w.first_token_ts is not None:
+            # engine stamps are monotonic; shift onto the wall clock
+            first_token_wall = float(w.first_token_ts) \
+                + (time.time() - time.monotonic())
+    warm_wall = time.time() - t0
+    c2 = introspect.compile_phase_totals()
+    build_xla = sum(max(0.0, c1[p] - c0[p])
+                    for p in introspect.COMPILE_PHASES)
+    warm_xla = sum(max(0.0, c2[p] - c1[p])
+                   for p in introspect.COMPILE_PHASES)
+    for p in introspect.COMPILE_PHASES:
+        startup[p] = max(0.0, c2[p] - c0[p])
+    startup["build"] = max(0.0, build_wall - build_xla)
+    startup["warm"] = max(0.0, warm_wall - warm_xla)
+    t0 = time.time()
     tracker = slo.SLOTracker(slo.SLOConfig(), capacity=8192).install()
     assert tracker is not None
+    slo.install_tail()
+    if getattr(args, "fault_delay", 0.0):
+        # the --ab fault arm: a fixed per-engine-step stall makes
+        # decode the provably dominant tail bucket on /tailz
+        resilience.install_fault_plan(resilience.FaultPlan().delay(
+            "serving.engine_step", float(args.fault_delay),
+            times=10 ** 9))
     fleet.start_shard_writer(args.fleet_dir,
                              interval_s=args.publish_interval)
     dsrv = diag.start_diag_server(port=0)
     ctl = ReplicaControl(eng)
-    print(json.dumps({
+    startup["ready"] = time.time() - t0
+    for p in STARTUP_PHASES:
+        if p in startup:
+            _observe_startup(p, startup[p])
+    # the startup track: phases laid out back-to-back from the spawn
+    # stamp on a dedicated tid (real wall placement would overlap —
+    # compile time is interleaved with build/warm — so the track reads
+    # as a clean waterfall whose slices sum to the startup wall)
+    off = time.time() - time.perf_counter()
+    cursor = (float(spawned_at) if spawned_at is not None
+              else t_entry - startup["import"]) - off
+    for p in STARTUP_PHASES:
+        dur = startup.get(p)
+        if not dur:
+            continue
+        observe.note_span(f"startup.{p}", cursor, dur,
+                          kind="startup", tid=STARTUP_TID)
+        cursor += dur
+    ready = {
         "event": "ready", "name": args.name, "pid": os.getpid(),
-        "ctl_port": ctl.port, "diag_port": dsrv.port}), flush=True)
+        "ctl_port": ctl.port, "diag_port": dsrv.port,
+        "startup": {p: round(startup[p], 6) for p in STARTUP_PHASES
+                    if p in startup}}
+    if spawned_at is not None and first_token_wall is not None:
+        ready["spawn_to_first_token_s"] = round(
+            first_token_wall - float(spawned_at), 6)
+    print(json.dumps(ready), flush=True)
     try:
         while not ctl.shutdown_evt.wait(0.2):
             pass
@@ -1092,6 +1399,7 @@ def _replica_main(args) -> int:
     eng.stop()
     fleet.uninstall()
     diag.stop_diag_server()
+    resilience.clear_fault_plan()
     slo.reset()
     print(json.dumps({"event": "exit", "name": args.name, "ok": True}),
           flush=True)
@@ -1118,7 +1426,10 @@ def spawn_replica(name: str, fleet_dir: str, args, *,
            "--new-hi", str(args.new_hi),
            "--slots", str(args.slots),
            "--page-size", str(args.page_size),
-           "--publish-interval", str(args.publish_interval)]
+           "--publish-interval", str(args.publish_interval),
+           "--spawned-at", f"{time.time():.6f}"]
+    if getattr(args, "fault_delay", 0.0):
+        cmd += ["--fault-delay", str(args.fault_delay)]
     proc = subprocess.Popen(cmd, cwd=root, env=env,
                             stdout=subprocess.PIPE, stderr=sys.stderr,
                             text=True)
@@ -1160,17 +1471,26 @@ def spawn_replica(name: str, fleet_dir: str, args, *,
 
 # ---- the kill-and-replace A/B harness ---------------------------------------
 
-def _ab_arm(args, workdir: str, *, kill: bool) -> dict:
+def _ab_arm(args, workdir: str, *, kill: bool,
+            fault_delay: float = 0.0) -> dict:
     """One harness arm: N replicas under the seeded Poisson workload.
     With `kill`, SIGKILL one replica mid-traffic and join a (pre-warmed)
-    standby in its place. Returns per-request outcomes/tokens plus the
-    router's counters — the caller does the cross-arm asserts."""
-    from . import diag, fleet, serving
+    standby in its place; with `fault_delay`, every replica stalls each
+    engine step by that much (the tail-attribution probe). Returns
+    per-request outcomes/tokens, the router's counters, the tail
+    summary + per-request attribution sums, each replica's cold-start
+    breakdown, and (kill arm) the merged-trace flow checks — the
+    caller does the cross-arm asserts."""
+    from types import SimpleNamespace
+
+    from . import diag, fleet, serving, slo
     fleet_dir = os.path.join(workdir, "spool")
     os.makedirs(fleet_dir, exist_ok=True)
-    fleet.install_aggregator(fleet_dir, stale_after_s=60.0,
-                             poll_interval_s=0.05)
+    agg = fleet.install_aggregator(fleet_dir, stale_after_s=60.0,
+                                   poll_interval_s=0.05)
     diag.start_diag_server(port=0)
+    spawn_args = SimpleNamespace(**vars(args))
+    spawn_args.fault_delay = fault_delay
     r = Router(fleet_dir=fleet_dir,
                queue_limit=max(64, 4 * args.requests),
                max_attempts=8, retry_base_s=0.05, retry_max_s=1.0,
@@ -1187,7 +1507,7 @@ def _ab_arm(args, workdir: str, *, kill: bool) -> dict:
 
         def _spawn_one(n):
             try:
-                spawned[n] = spawn_replica(n, fleet_dir, args)
+                spawned[n] = spawn_replica(n, fleet_dir, spawn_args)
             except Exception as e:  # surfaced after the join below
                 errs[n] = e
 
@@ -1236,7 +1556,27 @@ def _ab_arm(args, workdir: str, *, kill: bool) -> dict:
                 while time.perf_counter() < spin \
                         and not vrep.inflight:
                     time.sleep(0.001)
-                if not vrep.inflight and i < kill_at + 4 \
+                # ...and hold the trigger until the victim's ACCEPTED
+                # work has provably reached its shard file (the
+                # handle_submit force-publish): the merged trace's
+                # victim track only exists if the in-flight timeline
+                # hit disk before the SIGKILL. Bounded — a request
+                # that completes first just means a later arrival
+                # re-arms the trigger.
+                published = False
+                spin = time.perf_counter() \
+                    + 6.0 * args.publish_interval
+                while time.perf_counter() < spin and vrep.inflight:
+                    agg.poll()
+                    if any(w.host == victim
+                           and isinstance(w.serve, dict)
+                           and w.serve.get("active")
+                           for w in agg._workers.values()):
+                        published = True
+                        break
+                    time.sleep(0.005)
+                if not (vrep.inflight and published) \
+                        and i < kill_at + 8 \
                         and i < args.requests - 1:
                     continue
                 vrep.proc.kill()
@@ -1251,6 +1591,41 @@ def _ab_arm(args, workdir: str, *, kill: bool) -> dict:
         stuck = [h.id for h in handles if not h.wait(args.timeout)]
         snap = r.snapshot()
         fleetz = fleet.fleet_report()
+        arm["tail"] = slo.tail_summary()
+        # the wall-sum property, per terminal request: the LATENCY_ATTR
+        # buckets must reconstruct the request's total wall time
+        arm["attr_checks"] = [
+            {"id": h.id, "outcome": h.outcome,
+             "total_s": round(h.finished_ts - h.submitted, 6),
+             "attr_sum": round(sum((h.attr or {}).values()), 6)}
+            for h in handles if h.outcome is not None
+            and h.finished_ts is not None]
+        arm["startup"] = {n: ready.get("startup")
+                          for n, (_, ready) in spawned.items()}
+        arm["spawn_to_first_token_s"] = {
+            n: ready.get("spawn_to_first_token_s")
+            for n, (_, ready) in spawned.items()}
+        if kill:
+            # merged-trace flow check on a request that provably
+            # failed over FROM the victim and completed elsewhere:
+            # its trace_ctx flow must step through the router track
+            # AND both replica tracks (the victim's partial work
+            # survives in its last published shard)
+            time.sleep(3.0 * args.publish_interval)
+            agg.poll()
+            pick = None
+            for h in handles:
+                if h.outcome != OUTCOME_COMPLETED:
+                    continue
+                if victim in {i.get("replica")
+                              for e, _, i in h.events
+                              if e == "failover"}:
+                    pick = h
+                    break
+            arm["trace_checks"] = (
+                _check_merged_trace(agg.trace_events(), pick.trace,
+                                    os.getpid())
+                if pick is not None else None)
         arm.update({
             "stuck": stuck,
             "outcomes": {h.id: h.outcome for h in handles},
@@ -1285,16 +1660,76 @@ def _ab_arm(args, workdir: str, *, kill: bool) -> dict:
         reset()
         fleet.uninstall()
         diag.stop_diag_server()
+        slo.tail_reset()  # each arm's /tailz view stands alone
+
+
+def _check_merged_trace(trace: dict, trace_id, router_pid) -> dict:
+    """Schema + flow checks over a merged fleet trace for ONE routed
+    request's trace-context id: exactly one process_name per pid,
+    every per-replica req_flow id scoped to its own pid (no
+    cross-linked requests), and the trace_ctx flow for `trace_id`
+    stepping s (router) -> t (each replica that touched it) -> f
+    (router) in timestamp order across at least two replica pids."""
+    events = trace.get("traceEvents") or []
+    pname: "dict[int, int]" = {}
+    bad_scope = 0
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pname[e["pid"]] = pname.get(e["pid"], 0) + 1
+        if e.get("cat") == "req_flow" \
+                and e.get("ph") in ("s", "t", "f") \
+                and not str(e.get("id", "")).startswith(
+                    f"{e.get('pid')}:"):
+            bad_scope += 1
+    from .slo import TRACE_CTX_CAT
+    steps = [e for e in events
+             if e.get("cat") == TRACE_CTX_CAT
+             and str(e.get("id")) == str(trace_id)]
+    s_ev = [e for e in steps if e.get("ph") == "s"]
+    t_ev = [e for e in steps if e.get("ph") == "t"]
+    f_ev = [e for e in steps if e.get("ph") == "f"]
+    rep_pids = sorted({e["pid"] for e in t_ev
+                       if e["pid"] != router_pid})
+    ordered = bool(
+        len(s_ev) == 1 and len(f_ev) == 1 and t_ev
+        and all(s_ev[0]["ts"] < e["ts"] < f_ev[0]["ts"]
+                for e in t_ev))
+    out = {
+        "one_name_per_pid": bool(pname) and all(
+            v == 1 for v in pname.values()),
+        "req_flow_ids_pid_scoped": bad_scope == 0,
+        "router_anchors": len(s_ev) == 1 and len(f_ev) == 1
+        and all(e["pid"] == router_pid for e in s_ev + f_ev),
+        "replica_pids": rep_pids,
+        "spans_two_replicas": len(rep_pids) >= 2,
+        "flow_ordered": ordered,
+    }
+    out["ok"] = bool(
+        out["one_name_per_pid"] and out["req_flow_ids_pid_scoped"]
+        and out["router_anchors"] and out["spans_two_replicas"]
+        and out["flow_ordered"])
+    return out
 
 
 def _ab_main(args) -> int:
+    from types import SimpleNamespace
+
     from . import engine
     base = tempfile.mkdtemp(prefix="singa_router_ab_")
     rec = {"replicas": args.replicas, "requests": args.requests,
            "rps": args.rps, "seed": args.seed, "ok": False}
+    # the fault arm is a small third run: every replica stalls each
+    # engine step by --fault-delay, so /tailz must rank decode as the
+    # top p99 contributor — the attribution pipeline proven end to end
+    fault_args = SimpleNamespace(**vars(args))
+    fault_args.replicas = min(2, args.replicas)
+    fault_args.requests = min(8, args.requests)
     try:
         clean = _ab_arm(args, os.path.join(base, "clean"), kill=False)
         kill = _ab_arm(args, os.path.join(base, "kill"), kill=True)
+        fault = _ab_arm(fault_args, os.path.join(base, "fault"),
+                        kill=False,
+                        fault_delay=args.fault_delay or 0.05)
     finally:
         import shutil
         shutil.rmtree(base, ignore_errors=True)
@@ -1314,6 +1749,25 @@ def _ab_main(args) -> int:
     standby_served = f"r{args.replicas}" in kill["served_by"]
     p99_clean = engine.pctile(clean["ttfts"], 0.99)
     p99_kill = engine.pctile(kill["ttfts"], 0.99)
+    # per-request attribution must reconstruct each wall time within
+    # 10% (plus a small absolute floor for sub-ms rejects)
+    attr_ok = all(
+        abs(c["attr_sum"] - c["total_s"])
+        <= max(0.10 * c["total_s"], 0.005)
+        for arm in (clean, kill, fault)
+        for c in arm["attr_checks"])
+    attr_n = sum(len(arm["attr_checks"])
+                 for arm in (clean, kill, fault))
+    trace_checks = kill.get("trace_checks")
+    fault_top = (fault.get("tail") or {}).get("top")
+    decode_p99 = (((fault.get("tail") or {}).get("buckets") or {})
+                  .get("decode") or {}).get("p99_s")
+    cold_vals = [v for v in
+                 clean["spawn_to_first_token_s"].values()
+                 if v is not None]
+    cold_p50 = engine.pctile(cold_vals, 0.5)
+    warm_p50 = engine.pctile(clean["ttfts"], 0.5)
+    startup0 = clean["startup"].get("r0") or {}
     rec.update({
         "clean_completed": clean_done, "kill_completed": kill_done,
         "lost_requests": lost,
@@ -1330,13 +1784,31 @@ def _ab_main(args) -> int:
         "ttft_p99_delta_s": (round(p99_kill - p99_clean, 6)
                              if p99_clean is not None
                              and p99_kill is not None else None),
+        "attr_sum_ok": attr_ok, "attr_checked_requests": attr_n,
+        "trace": trace_checks,
+        "fault_top_bucket": fault_top,
+        "fault_completed": sum(
+            1 for o in fault["outcomes"].values()
+            if o == OUTCOME_COMPLETED),
+        "startup_phases": startup0,
+        "cold_spawn_first_token_s": cold_p50,
+        "cold_warm_first_token_delta_s": (
+            round(cold_p50 - warm_p50, 6)
+            if cold_p50 is not None and warm_p50 is not None
+            else None),
     })
     rec["ok"] = bool(
         clean_done == n and kill_done == n and lost == 0 and matched
         and victim_dead and standby_served
         and kill["failovers"] >= 1
         and rec["fleetz_has_router_rows"]
-        and p99_clean is not None and p99_kill is not None)
+        and p99_clean is not None and p99_kill is not None
+        and attr_ok and attr_n >= 2 * n
+        and trace_checks is not None and trace_checks["ok"]
+        and fault_top == "decode"
+        and set(startup0) == set(STARTUP_PHASES)
+        and cold_p50 is not None and warm_p50 is not None
+        and cold_p50 > warm_p50)
     lines = [
         {"metric": "router_lost_requests", "value": float(lost),
          "unit": "count"},
@@ -1346,6 +1818,16 @@ def _ab_main(args) -> int:
          "value": float(p99_clean or 0.0), "unit": "s"},
         {"metric": "router_ttft_p99_kill_s",
          "value": float(p99_kill or 0.0), "unit": "s"},
+        {"metric": "router_cold_spawn_first_token_s",
+         "value": float(cold_p50 or 0.0), "unit": "s"},
+        {"metric": "router_cold_warm_first_token_delta_s",
+         "value": float(rec["cold_warm_first_token_delta_s"] or 0.0),
+         "unit": "s"},
+        {"metric": "replica_startup_total_s",
+         "value": float(round(sum(startup0.values()), 6)
+                        if startup0 else 0.0), "unit": "s"},
+        {"metric": "router_tailz_decode_p99_contrib_s",
+         "value": float(decode_p99 or 0.0), "unit": "s"},
         rec,
     ]
     with open(args.out, "w", encoding="utf-8") as f:
@@ -1383,6 +1865,14 @@ def main(argv=None) -> int:
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--page-size", type=int, default=8)
     p.add_argument("--publish-interval", type=float, default=0.1)
+    p.add_argument("--spawned-at", type=float, default=None,
+                   help="replica mode: the parent's time.time() at "
+                        "spawn — anchors the cold-start observatory's "
+                        "spawn phase and spawn-to-first-token")
+    p.add_argument("--fault-delay", type=float, default=0.0,
+                   help="replica mode: install a FaultPlan delay of "
+                        "this many seconds on every serving.engine_step "
+                        "(the --ab fault arm's tail-attribution probe)")
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--out", default="SERVE_r01.json")
     args = p.parse_args(argv)
@@ -1398,9 +1888,11 @@ def main(argv=None) -> int:
 
 __all__ = [
     "ROUTE_OUTCOMES", "ROUTE_REASONS", "REPLICA_STATES",
+    "STARTUP_PHASES",
     "Router", "RouterRequest", "Replica", "ReplicaControl",
     "install_router", "get_router", "reset",
     "serving_lines", "fleetz_lines", "router_report",
+    "router_json", "router_trace_events",
     "spawn_replica",
 ]
 

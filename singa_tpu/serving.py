@@ -1413,7 +1413,10 @@ def build_beam_decode(m, B, S0, max_new, num_beams, length_penalty,
 def poisson_workload(seed, n_req, rps, vocab, prompt_lens, new_lens,
                      new_dist="bimodal"):
     """The seeded Poisson serving workload shared by `bench_decode
-    --serve`, `slo --ab`, and the router's kill-and-replace harness:
+    --serve`, `slo --ab`, and the router's kill-and-replace harness
+    (all three of its arms — clean, kill, and the FaultPlan-delayed
+    tail-attribution arm replay the same schedule, which is what makes
+    the /tailz and cold-vs-warm comparisons apples-to-apples):
     exponential inter-arrival times at `rps`, uniform prompt lengths in
     `prompt_lens = (lo, hi)`, and output lengths in `new_lens = (lo,
     hi)` — bimodal by default (75% short / 25% long, the mix that keeps

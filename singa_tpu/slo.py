@@ -88,6 +88,25 @@ PHASE_TERMINAL = "terminal"
 SLO_OBJECTIVES = ("ttft_p99", "latency_p99", "availability",
                   "tokens_per_sec")
 
+#: every latency-attribution bucket a terminal request's wall time can
+#: decompose into (the `attr=` label on singa_tail_seconds_total is
+#: proven against this tuple by rule 5). The decomposition is pure
+#: math over the phase-stamped timelines and MUST sum to the request's
+#: total latency — the same wall-sum discipline as the goodput
+#: buckets, test-enforced.
+LATENCY_ATTR = ("router_queue", "probe", "dispatch_retry",
+                "replica_queue", "prefill", "decode", "decode_stall",
+                "failover_replay", "other")
+ATTR_ROUTER_QUEUE = "router_queue"
+ATTR_PROBE = "probe"
+ATTR_DISPATCH_RETRY = "dispatch_retry"
+ATTR_REPLICA_QUEUE = "replica_queue"
+ATTR_PREFILL = "prefill"
+ATTR_DECODE = "decode"
+ATTR_DECODE_STALL = "decode_stall"
+ATTR_FAILOVER_REPLAY = "failover_replay"
+ATTR_OTHER = "other"
+
 
 _metrics_cache: "dict | None" = None
 
@@ -137,6 +156,10 @@ def _metrics():
         "phase": observe.histogram(
             "singa_slo_phase_seconds",
             "wall seconds a request spent in each lifecycle phase"),
+        "tail": observe.counter(
+            "singa_tail_seconds_total",
+            "terminal-request wall seconds attributed to each "
+            "latency bucket (LATENCY_ATTR decomposition)"),
     }
     return c
 
@@ -363,6 +386,11 @@ class SLOTracker:
                     "ttft_s": rec.get("ttft_s"),
                     "total_s": rec.get("total_s"),
                     "timeline": timeline,
+                    # where the violating request's wall time WENT —
+                    # the /slo display answers "which bucket" without
+                    # a trip to /tailz
+                    "attr": attribute_timeline(timeline)
+                    if timeline is not None else None,
                 })
         if observe.is_enabled():
             m = _metrics()
@@ -615,8 +643,10 @@ def get_tracker() -> "SLOTracker | None":
 def reset():
     """Full teardown (the conftest contract): the tracker uninstalled
     and its engine request listener detached — no evaluation state,
-    listeners or records leak between tests."""
+    listeners or records leak between tests. The tail-attribution
+    collector and its store reset on the same contract."""
     uninstall()
+    tail_reset()
 
 
 def note_decode(kind: str, seconds: float, new_tokens: int,
@@ -640,8 +670,23 @@ def note_decode(kind: str, seconds: float, new_tokens: int,
         "tokens_per_sec": (new_tokens / batch / seconds)
         if seconds > 0 else None,
     }
+    # the static path has no phase-stamped timeline, but the call wall
+    # still decomposes: the fenced TTFT is the prefill share, the rest
+    # is decode — so a dense deployment's /tailz is populated too
+    attr = None
+    if seconds > 0:
+        if ttft is not None and 0.0 < float(ttft) <= float(seconds):
+            attr = {ATTR_PREFILL: float(ttft),
+                    ATTR_DECODE: float(seconds) - float(ttft)}
+        else:
+            attr = {ATTR_DECODE: float(seconds)}
     for _ in range(batch):
         t.note_record(dict(rec))
+        if attr is not None:
+            note_attribution({"id": None, "outcome": "completed",
+                              "trace": None,
+                              "total_s": float(seconds),
+                              "attr": dict(attr)})
 
 
 # ---- per-phase durations ---------------------------------------------------
@@ -659,6 +704,260 @@ def phase_durations(timeline: dict):
     return out
 
 
+# ---- tail-latency attribution ----------------------------------------------
+# Pure math over the phase-stamped timelines: every terminal request's
+# wall time decomposes into the closed LATENCY_ATTR buckets, and the
+# buckets MUST sum to the request's total latency — the same wall-sum
+# discipline as the goodput buckets, test-enforced. Two decomposers:
+# one for an ENGINE timeline (inside a replica), one for a ROUTER
+# request (across dispatch/failover hops, adopting the winning
+# replica's engine-side buckets for the final hop).
+
+def attribute_timeline(timeline: dict) -> dict:
+    """{bucket: seconds} for one engine timeline, summing exactly to
+    last-event - first-event. submit/queue intervals book as
+    `replica_queue`, admit/prefill as `prefill`; the inter-sync decode
+    gaps split into steady `decode` plus `decode_stall` — any gap's
+    excess beyond 2x the median gap (an injected delay, a preempting
+    tenant, a straggling sync) with >= 3 gaps to estimate the median
+    from. Anything unclassifiable books as `other`. Empty dict for a
+    timeline with fewer than two events (nothing to attribute)."""
+    events = timeline.get("events") or []
+    out = {}
+    gaps = []
+    for (phase, t, _i), (_p2, t2, _i2) in zip(events, events[1:]):
+        d = max(0.0, float(t2) - float(t))
+        if phase in (PHASE_SUBMIT, PHASE_QUEUE):
+            k = ATTR_REPLICA_QUEUE
+        elif phase in (PHASE_ADMIT, PHASE_PREFILL):
+            k = ATTR_PREFILL
+        elif phase in (PHASE_FIRST_TOKEN, PHASE_DECODE):
+            gaps.append(d)
+            continue
+        else:
+            k = ATTR_OTHER
+        out[k] = out.get(k, 0.0) + d
+    if gaps:
+        total = sum(gaps)
+        stall = 0.0
+        if len(gaps) >= 3:
+            med = sorted(gaps)[len(gaps) // 2]
+            stall = min(sum(max(0.0, g - 2.0 * med) for g in gaps),
+                        total)
+        out[ATTR_DECODE] = total - stall
+        if stall > 0.0:
+            out[ATTR_DECODE_STALL] = stall
+    return {k: round(v, 7) for k, v in out.items()}
+
+
+def attribute_route(submitted, finished, events,
+                    replica_attr: "dict | None" = None) -> dict:
+    """{bucket: seconds} for one ROUTER request's wall time (submit ->
+    terminal), from its mark() events, summing exactly to finished -
+    submitted. `router_queue` runs up to the first dispatch; each hop
+    that failed over books its dead-replica probe under `probe` and
+    the rest under `failover_replay` (the replica had ACCEPTED the
+    work — the retry replays tokens already generated) or
+    `dispatch_retry` (it never started; includes the backoff); the
+    final hop adopts the winning replica's own engine-side buckets
+    (`replica_attr`) clipped to the hop wall, any remainder —
+    transport, HTTP framing, poll granularity — under `other`."""
+    out = {}
+    dispatches = [(float(t), i or {}) for (n, t, i) in events or ()
+                  if n == "dispatch"]
+    failovers = [(float(t), i or {}) for (n, t, i) in events or ()
+                 if n == "failover"]
+
+    def add(k, v):
+        if v > 0.0:
+            out[k] = out.get(k, 0.0) + v
+
+    if not dispatches:
+        # never dispatched: shed / drained / queue-expired in the
+        # router — the whole wall is router queue time
+        add(ATTR_ROUTER_QUEUE,
+            max(0.0, float(finished) - float(submitted)))
+        return {k: round(v, 7) for k, v in out.items()}
+    add(ATTR_ROUTER_QUEUE,
+        max(0.0, dispatches[0][0] - float(submitted)))
+    for k, (t, _info) in enumerate(dispatches):
+        end = dispatches[k + 1][0] if k + 1 < len(dispatches) \
+            else float(finished)
+        wall = max(0.0, end - t)
+        if k < len(failovers):
+            f_info = failovers[k][1]
+            probe = min(max(0.0, float(f_info.get("probe_s") or 0.0)),
+                        wall)
+            add(ATTR_PROBE, probe)
+            add(ATTR_FAILOVER_REPLAY if f_info.get("pending")
+                else ATTR_DISPATCH_RETRY, wall - probe)
+        elif replica_attr:
+            known = 0.0
+            for rk in LATENCY_ATTR:
+                rv = min(max(0.0, float(replica_attr.get(rk) or 0.0)),
+                         wall - known)
+                add(rk, rv)
+                known += rv
+            add(ATTR_OTHER, wall - known)
+        else:
+            add(ATTR_OTHER, wall)
+    return {k: round(v, 7) for k, v in out.items()}
+
+
+# -- the tail store (what /tailz aggregates) ---------------------------------
+
+_tail_lock = threading.Lock()
+_tail: "deque[dict]" = deque(maxlen=4096)
+_tail_collector: "TailCollector | None" = None
+
+
+def note_attribution(rec: dict):
+    """Feed one terminal request's decomposition into the tail store
+    ({"id", "outcome", "trace", "total_s", "attr"}) and the
+    singa_tail_seconds_total counter. Buckets outside the enum fold
+    into `other` — the counter's label set must stay closed."""
+    attr = {}
+    for k, v in (rec.get("attr") or {}).items():
+        k = k if k in LATENCY_ATTR else ATTR_OTHER
+        attr[k] = attr.get(k, 0.0) + float(v)
+    rec = dict(rec)
+    rec["attr"] = attr
+    with _tail_lock:
+        _tail.append(rec)
+    if observe.is_enabled():
+        m = _metrics()
+        for k, v in attr.items():
+            assert k in LATENCY_ATTR, k
+            if v > 0.0:
+                m["tail"].inc(float(v), attr=k)
+
+
+def tail_records() -> list:
+    """Locked copy of the attributed-request records (newest last)."""
+    with _tail_lock:
+        return [dict(r) for r in _tail]
+
+
+def tail_summary() -> dict:
+    """The aggregate /tailz view: request count, total-latency
+    percentiles, and per-bucket totals with each bucket's p99
+    CONTRIBUTION — the p99 of that bucket's per-request seconds
+    (zeros included, so a bucket touching one request in a thousand
+    ranks by what it does to the fleet tail, not to its own). `top`
+    names the bucket with the largest p99 contribution: the one-word
+    answer to "where did the tail go"."""
+    from . import engine as engine_mod
+    recs = tail_records()
+    totals = [float(r.get("total_s") or 0.0) for r in recs]
+    wall = sum(totals)
+    buckets = {}
+    for k in LATENCY_ATTR:
+        vals = [float((r.get("attr") or {}).get(k) or 0.0)
+                for r in recs]
+        nz = [v for v in vals if v > 0.0]
+        if not nz:
+            continue
+        buckets[k] = {
+            "sum_s": round(sum(nz), 6),
+            "share": round(sum(nz) / wall, 4) if wall > 0 else None,
+            "p99_s": engine_mod.pctile(vals, 0.99),
+            "requests": len(nz),
+        }
+    top = max(buckets, key=lambda k: buckets[k]["p99_s"] or 0.0) \
+        if buckets else None
+    return {"requests": len(recs),
+            "total_p50_s": engine_mod.pctile(totals, 0.5),
+            "total_p99_s": engine_mod.pctile(totals, 0.99),
+            "buckets": buckets,
+            "top": top}
+
+
+def tail_report() -> str:
+    """The /tailz text block: per-bucket p99 contribution ranking."""
+    lines = ["== tailz =="]
+    s = tail_summary()
+    if not s["requests"]:
+        lines.append("no attributed requests yet (terminal requests "
+                     "decompose into LATENCY_ATTR buckets here)")
+        return "\n".join(lines)
+    lines.append(
+        f"requests: {s['requests']}   "
+        f"total p50 {s['total_p50_s']:.4f}s "
+        f"p99 {s['total_p99_s']:.4f}s   "
+        f"top p99 contributor: {s['top']}")
+    ranked = sorted(s["buckets"].items(),
+                    key=lambda kv: kv[1]["p99_s"] or 0.0, reverse=True)
+    for k, b in ranked:
+        share = f"{100.0 * b['share']:.1f}%" \
+            if b["share"] is not None else "-"
+        lines.append(
+            f"  {k:<16} p99 {b['p99_s']:.4f}s  sum {b['sum_s']:.3f}s "
+            f"({share} of wall)  {b['requests']} req")
+    return "\n".join(lines)
+
+
+def tail_json() -> dict:
+    """The /tailz?json=1 body: summary + a bounded record tail."""
+    s = tail_summary()
+    return {"installed": s["requests"] > 0 or get_tail() is not None,
+            "summary": s, "records": tail_records()[-64:]}
+
+
+class TailCollector:
+    """Engine request listener feeding the tail store: every terminal
+    request's timeline decomposes through `attribute_timeline`.
+    Installed NEXT TO (not instead of) the SLOTracker — one listener
+    judges objectives, the other attributes the wall time."""
+
+    def _on_request(self, req, timeline):
+        attr = attribute_timeline(timeline)
+        if not attr:
+            return
+        total = timeline.get("total_s")
+        note_attribution({
+            "id": timeline.get("id"),
+            "outcome": timeline.get("outcome"),
+            "trace": timeline.get("trace"),
+            "total_s": total if total is not None
+            else round(sum(attr.values()), 7),
+            "attr": attr,
+        })
+
+
+def install_tail(collector: "TailCollector | None" = None) \
+        -> "TailCollector":
+    """Install (or replace) the process tail collector and subscribe
+    it to the engine's terminal-request stream."""
+    global _tail_collector
+    from . import engine
+    c = collector or TailCollector()
+    with _lock:
+        old = _tail_collector
+        if old is not None:
+            engine.remove_request_listener(old._on_request)
+        _tail_collector = c
+        engine.add_request_listener(c._on_request)
+    return c
+
+
+def get_tail() -> "TailCollector | None":
+    return _tail_collector
+
+
+def tail_reset():
+    """Detach the tail collector's engine listener and clear the
+    store (the conftest teardown contract, like the tracker's)."""
+    global _tail_collector
+    from . import engine
+    with _lock:
+        c = _tail_collector
+        _tail_collector = None
+        if c is not None:
+            engine.remove_request_listener(c._on_request)
+    with _tail_lock:
+        _tail.clear()
+
+
 # ---- trace export ----------------------------------------------------------
 
 #: synthetic track (tid) layout for request slices — far above real OS
@@ -668,6 +967,12 @@ QUEUE_TID = 900_000
 SLOT_TID_BASE = 900_100
 
 _FLOW_CAT = "req_flow"
+#: the CROSS-PROCESS flow category: one flow per router-minted trace
+#: id, stepping router queue -> each dispatch hop -> every replica the
+#: request touched. Unlike `req_flow` (pid-scoped by construction),
+#: linking ACROSS pids is the point — the id is the fleet-unique trace
+#: string itself.
+TRACE_CTX_CAT = "trace_ctx"
 
 
 def request_trace_events(timelines, syncs, pid, offset=0.0,
@@ -706,13 +1011,21 @@ def request_trace_events(timelines, syncs, pid, offset=0.0,
         })
     for tl in timelines or ():
         rid = tl.get("id")
+        evs = tl.get("events") or []
         stamps = {}
-        for phase, t, _info in tl.get("events") or ():
+        for phase, t, _info in evs:
             stamps.setdefault(phase, float(t))
         t_submit = stamps.get(PHASE_SUBMIT) or stamps.get(PHASE_QUEUE)
-        t_end = stamps.get(PHASE_TERMINAL)
-        if t_submit is None or t_end is None:
+        if t_submit is None:
             continue
+        t_end = stamps.get(PHASE_TERMINAL)
+        in_flight = t_end is None
+        if in_flight:
+            # an IN-FLIGHT timeline (the replica died mid-request, or
+            # the snapshot raced the decode loop): render what exists,
+            # up to the last stamp — the victim's partial work is
+            # exactly what the merged failover trace must show
+            t_end = float(evs[-1][1])
         t_admit = stamps.get(PHASE_ADMIT)
         t_first = stamps.get(PHASE_FIRST_TOKEN)
         args = {"id": rid, "outcome": tl.get("outcome"),
@@ -725,6 +1038,23 @@ def request_trace_events(timelines, syncs, pid, offset=0.0,
             "dur": round(max(0.0, q_end - t_submit) * 1e6, 3),
             "pid": pid, "tid": QUEUE_TID, "args": args,
         })
+        trace = tl.get("trace")
+        if trace:
+            # cross-process flow STEP on this replica: bound inside the
+            # request's first slice here (prefill when it reached a
+            # slot, else the queued span) — the router's track holds
+            # the flow's s/f ends
+            bind_t0 = t_admit if t_admit is not None else t_submit
+            bind_t1 = ((t_first if t_first is not None else t_end)
+                       if t_admit is not None else q_end)
+            events.append({
+                "ph": "t", "cat": TRACE_CTX_CAT, "name": "trace",
+                "id": str(trace),
+                "ts": us(bind_t0 + max(0.0, bind_t1 - bind_t0) / 2.0),
+                "pid": pid,
+                "tid": (SLOT_TID_BASE + int(tl.get("slot") or 0))
+                if t_admit is not None else QUEUE_TID,
+            })
         if t_admit is None:
             continue  # never reached a slot (rejected / queue timeout)
         slot_tid = SLOT_TID_BASE + int(tl.get("slot") or 0)
@@ -856,6 +1186,7 @@ def fleet_serve_snapshot(max_timelines: int = _SHARD_TIMELINES,
     ttfts = []
     finished = {}
     timelines = []
+    active = []
     syncs = []
     for e in engines:
         r = e.report()
@@ -870,6 +1201,13 @@ def fleet_serve_snapshot(max_timelines: int = _SHARD_TIMELINES,
             finished[o] = finished.get(o, 0) + n
         ttfts.extend(e.recent_ttfts())
         timelines.extend(e.timelines()[-max_timelines:])
+        # IN-FLIGHT request timelines ride the shard too: when a
+        # replica dies mid-request, its last published shard is the
+        # only record of the work the victim had done — the merged
+        # failover trace renders it as an open-ended track
+        act = getattr(e, "active_timelines", None)
+        if act is not None:
+            active.extend(act()[-max_timelines:])
         syncs.extend(e.sync_records()[-max_syncs:])
     kv_bytes = pool_bytes
     try:
@@ -916,6 +1254,7 @@ def fleet_serve_snapshot(max_timelines: int = _SHARD_TIMELINES,
         "finished": finished,
         "slo": slo_part,
         "timelines": timelines[-max_timelines:],
+        "active": active[-max_timelines:],
         "syncs": syncs[-max_syncs:],
     }
 
@@ -1003,6 +1342,11 @@ def slo_report() -> str:
             tl = rec.get("timeline")
             if tl:
                 lines.append("    " + _fmt_timeline(tl))
+            attr = rec.get("attr")
+            if attr:
+                ranked = sorted(attr.items(), key=lambda kv: -kv[1])
+                lines.append("    attr: " + " ".join(
+                    f"{k}={v:.4f}s" for k, v in ranked))
     else:
         lines.append("recent violations: none")
     return "\n".join(lines)
@@ -1277,8 +1621,12 @@ def main(argv=None) -> int:
 
 
 __all__ = [
-    "REQUEST_PHASES", "SLO_OBJECTIVES", "SLOConfig", "SLOTracker",
+    "REQUEST_PHASES", "SLO_OBJECTIVES", "LATENCY_ATTR",
+    "SLOConfig", "SLOTracker",
     "objective_good", "attainment", "burn_rate", "phase_durations",
+    "attribute_timeline", "attribute_route", "note_attribution",
+    "tail_records", "tail_summary", "tail_report", "tail_json",
+    "TailCollector", "install_tail", "get_tail", "tail_reset",
     "install", "uninstall", "get_tracker", "reset", "note_decode",
     "request_trace_events", "engine_trace_events", "export_trace",
     "flow_event_id",

@@ -113,6 +113,18 @@ def _metrics_isolation():
     assert not leaked_serve, (
         f"serving-engine thread(s) left running: {leaked_serve} — call "
         "ServingEngine.stop() (or engine.reset()) before the test ends")
+    # tail-attribution teardown (ISSUE-16): the installed TailCollector
+    # detached from the engine's listener list and the per-request
+    # attribution ring cleared. Runs BEFORE the SLO check below, which
+    # would otherwise misread the collector's listener as a raw leak.
+    _tc = slo.get_tail()
+    slo.tail_reset()
+    leaked_tail = [getattr(cb, "__qualname__", str(cb))
+                   for cb in engine.request_listeners()
+                   if _tc is not None and cb == _tc._on_request]
+    assert not leaked_tail, (
+        "TailCollector listener left attached after slo.tail_reset() "
+        f"({leaked_tail}) — install_tail() must detach via tail_reset()")
     # SLO-tracker teardown (ISSUE-12): the installed tracker is
     # uninstalled silently (like the memory ledger), but a RAW engine
     # request listener a test registered itself must be removed by the

@@ -255,6 +255,41 @@ def test_loss_and_failover_counts_lower_is_better():
                                            "items/s")
 
 
+def test_startup_metrics_lower_is_better():
+    """ISSUE-16 satellite: the replica cold-start observatory's wall
+    times — `startup`/`cold`/`spawn` anywhere in the name — regress UP
+    even when a round wrote them unit-less; rate units still win."""
+    assert bench_trend.lower_is_better("replica_startup_total_s", "s")
+    assert bench_trend.lower_is_better(
+        "router_cold_spawn_first_token_s", "")
+    assert bench_trend.lower_is_better("toy_spawn_to_ready", "")
+    assert bench_trend.lower_is_better("cold_start_p99", "")
+    assert not bench_trend.lower_is_better("cold_starts_handled_per_s",
+                                           "items/s")
+
+
+def test_startup_fixture_regression_flagged():
+    """The SERVE r05/r06 fixture rounds carry the cold-start records:
+    improving in clean/ (2.0 -> 1.9, no flag), +20% in regress/
+    (flagged UP against the best prior round) — a spin-up slide trips
+    the trend gate like a latency one."""
+    clean = bench_trend.trend_table(bench_trend.collect([CLEAN]))
+    assert clean["replica_startup_total_s"]["by_round"] == {5: 2.0,
+                                                           6: 1.9}
+    assert not [r for r in bench_trend.find_regressions(clean)
+                if r[0] in ("replica_startup_total_s",
+                            "router_cold_spawn_first_token_s")]
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = {m: (rnd, v, best_r, best, delta)
+            for m, rnd, v, best_r, best, delta
+            in bench_trend.find_regressions(table, threshold=0.05)}
+    rnd, v, best_r, best, delta = regs["replica_startup_total_s"]
+    assert (rnd, v, best_r, best) == (6, 2.4, 5, 2.0)
+    assert abs(delta - 0.2) < 1e-9
+    # the flat cold-spawn series is NOT flagged (2.4 -> 2.4)
+    assert "router_cold_spawn_first_token_s" not in regs
+
+
 def test_router_loss_fixture_regression_flagged():
     """The SERVE r03/r04 fixture rounds carry the router reliability
     records: flat-at-zero loss in clean/ (no flag — zero staying zero

@@ -672,3 +672,76 @@ def test_fleetz_carries_router_section(served, tmp_path):
         rt.reset()
         ctl.stop()
         fleet.uninstall()
+
+
+def test_tailz_golden_sections():
+    """ISSUE-16: /tailz is 503 until any terminal request has been
+    attributed; with records it ranks buckets by p99 CONTRIBUTION and
+    names the top one; ?json=1 is the structured form (summary + a
+    bounded record tail); the index advertises the endpoint."""
+    from singa_tpu import slo
+    srv = observe.start_diag_server(port=0)
+    try:
+        st, _h, body = _get(srv, "/tailz")
+        assert st == 503
+        assert "no attributed requests yet" in body
+        st, _h, body = _get(srv, "/tailz?json=1")
+        assert st == 503
+        assert json.loads(body)["installed"] is False
+        for i in range(4):
+            slo.note_attribution(
+                {"id": i, "outcome": "completed", "total_s": 0.1,
+                 "attr": {"decode": 0.09, "prefill": 0.01}})
+        slo.note_attribution(
+            {"id": 9, "outcome": "completed", "trace": "tdead-9",
+             "total_s": 1.0,
+             "attr": {"decode": 0.09, "failover_replay": 0.91}})
+        st, _h, body = _get(srv, "/tailz")
+        assert st == 200
+        assert "== tailz ==" in body
+        assert "requests: 5" in body
+        assert "top p99 contributor: failover_replay" in body
+        assert "decode" in body and "% of wall" in body
+        st, _h, body = _get(srv, "/tailz?json=1")
+        assert st == 200
+        rep = json.loads(body)
+        assert rep["installed"] is True
+        assert rep["summary"]["top"] == "failover_replay"
+        assert rep["summary"]["buckets"]["decode"]["requests"] == 5
+        assert rep["records"][-1]["trace"] == "tdead-9"
+        _st, _h, idx = _get(srv, "/")
+        assert "/tailz" in idx
+    finally:
+        diag.stop_diag_server()
+        slo.tail_reset()
+
+
+def test_routerz_json_form(served):
+    """ISSUE-16 satellite: /routerz?json=1 serves the snapshot plus
+    the terminal request timelines (trace id, hop marks, attribution)
+    — and stays a 503 {"installed": false} without a router."""
+    from singa_tpu import router as rt
+    from singa_tpu import slo
+    srv = served[0]
+    status, _, body = _get(srv, "/routerz?json=1")
+    assert status == 503
+    assert json.loads(body) == {"installed": False}
+    r, ctl = _stub_routed_router()
+    try:
+        status, _, body = _get(srv, "/routerz?json=1")
+        assert status == 200
+        rep = json.loads(body)
+        assert rep["installed"] is True
+        assert rep["snapshot"]["terminal"]["completed"] == 1
+        tl = rep["requests"][0]
+        assert tl["trace"] and tl["outcome"] == "completed"
+        assert tl["attr"] and tl["total_s"] > 0
+        # the text form now carries the recent-request tail too
+        status, _, body = _get(srv, "/routerz")
+        assert "recent requests:" in body
+        assert f"[{tl['trace']}]" in body
+    finally:
+        r.stop()
+        rt.reset()
+        ctl.stop()
+        slo.tail_reset()

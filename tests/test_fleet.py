@@ -762,3 +762,102 @@ def test_merged_trace_dedupes_engine_step_slices(tmp_path):
     s = steps[0]
     assert s["tid"] == f["tid"] == 77
     assert s["ts"] <= f["ts"] <= s["ts"] + s["dur"]
+
+
+def test_merged_trace_links_router_and_replicas_via_trace_ctx(
+        tmp_path):
+    """ISSUE-16: the merged trace carries ONE track per process (a
+    single process_name per pid, the router's sorted on top), every
+    req_flow id stays pid-scoped (two replicas serving request id 1
+    never cross-link), and a router-minted trace id stitches ONE
+    trace_ctx flow across processes — the router's s/f endpoints
+    bracketing a binding step on EACH replica the request touched
+    (the failover shape: victim's in-flight partial + winner), while
+    a second traced request keeps its own flow to its own replica."""
+    import numpy as np
+    from singa_tpu import router as rt
+    from singa_tpu import slo
+    from tests.test_router import _StubEngine, _mk_router
+    d = str(tmp_path)
+    ctls = [rt.ReplicaControl(_StubEngine()) for _ in range(2)]
+    r = _mk_router()
+    for i, c in enumerate(ctls):
+        r.add_replica(f"s{i}", c.url, host=f"s{i}")
+    try:
+        h1 = r.submit(np.array([3, 1], np.int32), 2)
+        h2 = r.submit(np.array([5], np.int32), 2)
+        assert h1.wait(30) and h2.wait(30)
+        off = time.time() - time.perf_counter()
+        q1 = next(t for e, t, _i in h1.events if e == "dispatch")
+        w1 = ((q1 + off) + (h1.finished_ts + off)) / 2.0
+        q2 = next(t for e, t, _i in h2.events if e == "dispatch")
+        w2 = ((q2 + off) + (h2.finished_ts + off)) / 2.0
+
+        def _tl(rid, trace, terminal=True):
+            evs = [["submit", 100.0, None], ["admit", 100.0001, None],
+                   ["first_token", 100.0003, None]]
+            if terminal:
+                evs.append(["terminal", 100.0004,
+                            {"outcome": "completed"}])
+            return {"id": rid, "trace": trace, "slot": 0,
+                    "outcome": "completed" if terminal else None,
+                    "prompt_tokens": 2, "new_tokens": 2,
+                    "ttft_s": 0.0003, "total_s": 0.0004,
+                    "events": evs, "syncs": []}
+
+        # victim replica: request 1 in flight (no terminal) when the
+        # shard was last published; winner replica: request 1 replayed
+        # to completion PLUS request 2 — note both processes reuse
+        # LOCAL request id 1
+        victim = _fake_serve(timelines=[], syncs=[])
+        victim["active"] = [_tl(1, h1.trace, terminal=False)]
+        _write_fake_shard(d, "hostA", 100, ts=w1 - 100.0, perf=0.0,
+                          serve=victim)
+        winner = _fake_serve(
+            timelines=[_tl(1, h1.trace), _tl(2, h2.trace)], syncs=[])
+        _write_fake_shard(d, "hostB", 101, ts=w2 - 100.0, perf=0.0,
+                          serve=winner)
+        agg = fleet.FleetAggregator(d)
+        agg.poll()
+        events = agg.trace_events()["traceEvents"]
+        # one track per process: a single process_name per pid, and
+        # the router's synthetic process present and sorted on top
+        pnames = [e for e in events if e.get("ph") == "M"
+                  and e["name"] == "process_name"]
+        by_pid = {}
+        for e in pnames:
+            by_pid.setdefault(e["pid"], []).append(e)
+        assert all(len(v) == 1 for v in by_pid.values()), by_pid
+        assert set(by_pid) >= {100, 101, os.getpid()}
+        assert by_pid[os.getpid()][0]["args"]["name"] == \
+            f"router (pid {os.getpid()})"
+        # req_flow ids stay pid-scoped: replica 100's request 1 and
+        # replica 101's request 1 can never join arrows
+        for e in events:
+            if e.get("cat") == "req_flow":
+                assert e["id"].startswith(f"{e['pid']}:"), e
+        # the failover request's trace_ctx flow: s and f on the router,
+        # a binding step on BOTH replicas, strictly ordered s < t < f
+        ctx = [e for e in events if e.get("cat") == slo.TRACE_CTX_CAT
+               and e["id"] == h1.trace]
+        s = [e for e in ctx if e["ph"] == "s"]
+        t = [e for e in ctx if e["ph"] == "t"]
+        f = [e for e in ctx if e["ph"] == "f"]
+        assert len(s) == 1 and len(f) == 1
+        assert s[0]["pid"] == os.getpid() == f[0]["pid"]
+        assert f[0]["bp"] == "e"
+        assert {e["pid"] for e in t} == {100, 101}
+        for e in t:
+            assert s[0]["ts"] < e["ts"] < f[0]["ts"], (s, e, f)
+        # the clean request's flow touches ONLY its own replica
+        ctx2 = [e for e in events if e.get("cat") == slo.TRACE_CTX_CAT
+                and e["id"] == h2.trace]
+        assert {e["pid"] for e in ctx2 if e["ph"] == "t"} == {101}
+        assert {e["pid"] for e in ctx2 if e["ph"] in ("s", "f")} == \
+            {os.getpid()}
+    finally:
+        r.stop()
+        rt.reset()
+        for c in ctls:
+            c.stop()
+        slo.tail_reset()

@@ -450,8 +450,10 @@ def test_lint_covers_slo_metric_names():
             "singa_slo_error_budget_remaining",
             "singa_slo_window_requests", "singa_slo_evaluations_total",
             "singa_slo_violations_total", "singa_slo_breach_total",
-            "singa_slo_phase_seconds"} <= names
-    assert all(n.startswith("singa_slo_") for n in names)
+            "singa_slo_phase_seconds",
+            "singa_tail_seconds_total"} <= names
+    assert all(n.startswith(("singa_slo_", "singa_tail_"))
+               for n in names)
     assert check_metrics_names.check([slo_py]) == []
     import ast
     enums, _consts = check_metrics_names._module_enum_info(
@@ -461,8 +463,13 @@ def test_lint_covers_slo_metric_names():
         "terminal")
     assert enums["SLO_OBJECTIVES"] == (
         "ttft_p99", "latency_p99", "availability", "tokens_per_sec")
+    assert enums["LATENCY_ATTR"] == (
+        "router_queue", "probe", "dispatch_retry", "replica_queue",
+        "prefill", "decode", "decode_stall", "failover_replay",
+        "other")
     assert "objective" in check_metrics_names.ENUM_LABEL_KWARGS
     assert "phase" in check_metrics_names.ENUM_LABEL_KWARGS
+    assert "attr" in check_metrics_names.ENUM_LABEL_KWARGS
 
 
 def test_objective_label_rule(tmp_path):
@@ -574,8 +581,10 @@ def test_lint_covers_router_metric_names():
             "singa_route_failover_total", "singa_route_retries_total",
             "singa_route_queue_depth", "singa_route_replicas_live",
             "singa_route_replica_inflight",
-            "singa_route_request_seconds"} <= names
-    assert all(n.startswith("singa_route_") for n in names)
+            "singa_route_request_seconds",
+            "singa_replica_startup_seconds"} <= names
+    assert all(n.startswith(("singa_route_", "singa_replica_"))
+               for n in names)
     assert check_metrics_names.check([router_py]) == []
     import ast
     enums, consts = check_metrics_names._module_enum_info(
@@ -584,6 +593,9 @@ def test_lint_covers_router_metric_names():
                                       "retry_exhausted")
     assert enums["ROUTE_OUTCOMES"] == ("completed", "rejected")
     assert enums["REPLICA_STATES"] == ("live", "draining", "dead")
+    assert enums["STARTUP_PHASES"] == (
+        "spawn", "import", "build", "trace", "lower", "compile",
+        "warm", "ready")
     # the literal aliases resolve as proven members
     assert consts["REASON_SHED"] == "shed"
     assert consts["REASON_REPLICA_DEAD"] == "replica_dead"
@@ -615,3 +627,49 @@ def test_route_reason_and_replica_label_rules(tmp_path):
     # a replica= string literal is not a member of any declared enum
     assert any("'r0'" in p for p in problems)
     assert any("dynamic" in p for p in problems)
+
+
+def test_attr_and_startup_phase_label_rules(tmp_path):
+    """ISSUE-16: an attr= literal outside LATENCY_ATTR (or a startup
+    phase= outside STARTUP_PHASES) is a violation; members and
+    enum-guarded dynamic values pass — unguarded dynamics fail."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "LATENCY_ATTR = ('router_queue', 'probe', 'decode', 'other')\n"
+        "STARTUP_PHASES = ('spawn', 'import', 'build', 'warm',"
+        " 'ready')\n"
+        "observe.counter('singa_t_total', 'a').inc(attr='decode')\n"
+        "observe.counter('singa_t_total', 'a').inc(attr='network')\n"
+        "def guarded(k, v):\n"
+        "    assert k in LATENCY_ATTR\n"
+        "    observe.counter('singa_t_total', 'a').inc(v, attr=k)\n"
+        "def unguarded(k, v):\n"
+        "    observe.counter('singa_t_total', 'a').inc(v, attr=k)\n"
+        "observe.histogram('singa_s_seconds', 'b')"
+        ".observe(1.0, phase='warm')\n"
+        "observe.histogram('singa_s_seconds', 'b')"
+        ".observe(1.0, phase='preflight')\n"
+        "def guarded_p(p, s):\n"
+        "    assert p in STARTUP_PHASES\n"
+        "    observe.histogram('singa_s_seconds', 'b')"
+        ".observe(s, phase=p)\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 3, problems
+    assert any("'network'" in p for p in problems)
+    assert any("'preflight'" in p for p in problems)
+    assert any("dynamic" in p for p in problems)
+
+
+def test_lint_passes_tail_and_startup_registrations():
+    """The coverage half of the ISSUE-16 satellite: every
+    singa_tail_* / singa_replica_* registration in the repo passes
+    the full lint (the enum guards in slo.note_attribution and
+    router._observe_startup prove the label values)."""
+    py_files = [os.path.join(check_metrics_names.ROOT, "singa_tpu", m)
+                for m in ("slo.py", "router.py")]
+    regs = [(n, f) for f in py_files
+            for n, _t, _h, _l in check_metrics_names.registrations_in(f)
+            if n.startswith(("singa_tail_", "singa_replica_"))]
+    assert {n for n, _f in regs} == {"singa_tail_seconds_total",
+                                     "singa_replica_startup_seconds"}
+    assert check_metrics_names.check(py_files) == []

@@ -435,3 +435,136 @@ def test_rolling_restart_drains_without_loss_or_evictions():
             c.stop()
         for e in engines:
             e.stop()
+
+
+# ---- trace context + tail attribution (ISSUE-16) ---------------------------
+
+def test_trace_minted_per_request_and_recorded(stubs):
+    """The front door mints the fleet-unique trace id (pid-prefixed,
+    so two routers can never collide) and it rides the terminal
+    timeline record together with an attribution that sums to the
+    request's wall time."""
+    import os as _os
+    from singa_tpu import slo
+    r, _ = stubs
+    h = r.submit(np.array([2, 4], np.int32), 3)
+    assert h.wait(30) and h.outcome == "completed"
+    assert h.trace == f"t{_os.getpid():x}-{h.id}"
+    tls = r.request_timelines()
+    tl = next(t for t in tls if t["id"] == h.id)
+    assert tl["trace"] == h.trace
+    assert tl["total_s"] > 0
+    assert set(tl["attr"]) <= set(slo.LATENCY_ATTR)
+    assert sum(tl["attr"].values()) == pytest.approx(
+        tl["total_s"], rel=0.10, abs=0.005)
+    # _finish also feeds the process tail store (/tailz)
+    recs = slo.tail_records()
+    assert any(rec.get("trace") == h.trace for rec in recs)
+    slo.tail_reset()
+
+
+def test_request_timelines_returns_locked_copies(stubs):
+    r, _ = stubs
+    h = r.submit(np.array([1], np.int32), 2)
+    assert h.wait(30)
+    tls = r.request_timelines()
+    assert len(tls) == 1
+    tls[0]["trace"] = "clobbered"
+    tls.clear()
+    again = r.request_timelines()
+    assert len(again) == 1 and again[0]["trace"] == h.trace
+
+
+def test_failover_attribution_probe_and_retry_buckets():
+    """A connection-refused hop never ACCEPTED the work: its wall
+    books as probe + dispatch_retry (not failover_replay), and the
+    decomposition still sums to the total."""
+    from singa_tpu import slo
+    dead = rt.ReplicaControl(_StubEngine())
+    dead_url = dead.url
+    dead.stop()
+    live = rt.ReplicaControl(_StubEngine())
+    r = _mk_router()
+    r.add_replica("dead", dead_url, host="dead")
+    r.add_replica("live", live.url, host="live")
+    try:
+        hs = [r.submit(np.array([i, 7], np.int32), 2)
+              for i in range(6)]
+        for h in hs:
+            assert h.wait(30) and h.outcome == "completed"
+        failed_over = [h for h in hs if h.attempts > 1]
+        assert failed_over
+        for h in failed_over:
+            assert h.attr is not None
+            assert h.attr.get("dispatch_retry", 0.0) > 0.0
+            assert "failover_replay" not in h.attr
+            assert sum(h.attr.values()) == pytest.approx(
+                h.finished_ts - h.submitted, rel=0.10, abs=0.005)
+            ev = next(i for e, t, i in h.events if e == "failover")
+            assert ev["pending"] is False
+            assert "probe_s" in ev
+    finally:
+        r.stop()
+        rt.reset()
+        live.stop()
+        slo.tail_reset()
+
+
+def test_router_trace_events_schema_and_flow_endpoints(stubs):
+    """The router's own track: metadata names the synthetic process
+    (sorted above the replicas), every terminal request renders one
+    queued slice + one slice per hop, and a traced completed request
+    carries the trace_ctx flow 's'/'f' pair — s strictly before f,
+    both inside the request's dispatch window, id = the trace string
+    (NOT pid-scoped: linking across processes is the point)."""
+    import os as _os
+    from singa_tpu.slo import TRACE_CTX_CAT
+    r, _ = stubs
+    hs = [r.submit(np.array([i], np.int32), 2) for i in range(3)]
+    for h in hs:
+        assert h.wait(30) and h.outcome == "completed"
+    evs = rt.router_trace_events()
+    pid = _os.getpid()
+    meta = {e["name"]: e for e in evs if e["ph"] == "M"}
+    assert meta["process_name"]["args"]["name"] == \
+        f"router (pid {pid})"
+    assert meta["process_sort_index"]["args"]["sort_index"] == -1
+    queued = [e for e in evs if e["ph"] == "X"
+              and e["name"].endswith("queued")]
+    hops = [e for e in evs if e["ph"] == "X" and " hop " in e["name"]]
+    assert len(queued) == 3 and len(hops) == 3
+    assert all(e["tid"] == rt.ROUTER_QUEUE_TID for e in queued)
+    assert all(e["tid"] == rt.ROUTER_DISPATCH_TID for e in hops)
+    for h in hs:
+        s = [e for e in evs if e.get("cat") == TRACE_CTX_CAT
+             and e["ph"] == "s" and e["id"] == h.trace]
+        f = [e for e in evs if e.get("cat") == TRACE_CTX_CAT
+             and e["ph"] == "f" and e["id"] == h.trace]
+        assert len(s) == 1 and len(f) == 1
+        assert f[0]["bp"] == "e"
+        assert s[0]["ts"] < f[0]["ts"]
+        hop = next(e for e in hops if f" {h.id} hop" in e["name"])
+        assert hop["ts"] <= s[0]["ts"]
+        assert f[0]["ts"] <= hop["ts"] + hop["dur"] + 1.0
+    from singa_tpu import slo
+    slo.tail_reset()
+
+
+def test_router_json_and_trace_empty_without_router():
+    rt.reset()
+    assert rt.router_json() == {"installed": False}
+    assert rt.router_trace_events() == []
+
+
+def test_router_json_carries_snapshot_and_timelines(stubs):
+    from singa_tpu import slo
+    r, _ = stubs
+    h = r.submit(np.array([9], np.int32), 2)
+    assert h.wait(30)
+    j = rt.router_json()
+    assert j["installed"] is True
+    assert j["snapshot"]["terminal"]["completed"] == 1
+    assert len(j["requests"]) == 1
+    assert j["requests"][0]["trace"] == h.trace
+    assert j["requests"][0]["attr"]
+    slo.tail_reset()

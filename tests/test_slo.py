@@ -514,3 +514,216 @@ def test_read_surfaces_do_not_advance_sustain():
         assert tr.breaching() == ["ttft_p99"]
     finally:
         slo.reset()
+
+
+# ---- tail-latency attribution (ISSUE-16) -----------------------------------
+
+def test_latency_attr_enum():
+    assert slo.LATENCY_ATTR == (
+        "router_queue", "probe", "dispatch_retry", "replica_queue",
+        "prefill", "decode", "decode_stall", "failover_replay",
+        "other")
+
+
+def test_attribute_timeline_sums_and_carves_stall():
+    evs = [("submit", 0.00, None), ("queue", 0.01, None),
+           ("admit", 0.03, None), ("first_token", 0.05, None),
+           ("decode", 0.06, None), ("decode", 0.07, None),
+           ("decode", 0.18, None), ("terminal", 0.19, None)]
+    attr = slo.attribute_timeline({"events": evs})
+    assert attr["replica_queue"] == pytest.approx(0.03)
+    assert attr["prefill"] == pytest.approx(0.02)
+    # gaps [0.01, 0.01, 0.11, 0.01]: median 0.01, so the 0.11 outlier
+    # books 0.09 of stall on top of 2x-median steady decode
+    assert attr["decode_stall"] == pytest.approx(0.09)
+    assert attr["decode"] == pytest.approx(0.05)
+    assert sum(attr.values()) == pytest.approx(0.19)
+    # unknown phase intervals land in `other`, never a new bucket
+    attr2 = slo.attribute_timeline(
+        {"events": [("submit", 0.0, None), ("mystery", 1.0, None),
+                    ("terminal", 1.5, None)]})
+    assert attr2["other"] == pytest.approx(0.5)
+    assert set(attr2) <= set(slo.LATENCY_ATTR)
+    # fewer than two events: nothing to attribute
+    assert slo.attribute_timeline({"events": []}) == {}
+    assert slo.attribute_timeline(
+        {"events": [("submit", 0.0, None)]}) == {}
+
+
+def test_attribute_route_never_dispatched_is_router_queue():
+    attr = slo.attribute_route(10.0, 10.5, [])
+    assert attr == {"router_queue": pytest.approx(0.5)}
+
+
+def test_attribute_route_adopts_replica_buckets_clipped():
+    evs = [("dispatch", 0.1, {"replica": "r0"})]
+    attr = slo.attribute_route(
+        0.0, 1.1, evs, replica_attr={"prefill": 0.3, "decode": 0.5})
+    assert attr["router_queue"] == pytest.approx(0.1)
+    assert attr["prefill"] == pytest.approx(0.3)
+    assert attr["decode"] == pytest.approx(0.5)
+    # transport/framing remainder of the hop wall books as `other`
+    assert attr["other"] == pytest.approx(0.2)
+    assert sum(attr.values()) == pytest.approx(1.1)
+    # a replica claiming more than the hop wall is CLIPPED — the route
+    # decomposition can never exceed what the router observed
+    attr = slo.attribute_route(
+        0.0, 1.1, evs, replica_attr={"prefill": 0.3, "decode": 5.0})
+    assert attr["decode"] == pytest.approx(0.7)
+    assert "other" not in attr
+    assert sum(attr.values()) == pytest.approx(1.1)
+
+
+def test_attribute_route_failover_probe_replay_vs_retry():
+    evs = [("dispatch", 0.1, {"replica": "a"}),
+           ("failover", 0.5, {"probe_s": 0.2, "pending": True}),
+           ("dispatch", 0.6, {"replica": "b"})]
+    attr = slo.attribute_route(0.0, 1.0, evs)
+    assert attr["router_queue"] == pytest.approx(0.1)
+    assert attr["probe"] == pytest.approx(0.2)
+    # the dead replica had ACCEPTED the work (a dispatch poll round
+    # returned "pending"): the lost hop is replayed generation
+    assert attr["failover_replay"] == pytest.approx(0.3)
+    assert attr["other"] == pytest.approx(0.4)  # winning hop, no attr
+    assert sum(attr.values()) == pytest.approx(1.0)
+    # never accepted -> dispatch_retry, not replay
+    evs[1] = ("failover", 0.5, {"probe_s": 0.2, "pending": False})
+    attr = slo.attribute_route(0.0, 1.0, evs)
+    assert attr["dispatch_retry"] == pytest.approx(0.3)
+    assert "failover_replay" not in attr
+    assert sum(attr.values()) == pytest.approx(1.0)
+
+
+def test_note_attribution_folds_unknown_and_feeds_counter():
+    slo.tail_reset()
+    slo.note_attribution({"id": 1, "outcome": "completed",
+                          "total_s": 0.5,
+                          "attr": {"decode": 0.3, "martian": 0.2}})
+    recs = slo.tail_records()
+    assert len(recs) == 1
+    assert recs[0]["attr"] == {"decode": pytest.approx(0.3),
+                               "other": pytest.approx(0.2)}
+    c = observe.get_registry().get("singa_tail_seconds_total")
+    assert c.value(attr="decode") == pytest.approx(0.3)
+    assert c.value(attr="other") == pytest.approx(0.2)
+    slo.tail_reset()
+    assert slo.tail_records() == []
+
+
+def test_tail_summary_ranks_p99_contribution_not_share():
+    """A bucket touching ONE request in many still tops the ranking
+    when that one contribution dominates the tail — p99 over ALL
+    records (zeros included) with maxlen-bounded share math."""
+    slo.tail_reset()
+    for i in range(20):
+        slo.note_attribution(
+            {"id": i, "outcome": "completed", "total_s": 0.1,
+             "attr": {"decode": 0.08, "prefill": 0.02}})
+    slo.note_attribution(
+        {"id": 99, "outcome": "completed", "total_s": 2.0,
+         "attr": {"decode": 0.08, "decode_stall": 1.92}})
+    s = slo.tail_summary()
+    assert s["requests"] == 21
+    assert s["top"] == "decode_stall"
+    assert s["buckets"]["decode_stall"]["requests"] == 1
+    assert s["buckets"]["decode_stall"]["p99_s"] > \
+        s["buckets"]["decode"]["p99_s"]
+    assert s["total_p99_s"] >= s["total_p50_s"]
+    rep = slo.tail_report()
+    assert "== tailz ==" in rep
+    assert "top p99 contributor: decode_stall" in rep
+    j = slo.tail_json()
+    assert j["installed"] and j["summary"]["top"] == "decode_stall"
+    assert len(j["records"]) == 21
+    slo.tail_reset()
+    assert "no attributed requests yet" in slo.tail_report()
+    assert slo.tail_json()["installed"] is False
+
+
+def test_tail_collector_install_reset_lifecycle():
+    c = slo.install_tail()
+    assert slo.get_tail() is c
+    assert eng.request_listeners() == [c._on_request]
+    c2 = slo.install_tail()  # replace: old listener detached
+    assert slo.get_tail() is c2
+    assert eng.request_listeners() == [c2._on_request]
+    slo.tail_reset()
+    assert slo.get_tail() is None
+    assert eng.request_listeners() == []
+
+
+def test_tail_wall_sum_property_clean_and_faulted(gpt):
+    """The acceptance invariant, engine-side: every terminal request's
+    attribution buckets sum to its wall time within 10% — on clean
+    traffic AND under a FaultPlan-delayed decode loop, where the
+    uniform per-step delay books as `decode` (steady inflation moves
+    the median, not the outlier carve). Warmed first (the AB arms run
+    warm replicas — AOT compile would otherwise pollute the first
+    batch's prefill), with max_slots covering the burst so every
+    request admits immediately — queued wait would book as
+    `replica_queue` and (correctly) outrank the decode buckets."""
+    e = eng.ServingEngine(gpt, max_slots=4, page_size=8,
+                          max_ctx=64, steps_per_sync=2).start()
+    plan = resilience.FaultPlan()
+    plan.delay("serving.engine_step", 0.05, times=10**9)
+    try:
+        rng = np.random.RandomState(7)
+        warm = [e.submit(rng.randint(0, 97, (6,)), 5)
+                for _ in range(2)]
+        for h in warm:
+            assert h.wait(300) and h.outcome == "completed"
+        slo.install_tail()
+        hs = [e.submit(rng.randint(0, 97, (6,)), 5) for _ in range(3)]
+        for h in hs:
+            assert h.wait(300) and h.outcome == "completed"
+        resilience.install_fault_plan(plan)
+        hs = [e.submit(rng.randint(0, 97, (6,)), 5) for _ in range(3)]
+        for h in hs:
+            assert h.wait(300) and h.outcome == "completed"
+    finally:
+        resilience.clear_fault_plan()
+        e.stop()
+    recs = slo.tail_records()
+    assert len(recs) == 6
+    for r in recs:
+        total = r["total_s"]
+        assert total > 0
+        assert set(r["attr"]) <= set(slo.LATENCY_ATTR)
+        assert sum(r["attr"].values()) == pytest.approx(
+            total, rel=0.10, abs=0.005)
+    # the faulted half's tail is decode-dominated
+    s = slo.tail_summary()
+    assert s["top"] in ("decode", "decode_stall")
+    slo.tail_reset()
+
+
+def test_trace_ctx_flow_step_emitted_per_replica():
+    """A timeline carrying a router-minted trace id emits ONE trace_ctx
+    't' flow step whose id is the trace string itself (cross-process
+    by design, unlike pid-scoped req_flow ids), bound inside the
+    request's first slice on this replica; traceless timelines emit
+    none."""
+    tl = {"id": 3, "outcome": "completed", "trace": "tabc-3",
+          "slot": 1, "prompt_tokens": 6, "new_tokens": 5,
+          "events": [("submit", 1.00, None), ("admit", 1.02, None),
+                     ("first_token", 1.05, None),
+                     ("terminal", 1.10, None)]}
+    evs = slo.request_trace_events([tl], [], pid=4242)
+    steps = [e for e in evs
+             if e.get("cat") == slo.TRACE_CTX_CAT and e["ph"] == "t"]
+    assert len(steps) == 1
+    st = steps[0]
+    assert st["id"] == "tabc-3" and st["pid"] == 4242
+    assert st["tid"] == slo.SLOT_TID_BASE + 1
+    pf = next(e for e in evs if e["name"] == "req 3 prefill")
+    assert pf["ts"] <= st["ts"] <= pf["ts"] + pf["dur"]
+    # a queued-only (never admitted) timeline binds on the queue track
+    tl2 = {"id": 4, "outcome": "rejected", "trace": "tabc-4",
+           "events": [("submit", 2.0, None), ("terminal", 2.1, None)]}
+    evs2 = slo.request_trace_events([tl2], [], pid=4242)
+    st2 = [e for e in evs2 if e.get("cat") == slo.TRACE_CTX_CAT]
+    assert len(st2) == 1 and st2[0]["tid"] == slo.QUEUE_TID
+    # no trace id -> no cross-process flow
+    tl3 = dict(tl, trace=None, id=5)
+    assert not [e for e in slo.request_trace_events([tl3], [], pid=1)
+                if e.get("cat") == slo.TRACE_CTX_CAT]

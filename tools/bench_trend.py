@@ -22,10 +22,12 @@ Record formats tolerated (all of which exist in the repo today):
 Direction is inferred from the record's `unit` (or the metric name):
 times ("s", "ms", "seconds", `*_ms`/`*_s` suffixes), memory
 footprints ("bytes" unit, `*_bytes` suffix — MEM_r*.json's region
-records), and serving latencies (any metric naming `ttft` or a
+records), serving latencies (any metric naming `ttft` or a
 `*_p50`/`*_p99` percentile — BENCHDEC_r06's engine TTFT records, even
-when unit-less) regress UP, everything else (throughput, ratios,
-ok-flags) regresses DOWN. Rate units ("tokens/s") always win over the
+when unit-less), and replica cold-start walls (any metric naming
+`startup`/`cold`/`spawn` — SERVE_r*.json's replica_startup_total_s /
+router_cold_spawn_first_token_s) regress UP, everything else
+(throughput, ratios, ok-flags) regresses DOWN. Rate units ("tokens/s") always win over the
 name heuristics, and SLO `attainment` metrics plus speculative-decode
 `accept`/`acceptance` rates are higher-is-better even though they may
 end in percentile-looking suffixes (`_pct`) — a drop in attainment or
@@ -58,8 +60,13 @@ LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_latency", "_bytes",
 #: wrote them unit-less; `dropped`/`lost`/`failover` are the router
 #: harness's loss-and-disruption counts (SERVE_rNN's
 #: router_lost_requests / router_failover_requests), where any rise —
-#: including zero-to-nonzero — is the regression
-LOWER_BETTER_SUBSTRINGS = ("ttft", "dropped", "lost", "failover")
+#: including zero-to-nonzero — is the regression;
+#: `startup`/`cold`/`spawn` are the replica cold-start observatory's
+#: wall times (SERVE_rNN's replica_startup_total_s /
+#: router_cold_spawn_first_token_s), where slower spin-up is the
+#: regression
+LOWER_BETTER_SUBSTRINGS = ("ttft", "dropped", "lost", "failover",
+                           "startup", "cold", "spawn")
 #: name substrings that mark a higher-is-better metric even when a
 #: lower-better suffix would otherwise match — SLO attainment records
 #: end in `_pct` (and the percentile suffixes), but a DROP in

@@ -18,17 +18,21 @@ literal, then fails if
      metric names (copy-pasted helps make /metrics output ambiguous;
      every name must describe itself), or
   5. a `reason=` / `phase=` / `bucket=` / `region=` / `op=` /
-     `outcome=` / `objective=` / `kv_dtype=` / `verdict=` / `replica=`
-     label value on a metric record call
+     `outcome=` / `objective=` / `kv_dtype=` / `verdict=` /
+     `replica=` / `attr=` label value on a metric record call
      (.inc/.set/.observe/.dec) does not come from a declared enum: these
      labels are CONTRACTUALLY low-cardinality (introspect.py's
      RECOMPILE_REASONS / COMPILE_PHASES, goodput.py's GOODPUT_BUCKETS,
      memory.py's MEM_REGIONS, watchdog.py's DEADLINE_OPS, observe.py's
      COMM_OPS, engine.py's REQUEST_OUTCOMES and KV_DTYPES, slo.py's
-     REQUEST_PHASES and SLO_OBJECTIVES, serving.py's KV_DTYPES and
+     REQUEST_PHASES / SLO_OBJECTIVES / LATENCY_ATTR — the tail
+     counter's `attr=` values are exactly the latency-attribution
+     buckets — serving.py's KV_DTYPES and
      SPEC_VERDICTS, router.py's ROUTE_REASONS / ROUTE_OUTCOMES /
-     REPLICA_STATES — the router's `reason=` values are exactly
-     shed / replica_dead / drain / retry_exhausted, and `replica=`
+     REPLICA_STATES / STARTUP_PHASES — the router's `reason=` values
+     are exactly shed / replica_dead / drain / retry_exhausted, the
+     cold-start histogram's `phase=` values are exactly
+     STARTUP_PHASES, and `replica=`
      names are allowed only from functions guarding against
      REPLICA_STATES, i.e. the bounded replica registry),
      so a string literal must be a
@@ -128,10 +132,12 @@ def registrations_in(path, tree=None):
 # objective: slo.py's SLO_OBJECTIVES; kv_dtype: serving.py's /
 # engine.py's KV_DTYPES; verdict: serving.py's SPEC_VERDICTS;
 # reason/outcome also: router.py's ROUTE_REASONS / ROUTE_OUTCOMES;
-# replica: router.py's bounded registry, guarded via REPLICA_STATES).
+# phase also: router.py's STARTUP_PHASES (cold-start observatory);
+# replica: router.py's bounded registry, guarded via REPLICA_STATES;
+# attr: slo.py's LATENCY_ATTR (tail-latency attribution buckets)).
 ENUM_LABEL_KWARGS = ("reason", "phase", "bucket", "region", "op",
                      "outcome", "objective", "kv_dtype", "verdict",
-                     "replica")
+                     "replica", "attr")
 RECORD_FUNCS = {"inc", "set", "observe", "dec"}
 
 # Rule 6: `host=` label values must originate in the cluster topology.
