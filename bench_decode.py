@@ -245,6 +245,13 @@ def main():
     floor_ms = per_step_bytes / (peak_bw * 1e9) * 1e3 if peak_bw else None
     step_ms = 1e3 / steps_s
     vs_roofline = (floor_ms / step_ms) if floor_ms else None
+    if floor_ms:
+        # register the implied decode ceiling (batch tokens per floor-
+        # bound step) so the capacity model's bandwidth wall holds the
+        # serving engine's measured decode tok/s against this chip's
+        # roofline instead of guessing
+        from singa_tpu import capacity
+        capacity.note_decode_floor(args.batch / (floor_ms / 1e3))
 
     if args.trace:
         from singa_tpu import xprof

@@ -94,6 +94,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "/fleetz": self._fleetz,
                 "/fleetz/trace": self._fleetz_trace,
                 "/routerz": self._routerz,
+                "/capacityz": self._capacityz,
                 "/tailz": self._tailz,
                 "/memz": self._memz,
                 "/slo": self._sloz,
@@ -123,6 +124,10 @@ class _Handler(BaseHTTPRequestHandler):
             "  /routerz      serving control plane: replica states, "
             "shed/failover/retry counters + recent request "
             "timelines; ?json=1 for the structured form\n"
+            "  /capacityz    capacity observatory: per-replica "
+            "headroom table, demand forecast, shadow-scaler "
+            "decision tail + counterfactual accuracy; ?json=1 for "
+            "the structured form\n"
             "  /tailz        tail-latency attribution: p99 "
             "contribution per LATENCY_ATTR bucket; ?json=1 for "
             "the structured form\n"
@@ -194,6 +199,11 @@ class _Handler(BaseHTTPRequestHandler):
             parts.append(slo.slo_report())
         except Exception as e:
             parts.append(f"(slo unavailable: {e})")
+        try:
+            from . import capacity
+            parts.append(capacity.capacity_report())
+        except Exception as e:
+            parts.append(f"(capacity unavailable: {e})")
         mon = self._monitor()
         if mon is None:
             parts.append("== health ==\nno HealthMonitor attached")
@@ -252,6 +262,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(router.router_json(), status=status)
             return
         self._send(router.router_report() + "\n", status=status)
+
+    def _capacityz(self, q):
+        """The capacity observatory (singa_tpu.capacity): the
+        per-replica headroom table naming each replica's binding wall,
+        the dual-EWMA demand forecast vs sustainable fleet RPS, the
+        shadow scaler's recent decision tail with reason codes, and
+        the counterfactual accuracy scorecard. `?json=1` returns the
+        scaler snapshot plus the full decision ring. 503 until a
+        ShadowScaler is installed."""
+        from . import capacity
+        status = 200 if capacity.get_scaler() is not None else 503
+        if (q.get("json") or ["0"])[0] not in ("0", "", "false"):
+            self._send_json(capacity.capacity_json(), status=status)
+        else:
+            self._send(capacity.capacity_report() + "\n", status=status)
 
     def _tailz(self, q):
         """Tail-latency attribution: every terminal request's wall

@@ -270,6 +270,18 @@ class ShardWriter:
         except Exception:
             serve = None
         lines.append({"kind": "fleet_serve", "serve": serve})
+        cap = None
+        try:
+            # this replica's own headroom row (singa_tpu.capacity):
+            # derived from the SAME serve signals the line above
+            # publishes, so the coordinator's headroom column
+            # reconciles against the shard by construction — plus the
+            # local shadow scaler's last decision when one is installed
+            from . import capacity
+            cap = capacity.fleet_capacity_snapshot()
+        except Exception:
+            cap = None
+        lines.append({"kind": "fleet_capacity", "capacity": cap})
         for rec in observe.span_records():
             lines.append({"kind": "fleet_span", "name": rec["name"],
                           "t0": rec["t0"], "dur": rec["dur"],
@@ -346,6 +358,8 @@ def read_shard(path: str) -> "dict | None":
                       if r.get("kind") == "fleet_hang"), None),
         "serve": next((r.get("serve") for r in rows
                        if r.get("kind") == "fleet_serve"), None),
+        "capacity": next((r.get("capacity") for r in rows
+                          if r.get("kind") == "fleet_capacity"), None),
         "spans": [r for r in rows if r.get("kind") == "fleet_span"],
     }
 
@@ -393,8 +407,8 @@ def merge_metric_snapshots(snaps: dict) -> dict:
 class _WorkerState:
     __slots__ = ("path", "host", "pid", "seq", "ts", "perf", "steps",
                  "started_ts", "metrics", "goodput", "health", "mem",
-                 "hang", "serve", "spans", "prev_ts", "prev_steps",
-                 "step_rate", "over_since")
+                 "hang", "serve", "capacity", "spans", "prev_ts",
+                 "prev_steps", "step_rate", "over_since")
 
     def __init__(self, path):
         self.path = path
@@ -411,6 +425,7 @@ class _WorkerState:
         self.mem = None   # per-host memory-ledger region snapshot
         self.hang = None  # per-host watchdog hang verdict (sticky)
         self.serve = None  # per-host serving snapshot (slo.fleet_serve)
+        self.capacity = None  # per-host headroom row (fleet_capacity)
         self.spans = {}   # (tid, t0, name) -> span rec, insertion-ordered
         self.prev_ts = None
         self.prev_steps = 0
@@ -514,6 +529,7 @@ class FleetAggregator:
             w.mem = shard.get("mem")
             w.hang = shard.get("hang")
             w.serve = shard.get("serve")
+            w.capacity = shard.get("capacity")
             if fresh and w.prev_ts and w.ts > w.prev_ts:
                 w.step_rate = max(
                     0.0, (w.steps - w.prev_steps) / (w.ts - w.prev_ts))
@@ -817,6 +833,7 @@ class FleetAggregator:
                         "slots": w.serve.get("slots"),
                         "page_util": w.serve.get("page_util"),
                         "kv_cache_bytes": w.serve.get("kv_cache_bytes"),
+                        "decode_tok_s": w.serve.get("decode_tok_s"),
                         "ttft_p50_s": w.serve.get("ttft_p50_s"),
                         "ttft_p99_s": w.serve.get("ttft_p99_s"),
                         "finished": w.serve.get("finished"),
@@ -830,6 +847,12 @@ class FleetAggregator:
                         # moment its engine stops admitting
                         "draining": bool(w.serve.get("draining")),
                     } if isinstance(w.serve, dict) else None,
+                    # the replica's own headroom row (fleet_capacity
+                    # shard line): binding wall + headroom for the
+                    # /fleetz column, last shadow decision when the
+                    # worker runs a scaler
+                    "capacity": dict(w.capacity)
+                    if isinstance(w.capacity, dict) else None,
                 })
             # worst-HBM host: max live bytes across workers that
             # published a memory snapshot (freshest shard per host
@@ -1132,9 +1155,13 @@ def fleet_report() -> str:
         lines.append(
             f"{'host':<12} {'rps':>7} {'queue':>6} {'occ':>7} "
             f"{'pages':>7} {'ttft_p50_ms':>12} {'ttft_p99_ms':>12} "
-            f"{'kv_mb':>8} {'slo_pct':>8} breaching")
+            f"{'kv_mb':>8} {'slo_pct':>8} {'headroom':>9} breaching")
         for r in serving:
             s = r["serve"]
+            cap = r.get("capacity") or {}
+            head = f"{100.0 * cap['headroom_frac']:.0f}%" \
+                   f"({cap.get('wall') or '-'})" \
+                if cap.get("headroom_frac") is not None else "-"
             occ = f"{s['occupancy']}/{s['slots']}" \
                 if s.get("slots") is not None else "-"
             pu = f"{100.0 * s['page_util']:.0f}%" \
@@ -1150,7 +1177,7 @@ def fleet_report() -> str:
             lines.append(
                 f"{r['host']:<12} {s.get('rps') or 0.0:>7.2f} "
                 f"{s.get('queue_depth') or 0:>6} {occ:>7} {pu:>7} "
-                f"{p50:>12} {p99:>12} {kv:>8} {att:>8} "
+                f"{p50:>12} {p99:>12} {kv:>8} {att:>8} {head:>9} "
                 f"{','.join(s.get('slo_breaching') or []) or 'none'}"
                 + (" [draining]" if s.get("draining") else ""))
     # the serving control plane, when one is installed in this process

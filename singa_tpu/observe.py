@@ -933,6 +933,18 @@ def record_checkpoint_bytes(nbytes: int):
           "bytes in the last checkpoint/snapshot flush").set(float(nbytes))
 
 
+def record_scaler_decision(rec: dict):
+    """Mirror one shadow-scaler decision record (singa_tpu.capacity's
+    ledger line) into the in-memory event ring and any attached
+    EventLog, so scaling decisions interleave with the step/serving/
+    bench records they were made from. Counters/gauges stay in
+    capacity._metrics — this is only the event-stream copy."""
+    if not _enabled:
+        return
+    # kind last: the ledger line carries its own kind ("decision")
+    _default.emit({**rec, "kind": "scaler_decision"})
+
+
 def record_bench(rec: dict):
     """Mirror a bench.py result record into the registry (gauges named
     singa_bench_<field>) and the EventLog, so BENCH_*.json artifacts and
@@ -962,6 +974,7 @@ __all__ = [
     "record_step", "record_step_build", "record_step_fenced",
     "record_compile", "record_hbm", "record_opt_update", "record_comm",
     "record_comm_host",
-    "record_decode", "record_bench", "record_checkpoint_bytes",
+    "record_decode", "record_bench", "record_scaler_decision",
+    "record_checkpoint_bytes",
     "record_prefetch", "record_ckpt_async",
 ]

@@ -254,6 +254,10 @@ class Replica:
         self.inflight: "set[int]" = set()
         self.dispatched = 0
         self.completed = 0
+        # dispatch/reject stamp rings — the /routerz admitted-RPS and
+        # shed-rate columns (and the capacity model's demand signals)
+        self.admit_times: "deque[float]" = deque(maxlen=1024)
+        self.shed_times: "deque[float]" = deque(maxlen=1024)
         self.joined_ts = time.monotonic()
         # liveness calibration over shard publish intervals
         self.last_seq = None
@@ -309,6 +313,11 @@ class Router:
         self._reasons = {r: 0 for r in ROUTE_REASONS}
         self._failovers = {REASON_REPLICA_DEAD: 0, REASON_DRAIN: 0}
         self._retries = 0
+        # front-door stamp rings: accepted submits and queue-full
+        # sheds — the router-level admitted-RPS / shed-rate the
+        # capacity forecaster feeds on
+        self._admit_times: "deque[float]" = deque(maxlen=4096)
+        self._shed_times: "deque[float]" = deque(maxlen=4096)
         # finished routed-request timelines (trace id, hop events,
         # LATENCY_ATTR decomposition) — the /routerz?json=1 surface
         self._timelines: "deque[dict]" = deque(maxlen=256)
@@ -484,8 +493,10 @@ class Router:
             elif len(self._queue) >= self.queue_limit:
                 shed_reason = REASON_SHED
                 detail = f"router queue full ({self.queue_limit})"
+                self._shed_times.append(time.monotonic())
             else:
                 shed_reason = None
+                self._admit_times.append(time.monotonic())
                 self._pending[req.id] = req
                 self._queue.append(req)
                 req.mark("queued", depth=len(self._queue))
@@ -732,6 +743,7 @@ class Router:
             with self._lock:
                 rep.inflight.add(req.id)
                 rep.dispatched += 1
+                rep.admit_times.append(time.monotonic())
             self._export_gauges()
             try:
                 out = self._dispatch(rep, req)
@@ -771,6 +783,12 @@ class Router:
                             rep,
                             f"dispatch failed ({out.get('detail')}) "
                             "and /healthz probe failed")
+            if cause == "retryable_reject":
+                # the replica turned the request away at ITS front
+                # door (queue full / draining): that is the per-
+                # replica shed signal the capacity table surfaces
+                with self._lock:
+                    rep.shed_times.append(time.monotonic())
             req.mark("failover", replica=rep.name, cause=cause,
                      detail=out.get("detail"),
                      probe_s=round(probe_s, 7),
@@ -876,6 +894,31 @@ class Router:
         with self._lock:
             return [dict(t) for t in self._timelines]
 
+    @staticmethod
+    def _rate(stamps: "deque[float]", window_s: float) -> float:
+        """Events/second over the trailing window of a monotonic stamp
+        ring, with the engine.rps short-span correction (a full ring
+        younger than the window covers less than `window_s`)."""
+        now = time.monotonic()
+        n = sum(1 for t in stamps if now - t <= window_s)
+        span = window_s
+        if stamps and len(stamps) == stamps.maxlen \
+                and now - stamps[0] < window_s:
+            span = max(now - stamps[0], 1e-6)
+        return n / span
+
+    def admit_rate(self, window_s: float = 10.0) -> float:
+        """Requests/second accepted at the front door over the
+        trailing window — the demand forecaster's arrival signal."""
+        with self._lock:
+            return self._rate(self._admit_times, window_s)
+
+    def shed_rate(self, window_s: float = 10.0) -> float:
+        """Requests/second shed at the front door (queue full) over
+        the trailing window."""
+        with self._lock:
+            return self._rate(self._shed_times, window_s)
+
     def snapshot(self) -> dict:
         with self._lock:
             reps = []
@@ -887,6 +930,10 @@ class Router:
                     "inflight": len(rep.inflight),
                     "dispatched": rep.dispatched,
                     "completed": rep.completed,
+                    "admitted_rps": round(
+                        self._rate(rep.admit_times, 10.0), 3),
+                    "shed_rate": round(
+                        self._rate(rep.shed_times, 10.0), 3),
                     "liveness_deadline_s": rep.liveness_deadline_s,
                 })
             return {
@@ -897,6 +944,10 @@ class Router:
                 "reasons": dict(self._reasons),
                 "failovers": dict(self._failovers),
                 "retries": self._retries,
+                "admitted_rps": round(
+                    self._rate(self._admit_times, 10.0), 3),
+                "shed_rate": round(
+                    self._rate(self._shed_times, 10.0), 3),
                 "replicas": reps,
             }
 
@@ -983,16 +1034,20 @@ def fleetz_lines() -> "list[str]":
         f"{reasons['shed']}   failover(replica_dead) "
         f"{s['failovers']['replica_dead']}   failover(drain) "
         f"{s['failovers']['drain']}   retry_exhausted "
-        f"{reasons['retry_exhausted']}   retries {s['retries']}",
+        f"{reasons['retry_exhausted']}   retries {s['retries']}   "
+        f"admitted {s['admitted_rps']:.2f}/s   shed "
+        f"{s['shed_rate']:.2f}/s",
         f"{'replica':<12} {'state':>9} {'inflight':>9} "
-        f"{'dispatched':>11} {'completed':>10} deadline",
+        f"{'dispatched':>11} {'completed':>10} {'admit/s':>8} "
+        f"{'shed/s':>7} deadline",
     ]
     for rep in s["replicas"]:
         dl = rep["liveness_deadline_s"]
         lines.append(
             f"{rep['name']:<12} {rep['state']:>9} "
             f"{rep['inflight']:>9} {rep['dispatched']:>11} "
-            f"{rep['completed']:>10} "
+            f"{rep['completed']:>10} {rep['admitted_rps']:>8.2f} "
+            f"{rep['shed_rate']:>7.2f} "
             + (f"{dl:.2f}s" if dl is not None else "uncalibrated"))
     return lines
 

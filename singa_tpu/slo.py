@@ -1183,6 +1183,7 @@ def fleet_serve_snapshot(max_timelines: int = _SHARD_TIMELINES,
     queue_depth = occupancy = slots = 0
     pages_in_use = pages_total = 0
     pool_bytes = 0
+    tok_s_parts = []
     ttfts = []
     finished = {}
     timelines = []
@@ -1199,6 +1200,8 @@ def fleet_serve_snapshot(max_timelines: int = _SHARD_TIMELINES,
         pool_bytes += r["pool_bytes"]
         for o, n in (r.get("finished") or {}).items():
             finished[o] = finished.get(o, 0) + n
+        if r.get("decode_tok_s") is not None:
+            tok_s_parts.append(r["decode_tok_s"])
         ttfts.extend(e.recent_ttfts())
         timelines.extend(e.timelines()[-max_timelines:])
         # IN-FLIGHT request timelines ride the shard too: when a
@@ -1249,6 +1252,10 @@ def fleet_serve_snapshot(max_timelines: int = _SHARD_TIMELINES,
         "page_util": round(pages_in_use / pages_total, 4)
         if pages_total else None,
         "kv_cache_bytes": kv_bytes,
+        # measured decode rate, for the capacity model's bandwidth
+        # wall (held against the roofline's bytes-per-token floor)
+        "decode_tok_s": round(sum(tok_s_parts), 3)
+        if tok_s_parts else None,
         "ttft_p50_s": engine_mod.pctile(ttfts, 0.5),
         "ttft_p99_s": engine_mod.pctile(ttfts, 0.99),
         "finished": finished,

@@ -52,14 +52,15 @@ def _metrics_isolation():
     HTTP ports, server threads, or span listeners — and (ISSUE-5)
     asserts the test left no async checkpoint pending, no prefetcher
     thread alive, and no stray non-daemon thread behind."""
-    from singa_tpu import (diag, engine, fleet, goodput, health,
-                           introspect, memory, observe, router, slo,
-                           watchdog)
+    from singa_tpu import (capacity, diag, engine, fleet, goodput,
+                           health, introspect, memory, observe, router,
+                           slo, watchdog)
     diag.stop_diag_server()
     goodput.uninstall()
     router.reset()
     fleet.uninstall()
     engine.reset()
+    capacity.reset()
     slo.reset()
     engine.clear_request_listeners()
     memory.reset()
@@ -142,6 +143,22 @@ def _metrics_isolation():
         "engine.remove_request_listener() (or register through "
         "slo.SLOTracker.install, which slo.reset() detaches) before "
         "the test ends")
+    # capacity teardown (ISSUE-17): the shadow scaler uninstalled —
+    # its singa-capacity-* poll thread joined and the JSONL decision
+    # ledger closed — and the measured decode floor dropped. Runs
+    # AFTER the SLO check (the scaler samples the tracker, never
+    # registers engine listeners) and before the generic stray-thread
+    # sweep. Capture-then-clean like the blocks above: the leak is
+    # recorded first and cleaned regardless, so one leaky test fails
+    # itself without cascading into the suite.
+    leaked_cap = [t.name for t in threading.enumerate()
+                  if t.is_alive()
+                  and t.name.startswith("singa-capacity")]
+    capacity.reset()
+    assert not leaked_cap, (
+        f"capacity poll thread(s) left running: {leaked_cap} — call "
+        "ShadowScaler.uninstall() (or capacity.reset()) before the "
+        "test ends")
     # memory-ledger teardown (ISSUE-9): the ledger uninstalled (its
     # step/span listeners detached, the sampler thread joined) and all
     # region providers/transient notes dropped. Leaked sampler threads

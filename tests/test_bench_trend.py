@@ -79,10 +79,15 @@ def test_regressions_flagged_against_best_prior_round():
     # a looser threshold forgives the throughput slide but not the
     # ok-flag collapse — nor the router reliability records (0->2 lost
     # is delta inf, 1->4 failovers is +300%; reliability slides are
-    # built to outlive any sane threshold)
+    # built to outlive any sane threshold) — nor the capacity
+    # observatory's oscillation/reaction counts (flaps 1->3, churn
+    # 3->6, delay 2->4: all at or beyond +100%)
     loose = bench_trend.find_regressions(table, threshold=0.5)
     assert {m for m, *_ in loose} == {"harn_ok", "router_lost_requests",
-                                      "router_failover_requests"}
+                                      "router_failover_requests",
+                                      "capacity_decision_flaps",
+                                      "capacity_decision_churn",
+                                      "capacity_scale_up_delay_polls"}
 
 
 def test_cli_exit_codes(capsys):
@@ -288,6 +293,50 @@ def test_startup_fixture_regression_flagged():
     assert abs(delta - 0.2) < 1e-9
     # the flat cold-spawn series is NOT flagged (2.4 -> 2.4)
     assert "router_cold_spawn_first_token_s" not in regs
+
+
+def test_capacity_metrics_directions():
+    """ISSUE-17 satellite: capacity `headroom` fractions are
+    higher-is-better (shrinking headroom at the same load is the
+    regression), while shadow-scaler oscillation (`flap`,
+    `decision_churn`) and reaction-time (`delay`) counts regress UP;
+    rate units still win over every name heuristic."""
+    assert not bench_trend.lower_is_better(
+        "capacity_cooldown_headroom_frac", "frac")
+    assert not bench_trend.lower_is_better("fleet_headroom_pct", "")
+    assert bench_trend.lower_is_better("capacity_decision_flaps",
+                                       "count")
+    assert bench_trend.lower_is_better("capacity_decision_churn", "")
+    assert bench_trend.lower_is_better("capacity_scale_up_delay_polls",
+                                       "polls")
+    assert not bench_trend.lower_is_better("decisions_per_s", "items/s")
+
+
+def test_capacity_fixture_regressions_flagged():
+    """The checked-in CAP fixture rounds carry the capacity
+    observatory's records: headroom up / flaps+churn+delay down in
+    clean/ (no flag), and in regress/ a headroom DROP (0.32 -> 0.24)
+    plus flap (1 -> 3), churn (3 -> 6), and delay (2 -> 4) RISES, all
+    flagged against the best prior round."""
+    clean = bench_trend.trend_table(bench_trend.collect([CLEAN]))
+    assert clean["capacity_cooldown_headroom_frac"]["by_round"] \
+        == {1: 0.30, 2: 0.32}
+    assert clean["capacity_decision_flaps"]["by_round"] == {1: 2.0,
+                                                           2: 1.0}
+    assert not [r for r in bench_trend.find_regressions(clean)
+                if r[0].startswith("capacity_")]
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = {m: (rnd, v, best_r, best, delta)
+            for m, rnd, v, best_r, best, delta
+            in bench_trend.find_regressions(table, threshold=0.05)}
+    rnd, v, best_r, best, delta = regs["capacity_cooldown_headroom_frac"]
+    assert (rnd, v, best_r, best) == (2, 0.24, 1, 0.32)
+    assert abs(delta - 0.08 / 0.32) < 1e-9
+    rnd, v, best_r, best, delta = regs["capacity_decision_flaps"]
+    assert (rnd, v, best_r, best) == (2, 3.0, 1, 1.0)
+    assert abs(delta - 2.0) < 1e-9
+    assert regs["capacity_decision_churn"][1] == 6.0
+    assert regs["capacity_scale_up_delay_polls"][1] == 4.0
 
 
 def test_router_loss_fixture_regression_flagged():

@@ -529,6 +529,95 @@ def test_slo_endpoint_golden_sections():
         slo.reset()
 
 
+def test_capacityz_without_scaler_is_503():
+    srv = observe.start_diag_server(port=0)
+    try:
+        st, _h, body = _get(srv, "/capacityz")
+        assert st == 503
+        assert "no ShadowScaler installed" in body
+        st, _h, body = _get(srv, "/capacityz?json=1")
+        assert st == 503
+        assert json.loads(body) == {"installed": False}
+    finally:
+        diag.stop_diag_server()
+
+
+def test_capacityz_golden_sections():
+    """ISSUE-17: /capacityz serves the fleet headroom line, the
+    per-replica table whose columns RECONCILE against the fleet-shard
+    serving signals it derives from (slots = occupancy/slots, pages =
+    page_util, headroom = 1 - the binding wall), the demand forecast,
+    the decision tail with enum reason codes, and the counterfactual
+    scorecard; ?json=1 is the structured form; /statusz grows the
+    `== capacity ==` section and the index advertises the endpoint."""
+    from singa_tpu import capacity
+    # a scripted 2-replica fleet: r00 slot-bound at 75%, r01
+    # page-bound at 60% — known signals the table must reconcile with
+    serves = [
+        {"slots": 4, "occupancy": 3, "page_util": 0.25,
+         "queue_depth": 0, "ttft_p99_s": None, "decode_tok_s": None,
+         "rps": 3.0},
+        {"slots": 4, "occupancy": 1, "page_util": 0.6,
+         "queue_depth": 0, "ttft_p99_s": None, "decode_tok_s": None,
+         "rps": 1.2},
+    ]
+
+    def sample():
+        return {"workers": [{"host": f"r{i:02d}", "serve": s,
+                             "stale": False}
+                            for i, s in enumerate(serves)],
+                "admitted_rps": 4.2, "burn_fast": 0.0,
+                "burn_slow": 0.0, "breaching": [], "shed_rate": 0.0}
+
+    clock = iter(float(i) for i in range(100))
+    s = capacity.ShadowScaler(sample=sample, interval_s=0.0,
+                              clock=lambda: next(clock))
+    s.install(poll=False)
+    srv = observe.start_diag_server(port=0)
+    try:
+        for _ in range(3):
+            s.evaluate()
+        st, _h, body = _get(srv, "/capacityz")
+        assert st == 200
+        assert "== capacity ==" in body
+        assert "fleet: 2 replica(s)" in body
+        # the headroom figures reconcile against the shard signals:
+        # r00's wall is slots at 3/4 (headroom 25%), r01's is pages at
+        # 60% (headroom 40%); the fleet line carries the binding
+        # replica's headroom and the summed sustainable rate
+        # (3/.75 + 1.2/.6 = 6 rps)
+        assert "headroom 25%" in body
+        assert "sustainable 6.00 rps" in body
+        r00 = next(ln for ln in body.splitlines()
+                   if ln.startswith("r00"))
+        assert "75%" in r00 and "slots" in r00 and "25%" in r00
+        r01 = next(ln for ln in body.splitlines()
+                   if ln.startswith("r01"))
+        assert "60%" in r01 and "pages" in r01 and "40%" in r01
+        assert "demand: fast" in body
+        assert "steady" in body          # the decision tail
+        assert "shadow accuracy:" in body
+        st, _h, body = _get(srv, "/capacityz?json=1")
+        assert st == 200
+        rep = json.loads(body)
+        assert rep["installed"] is True
+        assert rep["snapshot"]["assessment"]["headroom_frac"] == 0.25
+        assert rep["snapshot"]["assessment"]["replicas"][0]["wall"] \
+            == "slots"
+        assert rep["snapshot"]["assessment"]["replicas"][1]["wall"] \
+            == "pages"
+        assert len(rep["decisions"]) == 3
+        assert all(r["reason"] in capacity.DECISION_REASONS
+                   for r in rep["decisions"])
+        st, _h, body = _get(srv, "/statusz")
+        assert "== capacity ==" in body
+        _st, _h, idx = _get(srv, "/")
+        assert "/capacityz" in idx
+    finally:
+        diag.stop_diag_server()
+        capacity.reset()
+
+
 def test_statusz_serving_spec_lines(served):
     """ISSUE-13: the == serving == section renders the spec lines with
     the explicit no-data convention — 'spec: off' on a draftless

@@ -33,7 +33,7 @@ def _fleet_hygiene():
 
 def _write_fake_shard(fleet_dir, host, pid, seq=1, ts=None, perf=0.0,
                       spans=(), steps=0, metrics=None, goodput=None,
-                      name=None, mem=None, serve=None):
+                      name=None, mem=None, serve=None, capacity=None):
     """Hand-build one shard file in the documented format — the unit
     tests' stand-in for another process's ShardWriter (the writer end
     is covered by the round-trip test and the subprocess A/B)."""
@@ -47,7 +47,8 @@ def _write_fake_shard(fleet_dir, host, pid, seq=1, ts=None, perf=0.0,
              {"kind": "fleet_goodput", "goodput": goodput},
              {"kind": "fleet_health", "verdict": None},
              {"kind": "fleet_mem", "mem": mem},
-             {"kind": "fleet_serve", "serve": serve}]
+             {"kind": "fleet_serve", "serve": serve},
+             {"kind": "fleet_capacity", "capacity": capacity}]
     for nm, t0, dur, tid, kind in spans:
         lines.append({"kind": "fleet_span", "name": nm, "t0": t0,
                       "dur": dur, "tid": tid, "span_kind": kind})
@@ -667,6 +668,47 @@ def test_shard_carries_serve_and_fleetz_serving_columns(tmp_path):
     _write_fake_shard(d, "hostA", 100, seq=2, serve=None)
     _write_fake_shard(d, "hostB", 101, seq=2)
     assert "== fleet serving ==" not in fleet.fleet_report()
+
+
+def test_shard_carries_capacity_and_fleetz_headroom_column(tmp_path):
+    """ISSUE-17: the fleet_capacity shard line (this replica's own
+    headroom row, derived from the same serve signals its fleet_serve
+    line publishes) rides into the rollup, and /fleetz's serving table
+    grows the headroom column naming each replica's binding wall."""
+    d = str(tmp_path)
+    cap = {"headroom_frac": 0.25, "wall": "slots", "wall_util": 0.75,
+           "sustainable_rps": 4.667, "source": "measured",
+           "utils": {"slots": 0.75, "pages": 0.375, "queue": 0.5,
+                     "ttft": None, "bandwidth": None},
+           "rps": 3.5, "polls": 9, "decision": "hold",
+           "reason": "steady", "demand_rps": 3.1,
+           "accuracy": {"scored": 4, "tp": 1, "fp": 0, "fn": 0,
+                        "tn": 3, "precision": 1.0, "recall": 1.0}}
+    _write_fake_shard(d, "hostA", 100, steps=5, serve=_fake_serve(),
+                      capacity=cap)
+    _write_fake_shard(d, "hostB", 101, steps=5,
+                      serve=_fake_serve(rps=1.0, breaching=()))
+    agg = fleet.FleetAggregator(d)
+    roll = agg.poll()
+    by_host = {r["host"]: r for r in roll["workers"]}
+    assert by_host["hostA"]["capacity"]["headroom_frac"] == 0.25
+    assert by_host["hostA"]["capacity"]["wall"] == "slots"
+    assert by_host["hostB"]["capacity"] is None
+    fleet.install_aggregator(aggregator=agg)
+    rep = fleet.fleet_report()
+    assert "headroom" in rep
+    line = next(ln for ln in rep.splitlines()
+                if ln.startswith("hostA") and "3.50" in ln)
+    assert "25%(slots)" in line
+    # a worker without the line renders the explicit no-data dash
+    line_b = next(ln for ln in rep.splitlines()
+                  if ln.startswith("hostB") and "1.00" in ln)
+    assert " - " in line_b
+    # read_shard round-trips the line verbatim
+    shard = fleet.read_shard(by_host["hostA"]["path"]) \
+        if "path" in by_host["hostA"] else None
+    if shard is not None:
+        assert shard["capacity"] == cap
 
 
 def test_merged_trace_carries_request_flows_clock_aligned(tmp_path):

@@ -673,3 +673,70 @@ def test_lint_passes_tail_and_startup_registrations():
     assert {n for n, _f in regs} == {"singa_tail_seconds_total",
                                      "singa_replica_startup_seconds"}
     assert check_metrics_names.check(py_files) == []
+
+
+def test_lint_covers_capacity_metric_names():
+    """ISSUE-17: rule 5 extends to the capacity observatory's
+    `decision=` label (and its scaler `reason=` values) —
+    SCALE_DECISIONS / DECISION_REASONS are recognized as declared enum
+    tuples, every singa_capacity_* / singa_scaler_* registration in
+    capacity.py passes the full lint, and the new kwarg is enforced."""
+    cap_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                          "capacity.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(cap_py)}
+    assert {"singa_capacity_headroom_frac",
+            "singa_capacity_sustainable_rps",
+            "singa_capacity_demand_rps",
+            "singa_capacity_time_to_saturation_s",
+            "singa_capacity_polls_total",
+            "singa_scaler_decisions_total",
+            "singa_scaler_direction_changes_total",
+            "singa_capacity_shadow_precision",
+            "singa_capacity_shadow_recall"} <= names
+    assert all(n.startswith(("singa_capacity_", "singa_scaler_"))
+               for n in names)
+    assert check_metrics_names.check([cap_py]) == []
+    import ast
+    enums, _consts = check_metrics_names._module_enum_info(
+        ast.parse(open(cap_py).read()))
+    assert enums["SCALE_DECISIONS"] == ("scale_up", "scale_down",
+                                        "hold")
+    assert enums["DECISION_REASONS"] == (
+        "burn_sustained", "headroom_deficit", "burst_arrival",
+        "headroom_surplus", "cooldown", "damped", "steady",
+        "insufficient_data")
+    assert enums["CAPACITY_WALLS"] == ("slots", "pages", "queue",
+                                       "ttft", "bandwidth")
+    assert "decision" in check_metrics_names.ENUM_LABEL_KWARGS
+    assert "reason" in check_metrics_names.ENUM_LABEL_KWARGS
+
+
+def test_decision_and_scaler_reason_label_rules(tmp_path):
+    """A decision= literal outside SCALE_DECISIONS (or a scaler
+    reason= outside DECISION_REASONS) is a violation; members and
+    enum-guarded dynamic values — capacity.py's `assert rec[...] in
+    SCALE_DECISIONS` shape — pass, unguarded dynamics fail."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "SCALE_DECISIONS = ('scale_up', 'scale_down', 'hold')\n"
+        "DECISION_REASONS = ('burn_sustained', 'cooldown', 'steady')\n"
+        "observe.counter('singa_d_total', 'a')"
+        ".inc(decision='hold', reason='steady')\n"
+        "observe.counter('singa_d_total', 'a')"
+        ".inc(decision='scale_sideways')\n"
+        "observe.counter('singa_d_total', 'a')"
+        ".inc(decision='hold', reason='vibes')\n"
+        "def guarded(rec):\n"
+        "    assert rec['decision'] in SCALE_DECISIONS\n"
+        "    assert rec['reason'] in DECISION_REASONS\n"
+        "    observe.counter('singa_d_total', 'a')"
+        ".inc(decision=rec['decision'], reason=rec['reason'])\n"
+        "def unguarded(rec):\n"
+        "    observe.counter('singa_d_total', 'a')"
+        ".inc(decision=rec['decision'])\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 3, problems
+    assert any("'scale_sideways'" in p for p in problems)
+    assert any("'vibes'" in p for p in problems)
+    assert any("dynamic" in p for p in problems)

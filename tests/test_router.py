@@ -336,6 +336,32 @@ def test_reports_empty_without_router():
     assert "no Router installed" in rt.router_report()
 
 
+def test_admitted_and_shed_rates_surface(stubs):
+    """ISSUE-17 satellite: the router stamps front-door admissions and
+    sheds into monotonic rings, snapshot() carries admitted_rps /
+    shed_rate at both the router and per-replica level, and the
+    /routerz (/fleetz) table grows the admit/s + shed/s columns — the
+    capacity observatory's demand forecast reads these."""
+    r, _ = stubs
+    hs = [r.submit(np.array([3], np.int32), 2) for _ in range(4)]
+    for h in hs:
+        assert h.wait(30) and h.outcome == "completed"
+    assert r.admit_rate(60.0) > 0.0
+    assert r.shed_rate(60.0) == 0.0
+    s = r.snapshot()
+    assert s["admitted_rps"] > 0.0 and s["shed_rate"] == 0.0
+    for rep in s["replicas"]:
+        assert "admitted_rps" in rep and "shed_rate" in rep
+    # the dispatched counts are distributed over the replicas: the
+    # per-replica admission rates sum to (about) the front door's
+    assert sum(rep["admitted_rps"] for rep in s["replicas"]) > 0.0
+    lines = rt.fleetz_lines()
+    assert any("admitted" in ln and "shed" in ln for ln in lines)
+    head = next(ln for ln in lines if "admit/s" in ln)
+    assert "shed/s" in head
+    assert "admit/s" in rt.router_report()
+
+
 # ---- real-engine integration ----------------------------------------------
 
 def test_router_matches_direct_engine_tokens():

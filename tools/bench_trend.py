@@ -26,12 +26,16 @@ records), serving latencies (any metric naming `ttft` or a
 `*_p50`/`*_p99` percentile — BENCHDEC_r06's engine TTFT records, even
 when unit-less), and replica cold-start walls (any metric naming
 `startup`/`cold`/`spawn` — SERVE_r*.json's replica_startup_total_s /
-router_cold_spawn_first_token_s) regress UP, everything else
+router_cold_spawn_first_token_s), and shadow-scaler oscillation counts
+(any metric naming `flap` or `decision_churn` — CAPACITY_r*.json's
+capacity_decision_flaps) regress UP, everything else
 (throughput, ratios, ok-flags) regresses DOWN. Rate units ("tokens/s") always win over the
 name heuristics, and SLO `attainment` metrics plus speculative-decode
-`accept`/`acceptance` rates are higher-is-better even though they may
-end in percentile-looking suffixes (`_pct`) — a drop in attainment or
-acceptance is the regression (SLO_r*.json / BENCHDEC_r07 records).
+`accept`/`acceptance` rates and capacity `headroom` fractions are
+higher-is-better even though they may
+end in percentile-looking suffixes (`_pct`) — a drop in attainment,
+acceptance, or headroom is the regression (SLO_r*.json / BENCHDEC_r07
+/ CAPACITY_r*.json records).
 
 Usage: `python tools/bench_trend.py [DIR|FILES...] [--threshold 0.05]`
 (default DIR = the repo root). `--latest-only` restricts regression
@@ -65,15 +69,23 @@ LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_latency", "_bytes",
 #: wall times (SERVE_rNN's replica_startup_total_s /
 #: router_cold_spawn_first_token_s), where slower spin-up is the
 #: regression
+#: `flap`/`decision_churn` are the capacity observatory's shadow-
+#: scaler oscillation counts (CAPACITY_rNN's capacity_decision_flaps),
+#: where any rise means the hysteresis got worse at damping bursts
+#: `delay` covers reaction-time counts like CAPACITY_rNN's
+#: capacity_scale_up_delay_polls — reacting later is the regression
 LOWER_BETTER_SUBSTRINGS = ("ttft", "dropped", "lost", "failover",
-                           "startup", "cold", "spawn")
+                           "startup", "cold", "spawn", "flap",
+                           "decision_churn", "delay")
 #: name substrings that mark a higher-is-better metric even when a
 #: lower-better suffix would otherwise match — SLO attainment records
 #: end in `_pct` (and the percentile suffixes), but a DROP in
 #: attainment is the regression; speculative-decoding `accept`/
 #: `acceptance` rates (BENCHDEC_r07's spec records) likewise regress
-#: DOWN even when written unit-less or percentile-suffixed
-HIGHER_BETTER_SUBSTRINGS = ("attainment", "accept")
+#: DOWN even when written unit-less or percentile-suffixed; capacity
+#: `headroom` fractions (CAPACITY_rNN) regress DOWN too — shrinking
+#: headroom at the same load is the capacity regression
+HIGHER_BETTER_SUBSTRINGS = ("attainment", "accept", "headroom")
 
 
 def parse_records(path: str, family: str):

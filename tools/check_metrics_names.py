@@ -19,7 +19,7 @@ literal, then fails if
      every name must describe itself), or
   5. a `reason=` / `phase=` / `bucket=` / `region=` / `op=` /
      `outcome=` / `objective=` / `kv_dtype=` / `verdict=` /
-     `replica=` / `attr=` label value on a metric record call
+     `replica=` / `attr=` / `decision=` label value on a metric record call
      (.inc/.set/.observe/.dec) does not come from a declared enum: these
      labels are CONTRACTUALLY low-cardinality (introspect.py's
      RECOMPILE_REASONS / COMPILE_PHASES, goodput.py's GOODPUT_BUCKETS,
@@ -34,7 +34,10 @@ literal, then fails if
      cold-start histogram's `phase=` values are exactly
      STARTUP_PHASES, and `replica=`
      names are allowed only from functions guarding against
-     REPLICA_STATES, i.e. the bounded replica registry),
+     REPLICA_STATES, i.e. the bounded replica registry, and
+     capacity.py's SCALE_DECISIONS / DECISION_REASONS — the shadow
+     scaler's `decision=` values are exactly scale_up / scale_down /
+     hold and its `reason=` values the fixed reason-code enum),
      so a string literal must be a
      member of a module-level ALL-CAPS tuple of string literals, a NAME
      must be a module-level constant whose value is a member, and a
@@ -134,10 +137,12 @@ def registrations_in(path, tree=None):
 # reason/outcome also: router.py's ROUTE_REASONS / ROUTE_OUTCOMES;
 # phase also: router.py's STARTUP_PHASES (cold-start observatory);
 # replica: router.py's bounded registry, guarded via REPLICA_STATES;
-# attr: slo.py's LATENCY_ATTR (tail-latency attribution buckets)).
+# attr: slo.py's LATENCY_ATTR (tail-latency attribution buckets);
+# decision: capacity.py's SCALE_DECISIONS, with the shadow scaler's
+# reason= values from capacity.py's DECISION_REASONS).
 ENUM_LABEL_KWARGS = ("reason", "phase", "bucket", "region", "op",
                      "outcome", "objective", "kv_dtype", "verdict",
-                     "replica", "attr")
+                     "replica", "attr", "decision")
 RECORD_FUNCS = {"inc", "set", "observe", "dec"}
 
 # Rule 6: `host=` label values must originate in the cluster topology.
