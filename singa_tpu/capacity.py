@@ -313,7 +313,13 @@ class DemandForecaster:
     spacing handled via alpha = 1 - exp(-dt/tau)). A BURST is the fast
     estimate pulling `burst_ratio`x away from the slow one above a
     floor rate. `time_to_saturation` linearizes the fast-slow gap into
-    a growth slope and runs it forward to the capacity line."""
+    a growth slope and runs it forward to the capacity line.
+
+    The admission-rate samples come from the router's admit stamps,
+    which EXCLUDE synthetic traffic (audit canary probes and shadow
+    replays never stamp admit_times — singa_tpu.audit's exclusion
+    contract): the forecast tracks real demand only, so a probe storm
+    can never look like a burst or trigger a scale-up."""
 
     def __init__(self, *, fast_tau_s=2.0, slow_tau_s=10.0,
                  burst_ratio=1.5, min_rate=0.1):

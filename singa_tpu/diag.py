@@ -95,6 +95,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "/fleetz/trace": self._fleetz_trace,
                 "/routerz": self._routerz,
                 "/capacityz": self._capacityz,
+                "/auditz": self._auditz,
                 "/tailz": self._tailz,
                 "/memz": self._memz,
                 "/slo": self._sloz,
@@ -128,6 +129,10 @@ class _Handler(BaseHTTPRequestHandler):
             "headroom table, demand forecast, shadow-scaler "
             "decision tail + counterfactual accuracy; ?json=1 for "
             "the structured form\n"
+            "  /auditz       correctness observatory: per-layer-group "
+            "param fingerprint, canary/replay verdict table per "
+            "replica, quarantine ledger; ?json=1 for the structured "
+            "form\n"
             "  /tailz        tail-latency attribution: p99 "
             "contribution per LATENCY_ATTR bucket; ?json=1 for "
             "the structured form\n"
@@ -204,6 +209,11 @@ class _Handler(BaseHTTPRequestHandler):
             parts.append(capacity.capacity_report())
         except Exception as e:
             parts.append(f"(capacity unavailable: {e})")
+        try:
+            from . import audit
+            parts.append(audit.audit_report())
+        except Exception as e:
+            parts.append(f"(audit unavailable: {e})")
         mon = self._monitor()
         if mon is None:
             parts.append("== health ==\nno HealthMonitor attached")
@@ -277,6 +287,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(capacity.capacity_json(), status=status)
         else:
             self._send(capacity.capacity_report() + "\n", status=status)
+
+    def _auditz(self, q):
+        """The serving correctness observatory (singa_tpu.audit): this
+        process's per-layer-group param-integrity fingerprint, the
+        per-replica canary/replay verdict table with mismatch streaks
+        and first-divergence positions, and the quarantine ledger.
+        `?json=1` returns the structured form. 503 until a
+        fingerprinter or observatory is installed."""
+        from . import audit
+        status = 200 if (audit.get_fingerprinter() is not None
+                         or audit.get_observatory() is not None) \
+            else 503
+        if (q.get("json") or ["0"])[0] not in ("0", "", "false"):
+            self._send_json(audit.audit_json(), status=status)
+            return
+        self._send(audit.audit_report() + "\n", status=status)
 
     def _tailz(self, q):
         """Tail-latency attribution: every terminal request's wall

@@ -282,6 +282,18 @@ class ShardWriter:
         except Exception:
             cap = None
         lines.append({"kind": "fleet_capacity", "capacity": cap})
+        aud = None
+        try:
+            # this replica's param-integrity fingerprint
+            # (singa_tpu.audit): the aggregator majority-votes these
+            # across replicas serving the same model and flags a
+            # dissenter (silent data corruption) with the first
+            # diverging layer-group named
+            from . import audit
+            aud = audit.fleet_audit_snapshot()
+        except Exception:
+            aud = None
+        lines.append({"kind": "fleet_audit", "audit": aud})
         for rec in observe.span_records():
             lines.append({"kind": "fleet_span", "name": rec["name"],
                           "t0": rec["t0"], "dur": rec["dur"],
@@ -360,6 +372,8 @@ def read_shard(path: str) -> "dict | None":
                        if r.get("kind") == "fleet_serve"), None),
         "capacity": next((r.get("capacity") for r in rows
                           if r.get("kind") == "fleet_capacity"), None),
+        "audit": next((r.get("audit") for r in rows
+                       if r.get("kind") == "fleet_audit"), None),
         "spans": [r for r in rows if r.get("kind") == "fleet_span"],
     }
 
@@ -407,8 +421,8 @@ def merge_metric_snapshots(snaps: dict) -> dict:
 class _WorkerState:
     __slots__ = ("path", "host", "pid", "seq", "ts", "perf", "steps",
                  "started_ts", "metrics", "goodput", "health", "mem",
-                 "hang", "serve", "capacity", "spans", "prev_ts",
-                 "prev_steps", "step_rate", "over_since")
+                 "hang", "serve", "capacity", "audit", "spans",
+                 "prev_ts", "prev_steps", "step_rate", "over_since")
 
     def __init__(self, path):
         self.path = path
@@ -426,6 +440,7 @@ class _WorkerState:
         self.hang = None  # per-host watchdog hang verdict (sticky)
         self.serve = None  # per-host serving snapshot (slo.fleet_serve)
         self.capacity = None  # per-host headroom row (fleet_capacity)
+        self.audit = None  # per-host param fingerprint (fleet_audit)
         self.spans = {}   # (tid, t0, name) -> span rec, insertion-ordered
         self.prev_ts = None
         self.prev_steps = 0
@@ -477,6 +492,13 @@ class FleetAggregator:
         # episode triggers exactly ONE coordinated abort-and-restore.
         self._peer_hang: "dict | None" = None
         self._hang_seen: "set[tuple]" = set()
+        # fingerprint vote: host -> dissent info while the host's
+        # param fingerprint disagrees with the fleet majority;
+        # `_audit_seen` de-duplicates the once-per-episode emit by
+        # (host, fingerprint) so a persisting corruption logs once but
+        # keeps feeding the observatory's streak every poll
+        self._audit_dissent: "dict[str, dict]" = {}
+        self._audit_seen: "set[tuple]" = set()
         self._last_poll = 0.0
         self.started_mono = time.monotonic()
         self._poll_stop = threading.Event()
@@ -530,6 +552,7 @@ class FleetAggregator:
             w.hang = shard.get("hang")
             w.serve = shard.get("serve")
             w.capacity = shard.get("capacity")
+            w.audit = shard.get("audit")
             if fresh and w.prev_ts and w.ts > w.prev_ts:
                 w.step_rate = max(
                     0.0, (w.steps - w.prev_steps) / (w.ts - w.prev_ts))
@@ -687,6 +710,104 @@ class FleetAggregator:
                               "score": round(score, 4),
                               "ts": round(time.time(), 6)}
 
+    def _audit_vote_locked(self):
+        """Majority-vote the param-integrity fingerprints (the
+        fleet_audit shard line, singa_tpu.audit) across hosts serving
+        the same model. A host whose fingerprint disagrees with a
+        STRICT majority (> half of >= 3 voters — two replicas cannot
+        outvote each other, and without a majority nobody is convicted)
+        is a dissenter: silent data corruption, flagged with the first
+        diverging layer-group named. Returns the dissent list for
+        _apply_audit (outside the lock)."""
+        fps = {}
+        freshest = {}
+        for w in self._workers.values():
+            a = w.audit
+            if w.host is None or not isinstance(a, dict):
+                continue
+            fp = a.get("fingerprint")
+            if not fp:
+                continue
+            # newest publish owns a host's vote (dead incarnation's
+            # file next to its relaunch — same rule as _score_locked)
+            if w.host not in freshest or w.ts > freshest[w.host]:
+                freshest[w.host] = w.ts
+                try:
+                    fps[w.host] = tuple(
+                        (str(g), int(v)) for g, v in fp)
+                except (TypeError, ValueError):
+                    continue
+        self._audit_dissent = {}
+        if len(fps) < 3:
+            return []
+        counts = {}
+        for fp in fps.values():
+            counts[fp] = counts.get(fp, 0) + 1
+        majority_fp, n = max(counts.items(), key=lambda kv: kv[1])
+        if n <= len(fps) // 2:
+            return []
+        fired = []
+        for hostname, fp in sorted(fps.items()):
+            if fp == majority_fp:
+                continue
+            first = next(
+                (g for (g, v), (_, mv) in zip(fp, majority_fp)
+                 if v != mv), None)
+            info = {"first_group": first, "voters": len(fps),
+                    "majority": n}
+            self._audit_dissent[hostname] = info
+            fired.append((hostname, fp, info))
+        return fired
+
+    def _apply_audit(self, fired):
+        """Outside the lock: feed each fingerprint dissenter into the
+        audit observatory (which owns sustain + quarantine) — EVERY
+        poll while the dissent persists, so the observatory's streak
+        builds at poll cadence; the EventLog record and the
+        no-observatory health-note fallback fire once per (host,
+        fingerprint) episode."""
+        if not fired:
+            return
+        from . import audit as audit_mod
+        local = distributed.host_label()
+        obs = audit_mod.get_observatory()
+        mon = health.active_monitor()
+        for hostname, fp, info in fired:
+            key = (hostname, fp)
+            new = key not in self._audit_seen
+            if new:
+                self._audit_seen.add(key)
+                if observe.is_enabled():
+                    observe.get_registry().emit(
+                        {"kind": "audit",
+                         "event": "fingerprint_dissent",
+                         "host": hostname, "coordinator": local,
+                         **info})
+            detail = (f"fingerprint dissent: first diverging group "
+                      f"{info['first_group']} "
+                      f"({info['majority']}/{info['voters']} voters "
+                      f"agree)")
+            if obs is not None:
+                obs.note(hostname, audit_mod.LEG_FINGERPRINT,
+                         audit_mod.VERDICT_MISMATCH, detail=detail)
+            elif new and mon is not None:
+                try:
+                    # a verdict is health state, not telemetry: even
+                    # without an observatory the dissent must reach
+                    # /healthz
+                    mon.note_external(
+                        health.KIND_DIVERGENCE,
+                        detail={"host": hostname, **info},
+                        action="warn")
+                except Exception:
+                    pass  # the monitor must not break the aggregator
+
+    def audit_dissent(self) -> dict:
+        """host -> dissent info for hosts currently outvoted on their
+        param fingerprint (empty when the fleet agrees)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._audit_dissent.items()}
+
     def _hangs_locked(self):
         """Advance peer-hang state: a worker whose shard carries an
         abort-stage watchdog verdict is WEDGED (it could not step at
@@ -733,10 +854,12 @@ class FleetAggregator:
                 if w.host is not None
                 and now_epoch - w.ts > self.stale_after_s}
             fired = self._verdicts_locked()
+            audit_fired = self._audit_vote_locked()
             self._export_locked(now_epoch)
             self._last_poll = time.monotonic()
         _agg_metrics()["polls"].inc()
         self._apply_policy(fired)
+        self._apply_audit(audit_fired)
         return self.rollup()
 
     def poll_if_due(self):
@@ -853,6 +976,17 @@ class FleetAggregator:
                     # worker runs a scaler
                     "capacity": dict(w.capacity)
                     if isinstance(w.capacity, dict) else None,
+                    # param-integrity audit (fleet_audit shard line):
+                    # the fingerprint itself plus this poll's vote
+                    # outcome for the /fleetz integrity column
+                    "audit": {
+                        "fingerprint": list(
+                            w.audit.get("fingerprint") or []),
+                        "count": w.audit.get("count"),
+                        "dissent": dict(
+                            self._audit_dissent.get(w.host) or {})
+                        or None,
+                    } if isinstance(w.audit, dict) else None,
                 })
             # worst-HBM host: max live bytes across workers that
             # published a memory snapshot (freshest shard per host
@@ -877,6 +1011,8 @@ class FleetAggregator:
                                  and r["hang"].get("stage") == "abort"),
                 "halt": self._halt,
                 "peer_hang": self._peer_hang,
+                "audit_dissent": {k: dict(v) for k, v
+                                  in self._audit_dissent.items()},
                 "worst_mem_host": worst["host"] if worst else None,
                 "worst_mem_bytes": worst["mem_bytes"] if worst else None,
                 "metrics": merged,
@@ -1180,11 +1316,40 @@ def fleet_report() -> str:
                 f"{p50:>12} {p99:>12} {kv:>8} {att:>8} {head:>9} "
                 f"{','.join(s.get('slo_breaching') or []) or 'none'}"
                 + (" [draining]" if s.get("draining") else ""))
+    audited = [r for r in roll["workers"] if r.get("audit")]
+    if audited:
+        # the correctness columns: each replica's fingerprint (folded
+        # to one word for the table; /auditz has the per-group view)
+        # and the vote outcome — a dissenter names its first diverging
+        # layer group right here
+        lines.append("== fleet integrity ==")
+        lines.append(f"{'host':<12} {'fingerprint':>12} {'checks':>7} "
+                     f"vote")
+        for r in audited:
+            a = r["audit"]
+            folded = 0
+            for _, v in (a.get("fingerprint") or []):
+                folded = (folded * 16777619) ^ int(v)
+                folded &= 0xFFFFFFFF
+            d = a.get("dissent")
+            vote = (f"DISSENT (first diverging group: "
+                    f"{d.get('first_group')}, "
+                    f"{d.get('majority')}/{d.get('voters')} against)"
+                    if d else "agree")
+            lines.append(f"{r['host']:<12} {folded:>#12x} "
+                         f"{a.get('count') or 0:>7} {vote}")
     # the serving control plane, when one is installed in this process
     # (the router coordinator is usually also the fleet coordinator)
     try:
         from . import router as _router_mod
         lines.extend(_router_mod.fleetz_lines())
+    except Exception:
+        pass
+    # the correctness observatory's canary/replay verdict columns,
+    # when one is installed in this process
+    try:
+        from . import audit as _audit_mod
+        lines.extend(_audit_mod.fleetz_lines())
     except Exception:
         pass
     steps_total = 0

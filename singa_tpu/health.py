@@ -65,6 +65,7 @@ KIND_STRAGGLER = "straggler"  # fleet sustained-straggler verdict
 KIND_MEM_LEAK = "mem_leak"    # memory-ledger sustained-growth verdict
 KIND_HANG = "hang"            # watchdog deadline-breach abort verdict
 KIND_SLO = "slo"              # SLO tracker sustained burn-rate breach
+KIND_DIVERGENCE = "divergence"  # audit correctness verdict (wrong tokens)
 
 
 class HealthError(RuntimeError):
@@ -638,6 +639,7 @@ def record_nan_logits(n: int, kind: str):
 __all__ = [
     "POLICIES", "HealthError", "StepStatsCollector", "collector",
     "KIND_STRAGGLER", "KIND_MEM_LEAK", "KIND_HANG", "KIND_SLO",
+    "KIND_DIVERGENCE",
     "apply_skip", "FlightRecorder", "load_flight_bundle", "HealthMonitor",
     "record_nan_logits", "set_active_monitor", "active_monitor",
 ]

@@ -101,6 +101,10 @@ class FaultPlan:
       - "serving.decode" (serving decode) — inside the decode guard
       - "serving.engine_step" (engine.ServingEngine decode loop) —
                      inside the decode guard, before each decode sync
+      - "audit.corrupt_params" (audit.ParamFingerprinter.tick) — a
+                     `fail` rule here bit-flips one param layer (the
+                     injected silent-data-corruption the correctness
+                     observatory must detect from the outside)
 
     A `delay(...)` at any of these points is the deterministic stand-in
     for a wedged operation: it stalls inside the watchdog guard that

@@ -195,7 +195,7 @@ class RouterRequest:
     __slots__ = ("id", "prompt", "max_new", "submitted", "finished_ts",
                  "outcome", "reason", "detail", "tokens", "replica",
                  "attempts", "ttft_s", "events", "trace",
-                 "replica_attr", "attr", "_done")
+                 "replica_attr", "attr", "synthetic", "_done")
 
     def __init__(self, rid: int, prompt, max_new: int):
         self.id = rid
@@ -217,6 +217,7 @@ class RouterRequest:
         self.trace = None        # fleet-unique trace-context id
         self.replica_attr = None  # winning replica's LATENCY_ATTR split
         self.attr = None          # full route decomposition at terminal
+        self.synthetic = False    # audit probe: excluded from RPS stamps
         self._done = threading.Event()
 
     def mark(self, event: str, **info):
@@ -321,6 +322,10 @@ class Router:
         # finished routed-request timelines (trace id, hop events,
         # LATENCY_ATTR decomposition) — the /routerz?json=1 surface
         self._timelines: "deque[dict]" = deque(maxlen=256)
+        # terminal-request listeners: (RouterRequest, timeline dict)
+        # per terminal — the audit ShadowReplayer samples real
+        # completed requests here (mirror of engine's listener list)
+        self._request_listeners: "list" = []
         # balance on the installed aggregator when there is one (the
         # --ab coordinator installs it so /fleetz works too); otherwise
         # a private one over fleet_dir, polled from the health loop
@@ -441,14 +446,20 @@ class Router:
         router-side in-flight set to clear (the handed-back requests
         re-route themselves to surviving replicas), then optionally
         shut the replica process down. Returns the replica's drain
-        response (handed_back ids etc.)."""
+        response (handed_back ids etc.).
+
+        Idempotent/re-entrant: a second call while the replica is
+        already draining — or after it is dead — is a NO-OP returning
+        {"noop": True, "state": ...}. The audit quarantine poll loop
+        re-fires the same verdict until the episode clears, so the
+        drain it drives must tolerate being asked twice."""
         rep = self.get_replica(name)
         if rep is None:
             raise ValueError(f"no replica {name!r}")
         with self._lock:
             if rep.state != STATE_LIVE:
-                raise ValueError(
-                    f"replica {name!r} is {rep.state}, not live")
+                return {"noop": True, "replica": rep.name,
+                        "state": rep.state}
             rep.state = STATE_DRAINING
             rep.state_detail = "drain requested"
         self._export_gauges()
@@ -476,13 +487,20 @@ class Router:
         return out
 
     # -- submission --------------------------------------------------------
-    def submit(self, prompt, max_new: int) -> RouterRequest:
+    def submit(self, prompt, max_new: int, *,
+               synthetic: bool = False) -> RouterRequest:
         """Route one greedy request. Returns the handle immediately; a
         full router queue (or a stopped router) REJECTS it on the spot
-        — reason "shed" / "drain" — instead of queueing unboundedly."""
+        — reason "shed" / "drain" — instead of queueing unboundedly.
+        `synthetic` marks an audit canary/replay probe: it rides the
+        identical dispatch path (that is the point — a canary that
+        skips the front door proves nothing) but never stamps the
+        admit/shed RPS windows, so `/routerz` admitted-RPS and the
+        capacity forecaster's arrival signal see only real demand."""
         with self._lock:
             self._rid += 1
             req = RouterRequest(self._rid, prompt, max_new)
+            req.synthetic = bool(synthetic)
             # the fleet-unique trace context, minted at the front door:
             # pid-scoped so two routers (tests, a restart) never
             # collide, carried through every dispatch into the winning
@@ -493,10 +511,12 @@ class Router:
             elif len(self._queue) >= self.queue_limit:
                 shed_reason = REASON_SHED
                 detail = f"router queue full ({self.queue_limit})"
-                self._shed_times.append(time.monotonic())
+                if not req.synthetic:
+                    self._shed_times.append(time.monotonic())
             else:
                 shed_reason = None
-                self._admit_times.append(time.monotonic())
+                if not req.synthetic:
+                    self._admit_times.append(time.monotonic())
                 self._pending[req.id] = req
                 self._queue.append(req)
                 req.mark("queued", depth=len(self._queue))
@@ -540,6 +560,7 @@ class Router:
         total_s = round(req.finished_ts - req.submitted, 6)
         tlrec = {
             "id": req.id, "trace": req.trace, "outcome": outcome,
+            "synthetic": bool(req.synthetic),
             "reason": reason, "detail": detail, "replica": replica,
             "attempts": req.attempts, "ttft_s": req.ttft_s,
             "submitted": round(req.submitted, 7),
@@ -564,7 +585,23 @@ class Router:
                 "outcome": outcome, "reason": reason,
                 "replica": replica, "attempts": req.attempts,
                 "detail": detail})
+        for cb in tuple(self._request_listeners):
+            try:
+                cb(req, tlrec)
+            except Exception:
+                pass  # a listener must never break the routing path
         req._done.set()
+
+    def add_request_listener(self, cb):
+        """Register `cb(RouterRequest, timeline_dict)` called on every
+        terminal routed request (after the timeline is booked, before
+        the waiter wakes). Exceptions are swallowed."""
+        if cb not in self._request_listeners:
+            self._request_listeners.append(cb)
+
+    def remove_request_listener(self, cb):
+        if cb in self._request_listeners:
+            self._request_listeners.remove(cb)
 
     # -- dispatch ----------------------------------------------------------
     def _dispatch_loop(self):
@@ -651,6 +688,8 @@ class Router:
                    "prompt": [int(t) for t in req.prompt],
                    "max_new": req.max_new, "wait_s": self.poll_wait_s,
                    "trace": req.trace}
+        if req.synthetic:
+            payload["synthetic"] = True
         path = "/submit"
         # once a poll round returned "pending" the replica had ACCEPTED
         # the work (an engine request exists, tokens may be flowing) —
@@ -743,7 +782,8 @@ class Router:
             with self._lock:
                 rep.inflight.add(req.id)
                 rep.dispatched += 1
-                rep.admit_times.append(time.monotonic())
+                if not req.synthetic:
+                    rep.admit_times.append(time.monotonic())
             self._export_gauges()
             try:
                 out = self._dispatch(rep, req)
@@ -787,8 +827,9 @@ class Router:
                 # the replica turned the request away at ITS front
                 # door (queue full / draining): that is the per-
                 # replica shed signal the capacity table surfaces
-                with self._lock:
-                    rep.shed_times.append(time.monotonic())
+                if not req.synthetic:
+                    with self._lock:
+                        rep.shed_times.append(time.monotonic())
             req.mark("failover", replica=rep.name, cause=cause,
                      detail=out.get("detail"),
                      probe_s=round(probe_s, 7),
@@ -1256,10 +1297,11 @@ class ReplicaControl:
                 req = self.eng.submit(
                     np.asarray(body["prompt"], np.int32),
                     int(body["max_new"]),
-                    trace_ctx=body.get("trace"))
+                    trace_ctx=body.get("trace"),
+                    synthetic=bool(body.get("synthetic")))
             except TypeError:
-                # test stubs model a 2-arg submit; the trace id is
-                # merely lost, not load-bearing
+                # test stubs model a 2-arg submit; the trace id and
+                # synthetic tag are merely lost, not load-bearing
                 req = self.eng.submit(
                     np.asarray(body["prompt"], np.int32),
                     int(body["max_new"]))
@@ -1408,12 +1450,31 @@ def _replica_main(args) -> int:
     tracker = slo.SLOTracker(slo.SLOConfig(), capacity=8192).install()
     assert tracker is not None
     slo.install_tail()
+    plan = None
     if getattr(args, "fault_delay", 0.0):
         # the --ab fault arm: a fixed per-engine-step stall makes
         # decode the provably dominant tail bucket on /tailz
-        resilience.install_fault_plan(resilience.FaultPlan().delay(
+        plan = resilience.FaultPlan().delay(
             "serving.engine_step", float(args.fault_delay),
-            times=10 ** 9))
+            times=10 ** 9)
+    if getattr(args, "corrupt_after", 0):
+        # the audit --ab corrupt arm: the Nth fingerprint tick's
+        # fault_point("audit.corrupt_params") bit-flips one layer of
+        # THIS replica's params (audit.ParamFingerprinter._corrupt) —
+        # the silent-data-corruption stand-in the observatory must
+        # catch from the outside
+        plan = (plan or resilience.FaultPlan()).fail(
+            "audit.corrupt_params", nth=int(args.corrupt_after))
+    if plan is not None:
+        resilience.install_fault_plan(plan)
+    # the correctness observatory's replica half: the startup
+    # fingerprint plus the low-rate recompute timer whose snapshot
+    # rides the fleet_audit shard line (started before the shard
+    # writer so the first publish already carries a fingerprint)
+    from . import audit
+    audit.install_fingerprint(
+        m, eng,
+        interval_s=float(getattr(args, "audit_interval", 0.25)))
     fleet.start_shard_writer(args.fleet_dir,
                              interval_s=args.publish_interval)
     dsrv = diag.start_diag_server(port=0)
@@ -1452,6 +1513,7 @@ def _replica_main(args) -> int:
         pass
     ctl.stop()
     eng.stop()
+    audit.reset()
     fleet.uninstall()
     diag.stop_diag_server()
     resilience.clear_fault_plan()
@@ -1485,6 +1547,10 @@ def spawn_replica(name: str, fleet_dir: str, args, *,
            "--spawned-at", f"{time.time():.6f}"]
     if getattr(args, "fault_delay", 0.0):
         cmd += ["--fault-delay", str(args.fault_delay)]
+    if getattr(args, "audit_interval", None) is not None:
+        cmd += ["--audit-interval", str(args.audit_interval)]
+    if getattr(args, "corrupt_after", 0):
+        cmd += ["--corrupt-after", str(args.corrupt_after)]
     proc = subprocess.Popen(cmd, cwd=root, env=env,
                             stdout=subprocess.PIPE, stderr=sys.stderr,
                             text=True)
@@ -1928,6 +1994,15 @@ def main(argv=None) -> int:
                    help="replica mode: install a FaultPlan delay of "
                         "this many seconds on every serving.engine_step "
                         "(the --ab fault arm's tail-attribution probe)")
+    p.add_argument("--audit-interval", type=float, default=0.25,
+                   help="replica mode: param-fingerprint recompute "
+                        "period in seconds (0 disables the timer; the "
+                        "startup fingerprint is always computed)")
+    p.add_argument("--corrupt-after", type=int, default=0,
+                   help="replica mode: bit-flip one param layer at the "
+                        "Nth fingerprint tick via fault_point("
+                        "'audit.corrupt_params') — the audit --ab "
+                        "corrupt arm's SDC injection")
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--out", default="SERVE_r01.json")
     args = p.parse_args(argv)

@@ -356,7 +356,12 @@ class SLOTracker:
         """Feed one finished request timeline (the engine's ring
         shape). Derives the tracker record, books per-phase durations,
         tracks violations for the /slo display, and throttle-runs an
-        evaluation pass."""
+        evaluation pass. Synthetic (audit canary/replay) timelines are
+        dropped at the door: a probe storm must never move SLO
+        attainment or burn the error budget — correctness probing is
+        the audit module's verdict, not demand-facing load."""
+        if timeline.get("synthetic"):
+            return
         events = timeline.get("events") or []
         ts = events[-1][1] if events else self.clock()
         rec = {

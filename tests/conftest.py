@@ -52,11 +52,12 @@ def _metrics_isolation():
     HTTP ports, server threads, or span listeners — and (ISSUE-5)
     asserts the test left no async checkpoint pending, no prefetcher
     thread alive, and no stray non-daemon thread behind."""
-    from singa_tpu import (capacity, diag, engine, fleet, goodput,
-                           health, introspect, memory, observe, router,
-                           slo, watchdog)
+    from singa_tpu import (audit, capacity, diag, engine, fleet,
+                           goodput, health, introspect, memory,
+                           observe, router, slo, watchdog)
     diag.stop_diag_server()
     goodput.uninstall()
+    audit.reset()
     router.reset()
     fleet.uninstall()
     engine.reset()
@@ -85,6 +86,23 @@ def _metrics_isolation():
     assert not leaked_wd, (
         f"watchdog thread(s) left running: {leaked_wd} — call "
         "watchdog.uninstall_watchdog() before the test ends")
+    # audit teardown (ISSUE-18): the correctness observatory reset —
+    # its canary prober / shadow replayer / fingerprint-timer /
+    # quarantine-drain threads (singa-audit-*) joined and the router
+    # terminal-request listener detached. Runs BEFORE the router check
+    # because the observatory drives the router (drain threads call
+    # Router.drain_replica; the replayer holds a router listener).
+    # Capture-then-clean like every block here: the leak is recorded
+    # first and cleaned regardless, so one leaky test fails itself
+    # without cascading into the suite.
+    leaked_audit = [t.name for t in threading.enumerate()
+                    if t.is_alive()
+                    and t.name.startswith("singa-audit")]
+    audit.reset()
+    assert not leaked_audit, (
+        f"audit thread(s) left running: {leaked_audit} — call "
+        "AuditObservatory.stop() / ParamFingerprinter.stop() (or "
+        "audit.reset()) before the test ends")
     # router teardown (ISSUE-15): the installed router stopped — its
     # dispatcher/health/sender threads joined, replica subprocesses
     # reaped, and every still-pending request drained with a TERMINAL

@@ -1,0 +1,713 @@
+"""Serving correctness observatory (ISSUE-18): param-integrity
+fingerprints (jitted per-layer-group fold — deterministic, single-bit
+sensitive, first-diverging-group precise, compile-count neutral), the
+fleet aggregator's fingerprint majority vote over handcrafted shards,
+canary/replay verdict plumbing with the quarantine path (sustain,
+peer triangulation, the min_replicas cap, drain idempotence), and the
+synthetic-traffic exclusion contract: a canary storm moves neither SLO
+attainment, the demand forecast, nor /routerz admitted-RPS, while real
+traffic still does."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from singa_tpu import audit, device, fleet, health, models, observe
+from singa_tpu import engine as eng
+from singa_tpu import router as rt
+from singa_tpu import slo, tensor
+from singa_tpu.audit import (AUDIT_LEGS, AUDIT_VERDICTS,
+                             AuditObservatory, CanaryProber,
+                             ParamFingerprinter, ShadowReplayer)
+
+
+def _gpt(vocab=97, max_seq=64, dim=32, heads=2, layers=2):
+    dev = device.best_device()
+    m = models.create_model(
+        "gpt", vocab_size=vocab, max_seq=max_seq, dim=dim,
+        num_heads=heads, num_layers=layers)
+    ids = tensor.from_numpy(
+        np.random.RandomState(0).randint(0, vocab, (2, 8))
+        .astype(np.int32), device=dev)
+    m.compile([ids], is_train=False, use_graph=False)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    return _gpt()
+
+
+class _FakeRep:
+    def __init__(self, name, state="live"):
+        self.name = name
+        self.state = state
+
+
+class _FakeRouter:
+    """Duck-typed router for observatory unit tests: tracks drains,
+    accepts/removes request listeners, optionally scripts submit()."""
+
+    def __init__(self, reps):
+        self._reps = list(reps)
+        self.drained = []
+        self.listeners = []
+
+    def replicas(self):
+        return list(self._reps)
+
+    def drain_replica(self, name):
+        self.drained.append(name)
+        for rep in self._reps:
+            if rep.name == name:
+                rep.state = "draining"
+
+    def add_request_listener(self, cb):
+        self.listeners.append(cb)
+
+    def remove_request_listener(self, cb):
+        if cb in self.listeners:
+            self.listeners.remove(cb)
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+# ---- enums -----------------------------------------------------------------
+
+def test_audit_enums():
+    assert AUDIT_LEGS == ("fingerprint", "canary", "replay")
+    assert AUDIT_VERDICTS == ("match", "mismatch", "error")
+
+
+# ---- leg 1: the fingerprint fold -------------------------------------------
+
+def test_fingerprint_deterministic_and_single_bit_sensitive(gpt):
+    """Same params -> identical fingerprint across computes; flipping
+    ONE BIT of one param changes exactly that param's layer group and
+    no other."""
+    fp = ParamFingerprinter(gpt)
+    a, b = fp.compute(), fp.compute()
+    assert a == b
+    assert all(0 <= v < 2 ** 32 for _, v in a)
+    groups = [g for g, _ in a]
+    assert len(groups) == len(set(groups))
+    params = gpt.get_params()
+    name = next(n for n in params if "fc1.W" in n)
+    t = params[name]
+    orig = np.ascontiguousarray(t.numpy(), dtype=np.float32)
+    u = orig.view(np.uint32).copy()
+    u.flat[7] ^= np.uint32(1)  # one bit, one element
+    t.copy_from_numpy(u.view(np.float32))
+    try:
+        c = fp.compute()
+        diff = [g for (g, v1), (_, v2) in zip(a, c) if v1 != v2]
+        assert diff == [name.split(gpt.sep, 1)[0]]
+    finally:
+        t.copy_from_numpy(orig)
+    assert fp.compute() == a  # restore -> original fingerprint
+
+
+def test_fingerprint_position_sensitive():
+    """Two layers holding the SAME multiset of values in different
+    positions must fingerprint differently — a transposed/permuted
+    buffer is corruption too, and a plain unordered sum would miss
+    it."""
+
+    class Holder:
+        sep = "."
+
+        def __init__(self, arr):
+            self._t = tensor.from_numpy(arr)
+
+        def get_params(self):
+            from collections import OrderedDict
+            return OrderedDict([("blk.W", self._t)])
+
+    base = np.arange(8, dtype=np.float32)
+    fp1 = ParamFingerprinter(Holder(base)).compute()
+    fp2 = ParamFingerprinter(Holder(base[::-1].copy())).compute()
+    assert fp1 != fp2
+
+
+def test_fingerprint_executable_compiles_nothing_in_model_counter(gpt):
+    """The fold is its own AotExecutor: installing and re-running it
+    must leave singa_model_compile_total (the paper's compile-once
+    contract) exactly where it was."""
+    c = observe.get_registry().get("singa_model_compile_total")
+    before = int(c.value()) if c is not None else 0
+    fp = audit.install_fingerprint(gpt)
+    for _ in range(3):
+        fp.compute()
+    audit.refresh_fingerprint("restore")
+    c = observe.get_registry().get("singa_model_compile_total")
+    after = int(c.value()) if c is not None else 0
+    assert after == before
+    audit.reset()
+
+
+def test_fingerprint_timer_thread_and_reset(gpt):
+    fp = audit.install_fingerprint(gpt, interval_s=0.05)
+    assert _wait_for(lambda: fp.count >= 3)
+    names = [t.name for t in threading.enumerate()
+             if t.name.startswith("singa-audit-fp")]
+    assert names, "fingerprint timer thread not running"
+    audit.reset()
+    assert not [t.name for t in threading.enumerate()
+                if t.is_alive()
+                and t.name.startswith("singa-audit")]
+    assert audit.get_fingerprinter() is None
+
+
+def test_corrupt_fault_point_flips_layer_and_snapshot_marks_it(gpt):
+    """A FaultPlan fail rule at audit.corrupt_params makes tick()
+    bit-flip one layer: the fingerprint changes in exactly one group
+    and the shard snapshot carries injected=True."""
+    from singa_tpu import resilience
+    params = gpt.get_params()
+    name = next(n for n in params if "fc1.W" in n)
+    orig = np.ascontiguousarray(params[name].numpy(), dtype=np.float32)
+    fp = ParamFingerprinter(gpt, corrupt_target=name)
+    before = fp.compute()
+    plan = resilience.FaultPlan().fail("audit.corrupt_params", nth=1)
+    resilience.install_fault_plan(plan)
+    try:
+        after = fp.tick()
+        diff = [g for (g, v1), (_, v2) in zip(before, after)
+                if v1 != v2]
+        assert diff == [name.split(gpt.sep, 1)[0]]
+        snap = fp.snapshot()
+        assert snap["injected"] is True
+        assert snap["fingerprint"] == [[g, v] for g, v in after]
+    finally:
+        resilience.clear_fault_plan()
+        params[name].copy_from_numpy(orig)
+
+
+# ---- the aggregator's majority vote ----------------------------------------
+
+def _write_shard(fleet_dir, host, fingerprint, seq=1):
+    path = os.path.join(fleet_dir, host + fleet.SHARD_SUFFIX)
+    rows = [
+        {"kind": "fleet_shard_header", "version": fleet.SHARD_VERSION,
+         "seq": seq, "host": host, "pid": 1000 + seq,
+         "ts": round(time.time(), 6), "perf": 0.0,
+         "started_ts": 0.0, "steps": 0},
+        {"kind": "fleet_audit",
+         "audit": {"fingerprint": [[g, v] for g, v in fingerprint],
+                   "count": seq, "ts": time.time(), "groups":
+                   len(fingerprint), "params": len(fingerprint),
+                   "injected": False}},
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_fingerprint_majority_vote_flags_dissenter(tmp_path):
+    """3 replicas, one disagreeing in one group: the vote names the
+    dissenter and its first diverging layer group; the dissent feeds
+    the observatory's fingerprint leg and (sustained) quarantines the
+    dissenter via drain. Two agreeing replicas alone never convict."""
+    good = [("tok_embed", 1), ("blk0", 2), ("head", 3)]
+    bad = [("tok_embed", 1), ("blk0", 99), ("head", 3)]
+    fleet_dir = str(tmp_path)
+    _write_shard(fleet_dir, "r0", good)
+    _write_shard(fleet_dir, "r1", good)
+    agg = fleet.install_aggregator(fleet_dir, stale_after_s=60.0)
+    fr = _FakeRouter([_FakeRep(f"r{i}") for i in range(3)])
+    obs = audit.install_observatory(fr, sustain=2, min_replicas=1)
+    try:
+        agg.poll()
+        assert agg.audit_dissent() == {}  # 2 voters: no majority rule
+        _write_shard(fleet_dir, "r2", bad)
+        roll = agg.poll()
+        d = agg.audit_dissent()
+        assert list(d) == ["r2"]
+        assert d["r2"]["first_group"] == "blk0"
+        assert d["r2"]["voters"] == 3 and d["r2"]["majority"] == 2
+        assert roll["audit_dissent"]["r2"]["first_group"] == "blk0"
+        row = next(r for r in roll["workers"] if r["host"] == "r2")
+        assert row["audit"]["dissent"]["first_group"] == "blk0"
+        # dissent is re-noted EVERY poll -> streak reaches sustain
+        agg.poll()
+        assert _wait_for(lambda: fr.drained == ["r2"])
+        snap = obs.snapshot()
+        st = snap["replicas"]["r2"]["fingerprint"]
+        assert st["mismatch"] >= 2
+        assert snap["quarantined"]["r2"]["leg"] == "fingerprint"
+        assert "first diverging group blk0" in st["last_detail"]
+        # the healthy majority is never noted
+        assert "r0" not in snap["replicas"]
+        # the /fleetz integrity table names the dissent
+        rep = fleet.fleet_report()
+        assert "== fleet integrity ==" in rep
+        assert "first diverging group: blk0" in rep
+    finally:
+        obs.stop()
+        audit.reset()
+        fleet.uninstall()
+
+
+def test_fingerprint_vote_unanimous_no_dissent(tmp_path):
+    good = [("tok_embed", 1), ("head", 3)]
+    fleet_dir = str(tmp_path)
+    for h in ("r0", "r1", "r2"):
+        _write_shard(fleet_dir, h, good)
+    agg = fleet.install_aggregator(fleet_dir, stale_after_s=60.0)
+    try:
+        roll = agg.poll()
+        assert agg.audit_dissent() == {}
+        assert roll["audit_dissent"] == {}
+    finally:
+        fleet.uninstall()
+
+
+def test_fingerprint_vote_without_observatory_notes_health(tmp_path):
+    """No observatory installed: the dissent still reaches /healthz as
+    KIND_DIVERGENCE (a verdict is health state) exactly once per
+    episode."""
+    good = [("tok_embed", 1)]
+    bad = [("tok_embed", 2)]
+    fleet_dir = str(tmp_path)
+    _write_shard(fleet_dir, "r0", good)
+    _write_shard(fleet_dir, "r1", good)
+    _write_shard(fleet_dir, "r2", bad)
+    mon = health.HealthMonitor()
+    health.set_active_monitor(mon)
+    agg = fleet.install_aggregator(fleet_dir, stale_after_s=60.0)
+    try:
+        agg.poll()
+        agg.poll()  # same episode: no second note
+        notes = [r for r in mon.recorder.ring
+                 if r.get("external") == health.KIND_DIVERGENCE]
+        assert len(notes) == 1
+        assert notes[0]["detail"]["host"] == "r2"
+    finally:
+        fleet.uninstall()
+        health.set_active_monitor(None)
+
+
+# ---- legs 2 & 3: canary + replay verdict plumbing --------------------------
+
+class _ScriptedRouter(_FakeRouter):
+    """submit() returns pre-scripted handles round-robin."""
+
+    def __init__(self, reps, script):
+        super().__init__(reps)
+        self.script = list(script)
+        self.submits = []
+
+    def submit(self, prompt, max_new, *, synthetic=False):
+        self.submits.append((list(np.asarray(prompt).reshape(-1)),
+                             int(max_new), synthetic))
+        h = self.script[(len(self.submits) - 1) % len(self.script)]
+        return h
+
+
+class _Handle:
+    def __init__(self, tokens, replica, outcome="completed"):
+        self.tokens = list(tokens)
+        self.replica = replica
+        self.outcome = outcome
+        self.detail = None
+
+    def wait(self, timeout=None):
+        return True
+
+
+def test_canary_prober_records_goldens_then_flags_miscompare():
+    """First completed sighting records the golden; an identical later
+    probe matches, a diverging one mismatches with the first-divergence
+    position, attributed to the SERVING replica. All probes go out
+    synthetic=True."""
+    reps = [_FakeRep("r0"), _FakeRep("r1")]
+    good = _Handle([5, 6, 7], "r0")
+    bad = _Handle([5, 9, 7], "r1")
+    router = _ScriptedRouter(reps, [good, good, bad])
+    obs = AuditObservatory(router, sustain=99)
+    p = CanaryProber(obs, router, vocab=31, n_goldens=1, prompt_len=4,
+                     max_new=3, seed=7)
+    p.record_goldens()           # golden recorded from `good`
+    assert p.goldens == {0: [5, 6, 7]}
+    p.run_once()                 # matches golden
+    p.run_once()                 # bad: diverges at position 1 on r1
+    snap = obs.snapshot()
+    assert snap["replicas"]["r0"]["canary"]["match"] == 1
+    st = snap["replicas"]["r1"]["canary"]
+    assert st["mismatch"] == 1 and st["last_position"] == 1
+    assert all(s[2] is True for s in router.submits)  # synthetic tag
+
+
+def test_canary_error_verdict_never_quarantines():
+    reps = [_FakeRep("r0")]
+    err = _Handle([], "r0", outcome="timeout")
+    router = _ScriptedRouter(reps, [err])
+    obs = AuditObservatory(router, sustain=1, min_replicas=0)
+    p = CanaryProber(obs, router, vocab=31, n_goldens=1, seed=7)
+    for _ in range(3):
+        p.run_once()
+    snap = obs.snapshot()
+    assert snap["replicas"]["r0"]["canary"]["error"] == 3
+    assert snap["quarantined"] == {} and router.drained == []
+
+
+def test_shadow_replayer_samples_and_triangulates():
+    """fraction=1.0 samples every completed real request; a replay
+    mismatch notes BOTH parties with the peer recorded, and only the
+    replica diverging against >= 2 distinct peers is quarantined —
+    never its healthy counterparties."""
+    reps = [_FakeRep("r0"), _FakeRep("r1"), _FakeRep("r2")]
+    router = _FakeRouter(reps)
+    obs = AuditObservatory(router, sustain=99, min_replicas=1,
+                           replay_min_peers=2)
+    # r2 is corrupted: any replay involving it diverges at position 0
+    def replay_fn(prompt, max_new, target):
+        return [99] * max_new if target.name == "r2" \
+            else [1] * max_new
+
+    rp = ShadowReplayer(obs, router, fraction=1.0, replay_fn=replay_fn)
+    router.add_request_listener(rp._on_terminal)
+
+    class Req:
+        def __init__(self, rid, replica, tokens, synthetic=False):
+            self.id = rid
+            self.prompt = np.asarray([1, 2, 3], np.int32)
+            self.max_new = len(tokens)
+            self.replica = replica
+            self.tokens = tokens
+            self.outcome = "completed"
+            self.synthetic = synthetic
+
+    # synthetic and non-completed terminals are never sampled
+    rp._on_terminal(Req(1, "r0", [1, 1], synthetic=True), {})
+    bad = Req(2, "r0", [1, 1])
+    bad.outcome = "timeout"
+    rp._on_terminal(bad, {})
+    assert rp.sampled == 0
+    # r2-origin requests replayed on healthy targets diverge (its
+    # tokens were wrong); healthy-origin replays landing ON r2 diverge
+    # too — r2 accumulates 2 distinct peers, r0/r1 only see peer r2
+    rp._on_terminal(Req(3, "r2", [7, 7]), {})   # replayed on r0
+    rp._on_terminal(Req(4, "r2", [7, 7]), {})   # replayed on r1
+    while rp.process_one():
+        pass
+    snap = obs.snapshot()
+    st2 = snap["replicas"]["r2"]["replay"]
+    assert st2["mismatch"] >= 2 and len(st2["peers"]) >= 2
+    assert _wait_for(lambda: router.drained == ["r2"])
+    for healthy in ("r0", "r1"):
+        legs = snap["replicas"].get(healthy) or {}
+        peers = (legs.get("replay") or {}).get("peers", [])
+        assert set(peers) <= {"r2"}
+    assert list(obs.snapshot()["quarantined"]) == ["r2"]
+    obs.stop()
+
+
+def test_replay_match_and_divergence_position():
+    reps = [_FakeRep("r0"), _FakeRep("r1")]
+    router = _FakeRouter(reps)
+    obs = AuditObservatory(router, sustain=99, replay_min_peers=99)
+    outs = {"val": None}
+    rp = ShadowReplayer(obs, router, fraction=1.0,
+                        replay_fn=lambda p, m, t: outs["val"])
+
+    class Req:
+        id = 1
+        prompt = np.asarray([4], np.int32)
+        max_new = 3
+        replica = "r0"
+        tokens = [8, 9, 10]
+        outcome = "completed"
+        synthetic = False
+
+    outs["val"] = [8, 9, 10]
+    rp._on_terminal(Req(), {})
+    assert rp.process_one()
+    outs["val"] = [8, 9, 11]
+    rp._on_terminal(Req(), {})
+    assert rp.process_one()
+    snap = obs.snapshot()
+    st = snap["replicas"]["r0"]["replay"]
+    assert st["match"] == 1 and st["mismatch"] == 1
+    assert st["last_position"] == 2
+
+
+# ---- quarantine: cap + drain idempotence -----------------------------------
+
+def test_quarantine_capped_at_min_replicas():
+    """A sustained verdict with the fleet at min_replicas live records
+    the quarantine as CAPPED, fires the health note, but never drains —
+    a fleet-wide false alarm cannot drain the fleet dark."""
+    router = _FakeRouter([_FakeRep("r0"), _FakeRep("r1", "dead")])
+    mon = health.HealthMonitor()
+    health.set_active_monitor(mon)
+    try:
+        obs = AuditObservatory(router, sustain=1, min_replicas=1)
+        obs.note("r0", "canary", "mismatch", detail="probe diverged")
+        snap = obs.snapshot()
+        q = snap["quarantined"]["r0"]
+        assert q["capped"] is True and q["live_at_verdict"] == 1
+        assert router.drained == []
+        notes = [r for r in mon.recorder.ring
+                 if r.get("external") == health.KIND_DIVERGENCE]
+        assert len(notes) == 1 and notes[0]["detail"]["capped"] is True
+        # a second sustained verdict for the same replica is a no-op
+        obs.note("r0", "canary", "mismatch")
+        assert len(obs.snapshot()["quarantined"]) == 1
+        obs.stop()
+    finally:
+        health.set_active_monitor(None)
+
+
+def test_health_note_survives_observe_disable():
+    """PR-12 convention: a verdict is health state, not telemetry.
+    With observe.enable(False) the quarantine still health-notes and
+    drains, while the singa_audit_* counters and the EventLog stay
+    silent."""
+    router = _FakeRouter([_FakeRep(f"r{i}") for i in range(3)])
+    mon = health.HealthMonitor()
+    health.set_active_monitor(mon)
+    observe.enable(False)
+    try:
+        obs = AuditObservatory(router, sustain=1, min_replicas=1)
+        obs.note("r1", "canary", "mismatch", position=0)
+        assert _wait_for(lambda: router.drained == ["r1"])
+        assert [r for r in mon.recorder.ring
+                if r.get("external") == health.KIND_DIVERGENCE]
+        c = observe.get_registry().get("singa_audit_checks_total")
+        assert c is None or int(c.value()) == 0
+        assert not [e for e in observe.get_registry().recent
+                    if e.get("kind") == "audit"]
+        obs.stop()
+    finally:
+        observe.enable(True)
+        health.set_active_monitor(None)
+
+
+def test_verdicts_emit_structured_events_and_counters():
+    router = _FakeRouter([_FakeRep(f"r{i}") for i in range(3)])
+    obs = AuditObservatory(router, sustain=2, min_replicas=1)
+    obs.note("r1", "canary", "match")
+    obs.note("r1", "canary", "mismatch", position=3, detail="diverged")
+    obs.note("r1", "canary", "mismatch", position=3, detail="diverged")
+    assert _wait_for(lambda: router.drained == ["r1"])
+    events = list(observe.get_registry().recent)
+    verdicts = [e for e in events if e.get("kind") == "audit"
+                and e.get("event") == "verdict"]
+    assert len(verdicts) == 3
+    assert verdicts[1]["leg"] == "canary"
+    assert verdicts[1]["verdict"] == "mismatch"
+    assert verdicts[1]["position"] == 3
+    quars = [e for e in events if e.get("kind") == "audit"
+             and e.get("event") == "quarantine"]
+    assert len(quars) == 1 and quars[0]["replica"] == "r1"
+    c = observe.get_registry().get("singa_audit_checks_total")
+    assert int(c.value(leg="canary", verdict="mismatch")) == 2
+    assert int(c.value(leg="canary", verdict="match")) == 1
+    q = observe.get_registry().get("singa_audit_quarantine_total")
+    assert int(q.value(leg="canary")) == 1
+    obs.stop()
+
+
+def test_drain_replica_idempotent_and_reentrant():
+    """ISSUE-18 satellite: drain_replica on a non-live replica is a
+    no-op dict, not a ValueError — the audit quarantine path may race
+    the fleet policy (or itself) to the same dissenter."""
+    r = rt.Router()
+    rep = r.add_replica("rx", "http://127.0.0.1:1/ctl")
+    rep.state = rt.STATE_DRAINING
+    out = r.drain_replica("rx")
+    assert out == {"noop": True, "replica": "rx", "state": "draining"}
+    rep.state = rt.STATE_DEAD
+    out2 = r.drain_replica("rx")
+    assert out2["noop"] is True and out2["state"] == "dead"
+    with pytest.raises(ValueError):
+        r.drain_replica("missing")
+    r.stop()
+
+
+# ---- synthetic-traffic exclusion (test-enforced contract) ------------------
+
+def test_synthetic_storm_moves_no_demand_signal():
+    """A synthetic canary storm through the router front door moves
+    neither /routerz admitted-RPS nor the shed stamps; real traffic
+    still does. (No replicas: every request is queued-then-drained —
+    admit stamps happen at the front door, which is the surface the
+    DemandForecaster and /routerz read.)"""
+    r = rt.Router(queue_limit=8)
+    try:
+        for _ in range(8):
+            r.submit(np.asarray([1, 2], np.int32), 4, synthetic=True)
+        # queue full now: synthetic overflow must not stamp shed either
+        r.submit(np.asarray([1, 2], np.int32), 4, synthetic=True)
+        snap = r.snapshot()
+        assert snap["admitted_rps"] == 0.0
+        assert snap["shed_rate"] == 0.0
+        assert len(r._admit_times) == 0 and len(r._shed_times) == 0
+        real = r.submit(np.asarray([1, 2], np.int32), 4)
+        assert real.outcome == "rejected"  # queue still full: shed
+        assert len(r._shed_times) == 1
+        assert r.snapshot()["shed_rate"] > 0.0
+    finally:
+        r.stop()
+
+
+def test_synthetic_storm_moves_no_slo_attainment():
+    """SLOTracker.note_timeline drops synthetic timelines at the door:
+    a storm of violating synthetic timelines leaves attainment
+    untouched while one real timeline still books."""
+    tr = slo.SLOTracker(slo.SLOConfig(ttft_p99_s=0.01))
+    bad = {"id": 1, "outcome": "completed", "ttft_s": 5.0,
+           "total_s": 6.0, "tokens_per_sec": 1.0,
+           "events": [["terminal", 100.0, {}]]}
+    for i in range(50):
+        tl = dict(bad, id=i, synthetic=True)
+        tr.note_timeline(tl)
+    assert len(tr._records) == 0
+    tr.note_timeline(dict(bad, id=999))
+    assert len(tr._records) == 1
+
+
+def test_synthetic_storm_moves_no_demand_forecast():
+    """End of the exclusion chain: the DemandForecaster reads the
+    router's admit-rate — synthetic submits leave it at zero, real
+    submits raise it."""
+    from singa_tpu.capacity import DemandForecaster
+    r = rt.Router(queue_limit=64)
+    try:
+        for _ in range(20):
+            r.submit(np.asarray([1], np.int32), 2, synthetic=True)
+        f = DemandForecaster()
+        f.update(r._rate(r._admit_times, 10.0), now=1.0)
+        assert f.fast == 0.0 and f.slow == 0.0
+        for _ in range(20):
+            r.submit(np.asarray([1], np.int32), 2)
+        f.update(r._rate(r._admit_times, 10.0), now=2.0)
+        assert f.fast > 0.0
+    finally:
+        r.stop()
+
+
+def test_engine_submit_carries_synthetic_into_timeline(gpt):
+    """The tag survives the full engine path: submit(synthetic=True)
+    -> EngineRequest.synthetic -> the timeline dict the SLO tracker
+    and fleet shard read."""
+    e = eng.ServingEngine(gpt, max_slots=2, page_size=8, max_ctx=64,
+                          steps_per_sync=2).start()
+    try:
+        hs = e.submit(np.asarray([1, 2, 3], np.int32), 4,
+                      synthetic=True)
+        hr = e.submit(np.asarray([1, 2, 3], np.int32), 4)
+        assert hs.wait(300) and hr.wait(300)
+        tls = {t["id"]: t for t in e.timelines()}
+        assert tls[hs.id]["synthetic"] is True
+        assert tls[hr.id]["synthetic"] is False
+    finally:
+        e.stop()
+
+
+# ---- surfaces ---------------------------------------------------------------
+
+def test_auditz_report_and_json(gpt):
+    audit.install_fingerprint(gpt)
+    fr = _FakeRouter([_FakeRep("r0")])
+    obs = audit.install_observatory(fr, sustain=3)
+    obs.note("r0", "canary", "match")
+    rep = audit.audit_report()
+    assert "== audit ==" in rep
+    assert "layer groups" in rep and "replica r0" in rep
+    js = audit.audit_json()
+    assert js["fingerprint"]["groups"] >= 1
+    assert js["observatory"]["replicas"]["r0"]["canary"]["match"] == 1
+    lines = audit.fleetz_lines()
+    assert any("== fleet audit ==" in ln for ln in lines)
+    obs.stop()
+    audit.reset()
+    assert "(not installed)" in audit.audit_report()
+    assert audit.fleetz_lines() == []
+
+
+def test_auditz_endpoint(gpt):
+    from urllib.request import urlopen
+    from singa_tpu import diag
+    srv = diag.start_diag_server(port=0)
+    try:
+        from urllib.error import HTTPError
+        url = f"http://127.0.0.1:{srv.port}/auditz"
+        with pytest.raises(HTTPError) as ei:
+            urlopen(url, timeout=10)
+        assert ei.value.code == 503
+        audit.install_fingerprint(gpt)
+        body = urlopen(url, timeout=10).read().decode()
+        assert "== audit ==" in body and "layer groups" in body
+        js = json.loads(
+            urlopen(url + "?json=1", timeout=10).read().decode())
+        assert js["fingerprint"]["params"] >= 1
+        status = urlopen(
+            f"http://127.0.0.1:{srv.port}/statusz", timeout=10
+        ).read().decode()
+        assert "== audit ==" in status
+    finally:
+        audit.reset()
+        diag.stop_diag_server()
+
+
+
+
+def test_fingerprint_conviction_fires_canary_confirm_burst(monkeypatch):
+    """A fingerprint-leg conviction is internal (param-level) evidence;
+    the quarantine path corroborates it with a targeted golden burst
+    against the accused's control surface BEFORE the drain retires it,
+    so the conviction always gains external wrong-token evidence.
+    Canary- and replay-leg convictions (already external) drain
+    directly with no burst."""
+    reps = [_FakeRep("r0"), _FakeRep("r1"), _FakeRep("r2")]
+    for rep in reps:
+        rep.ctl_url = f"http://127.0.0.1:1/{rep.name}"
+    r = _FakeRouter(reps)
+    r.get_replica = lambda name: next(
+        (rep for rep in reps if rep.name == name), None)
+    obs = audit.install_observatory(r, sustain=2, min_replicas=1)
+    prober = audit.CanaryProber(obs, r, vocab=31, n_goldens=2,
+                                prompt_len=4, max_new=4, seed=7)
+    prober.goldens = {0: [1, 2, 3, 4], 1: [5, 6, 7, 8]}
+    obs.prober = prober
+    calls = []
+
+    def fake_direct(target, prompt, max_new, **kw):
+        calls.append(target.name)
+        return [9, 9, 9, 9]  # wrong from token 0 -> miscompare
+
+    monkeypatch.setattr(audit, "_direct_generate", fake_direct)
+    for _ in range(2):
+        obs.note("r2", audit.LEG_FINGERPRINT, audit.VERDICT_MISMATCH,
+                 detail="vote dissent")
+    assert _wait_for(lambda: "r2" in r.drained)
+    obs.stop()  # joins the drain thread the burst ran on
+    assert calls == ["r2", "r2"]
+    snap = obs.snapshot()
+    st = snap["replicas"]["r2"][audit.LEG_CANARY]
+    assert st["mismatch"] == 2
+    assert st["last_position"] == 0
+    # the canary conviction the burst itself produces must not
+    # re-quarantine: the ledger still shows ONE episode, fingerprint-led
+    assert list(snap["quarantined"]) == ["r2"]
+    assert snap["quarantined"]["r2"]["leg"] == audit.LEG_FINGERPRINT
+    # a replay-leg conviction (pair evidence) goes straight to drain
+    obs.note("r1", audit.LEG_REPLAY, audit.VERDICT_MISMATCH, peer="r0")
+    obs.note("r1", audit.LEG_REPLAY, audit.VERDICT_MISMATCH, peer="r2")
+    assert _wait_for(lambda: "r1" in r.drained)
+    obs.stop()
+    assert calls == ["r2", "r2"]

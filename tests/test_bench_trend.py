@@ -81,13 +81,18 @@ def test_regressions_flagged_against_best_prior_round():
     # is delta inf, 1->4 failovers is +300%; reliability slides are
     # built to outlive any sane threshold) — nor the capacity
     # observatory's oscillation/reaction counts (flaps 1->3, churn
-    # 3->6, delay 2->4: all at or beyond +100%)
+    # 3->6, delay 2->4: all at or beyond +100%) — nor the audit
+    # correctness records (divergence 6->11, miscompares 3->9,
+    # false positives 0->2 is delta inf)
     loose = bench_trend.find_regressions(table, threshold=0.5)
     assert {m for m, *_ in loose} == {"harn_ok", "router_lost_requests",
                                       "router_failover_requests",
                                       "capacity_decision_flaps",
                                       "capacity_decision_churn",
-                                      "capacity_scale_up_delay_polls"}
+                                      "capacity_scale_up_delay_polls",
+                                      "audit_divergence_count",
+                                      "audit_canary_miscompare_count",
+                                      "audit_false_positive_count"}
 
 
 def test_cli_exit_codes(capsys):
@@ -360,3 +365,47 @@ def test_router_loss_fixture_regression_flagged():
     rnd, v, best_r, best, delta = regs["router_failover_requests"]
     assert (rnd, v, best_r, best) == (4, 4.0, 3, 1.0)
     assert abs(delta - 3.0) < 1e-9
+
+
+def test_audit_metrics_lower_is_better():
+    """ISSUE-18 satellite: the correctness observatory's divergence,
+    canary-miscompare and false-positive counts regress UP (a healthy
+    fleet's audit should find LESS wrong over time, and a clean arm
+    must stay at zero false positives), while the AUD harness ok flag
+    stays higher-is-better."""
+    assert bench_trend.lower_is_better("audit_divergence_count",
+                                       "count")
+    assert bench_trend.lower_is_better(
+        "audit_canary_miscompare_count", "count")
+    assert bench_trend.lower_is_better("audit_false_positive_count",
+                                       "count")
+    assert bench_trend.lower_is_better("audit_lost_requests", "count")
+    assert not bench_trend.lower_is_better("aud_ok", "bool")
+
+
+def test_audit_fixture_regressions_flagged():
+    """The checked-in AUD fixture rounds carry the audit harness's
+    records: divergence down, miscompares flat, false positives /
+    lost requests flat at zero in clean/ (no flag — zero staying zero
+    is the contract), and in regress/ a divergence (6 -> 11) and
+    miscompare (3 -> 9) RISE plus a 0 -> 2 false-positive jump
+    (delta inf — any clean-arm false positive is a regression), all
+    flagged against the best prior round."""
+    clean = bench_trend.trend_table(bench_trend.collect([CLEAN]))
+    assert clean["audit_divergence_count"]["by_round"] == {1: 6.0,
+                                                          2: 5.0}
+    assert clean["audit_false_positive_count"]["by_round"] \
+        == {1: 0.0, 2: 0.0}
+    assert not [r for r in bench_trend.find_regressions(clean)
+                if r[0].startswith("audit_")]
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = {m: (rnd, v, best_r, best, delta)
+            for m, rnd, v, best_r, best, delta
+            in bench_trend.find_regressions(table, threshold=0.05)}
+    rnd, v, best_r, best, delta = regs["audit_divergence_count"]
+    assert (rnd, v, best_r, best) == (2, 11.0, 1, 6.0)
+    assert abs(delta - 5.0 / 6.0) < 1e-9
+    assert regs["audit_canary_miscompare_count"][1] == 9.0
+    rnd, v, best_r, best, delta = regs["audit_false_positive_count"]
+    assert (v, best) == (2.0, 0.0) and delta == float("inf")
+    assert "audit_lost_requests" not in regs

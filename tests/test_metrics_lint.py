@@ -740,3 +740,57 @@ def test_decision_and_scaler_reason_label_rules(tmp_path):
     assert any("'scale_sideways'" in p for p in problems)
     assert any("'vibes'" in p for p in problems)
     assert any("dynamic" in p for p in problems)
+
+
+def test_lint_covers_audit_metric_names():
+    """ISSUE-18: rule 5 extends to the correctness observatory's
+    `leg=` label (and its `verdict=` values) — AUDIT_LEGS /
+    AUDIT_VERDICTS are recognized as declared enum tuples, every
+    singa_audit_* registration in audit.py passes the full lint, and
+    the new kwarg is enforced."""
+    audit_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                            "audit.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(audit_py)}
+    assert {"singa_audit_checks_total",
+            "singa_audit_quarantine_total",
+            "singa_audit_fingerprint_total",
+            "singa_audit_divergence_position"} <= names
+    assert all(n.startswith("singa_audit_") for n in names)
+    assert check_metrics_names.check([audit_py]) == []
+    import ast
+    enums, _consts = check_metrics_names._module_enum_info(
+        ast.parse(open(audit_py).read()))
+    assert enums["AUDIT_LEGS"] == ("fingerprint", "canary", "replay")
+    assert enums["AUDIT_VERDICTS"] == ("match", "mismatch", "error")
+    assert "leg" in check_metrics_names.ENUM_LABEL_KWARGS
+    assert "verdict" in check_metrics_names.ENUM_LABEL_KWARGS
+
+
+def test_leg_and_audit_verdict_label_rules(tmp_path):
+    """A leg= literal outside AUDIT_LEGS (or a verdict= outside
+    AUDIT_VERDICTS) is a violation; members and enum-guarded dynamic
+    values — audit.py's `assert leg in AUDIT_LEGS` shape — pass,
+    unguarded dynamics fail."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "AUDIT_LEGS = ('fingerprint', 'canary', 'replay')\n"
+        "AUDIT_VERDICTS = ('match', 'mismatch', 'error')\n"
+        "observe.counter('singa_a_total', 'a')"
+        ".inc(leg='canary', verdict='match')\n"
+        "observe.counter('singa_a_total', 'a')"
+        ".inc(leg='teleportation')\n"
+        "observe.counter('singa_a_total', 'a')"
+        ".inc(leg='replay', verdict='maybe')\n"
+        "def guarded(leg, verdict):\n"
+        "    assert leg in AUDIT_LEGS\n"
+        "    assert verdict in AUDIT_VERDICTS\n"
+        "    observe.counter('singa_a_total', 'a')"
+        ".inc(leg=leg, verdict=verdict)\n"
+        "def unguarded(leg):\n"
+        "    observe.counter('singa_a_total', 'a').inc(leg=leg)\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 3, problems
+    assert any("'teleportation'" in p for p in problems)
+    assert any("'maybe'" in p for p in problems)
+    assert any("dynamic" in p for p in problems)

@@ -26,9 +26,14 @@ records), serving latencies (any metric naming `ttft` or a
 `*_p50`/`*_p99` percentile — BENCHDEC_r06's engine TTFT records, even
 when unit-less), and replica cold-start walls (any metric naming
 `startup`/`cold`/`spawn` — SERVE_r*.json's replica_startup_total_s /
-router_cold_spawn_first_token_s), and shadow-scaler oscillation counts
+router_cold_spawn_first_token_s), shadow-scaler oscillation counts
 (any metric naming `flap` or `decision_churn` — CAPACITY_r*.json's
-capacity_decision_flaps) regress UP, everything else
+capacity_decision_flaps), and correctness-observatory incident counts
+(any metric naming `divergence`, `miscompare`, or `false_positive` —
+AUDIT_r*.json's audit_divergence_count / audit_canary_miscompare_count
+/ audit_false_positive_count, where more wrong-token incidents or
+false alarms at the same injected fault is the regression) regress UP,
+everything else
 (throughput, ratios, ok-flags) regresses DOWN. Rate units ("tokens/s") always win over the
 name heuristics, and SLO `attainment` metrics plus speculative-decode
 `accept`/`acceptance` rates and capacity `headroom` fractions are
@@ -74,9 +79,15 @@ LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_latency", "_bytes",
 #: where any rise means the hysteresis got worse at damping bursts
 #: `delay` covers reaction-time counts like CAPACITY_rNN's
 #: capacity_scale_up_delay_polls — reacting later is the regression
+#: `divergence`/`miscompare`/`false_positive` are the correctness
+#: observatory's incident counts (AUDIT_rNN's audit_divergence_count /
+#: audit_canary_miscompare_count / audit_false_positive_count), where
+#: any rise — especially zero-to-nonzero false positives — is the
+#: regression
 LOWER_BETTER_SUBSTRINGS = ("ttft", "dropped", "lost", "failover",
                            "startup", "cold", "spawn", "flap",
-                           "decision_churn", "delay")
+                           "decision_churn", "delay", "divergence",
+                           "miscompare", "false_positive")
 #: name substrings that mark a higher-is-better metric even when a
 #: lower-better suffix would otherwise match — SLO attainment records
 #: end in `_pct` (and the percentile suffixes), but a DROP in

@@ -19,7 +19,8 @@ literal, then fails if
      every name must describe itself), or
   5. a `reason=` / `phase=` / `bucket=` / `region=` / `op=` /
      `outcome=` / `objective=` / `kv_dtype=` / `verdict=` /
-     `replica=` / `attr=` / `decision=` label value on a metric record call
+     `replica=` / `attr=` / `decision=` / `leg=` label value on a
+     metric record call
      (.inc/.set/.observe/.dec) does not come from a declared enum: these
      labels are CONTRACTUALLY low-cardinality (introspect.py's
      RECOMPILE_REASONS / COMPILE_PHASES, goodput.py's GOODPUT_BUCKETS,
@@ -37,7 +38,11 @@ literal, then fails if
      REPLICA_STATES, i.e. the bounded replica registry, and
      capacity.py's SCALE_DECISIONS / DECISION_REASONS — the shadow
      scaler's `decision=` values are exactly scale_up / scale_down /
-     hold and its `reason=` values the fixed reason-code enum),
+     hold and its `reason=` values the fixed reason-code enum — and
+     audit.py's AUDIT_LEGS / AUDIT_VERDICTS — the correctness
+     observatory's `leg=` values are exactly fingerprint / canary /
+     replay and its `verdict=` values exactly match / mismatch /
+     error),
      so a string literal must be a
      member of a module-level ALL-CAPS tuple of string literals, a NAME
      must be a module-level constant whose value is a member, and a
@@ -139,10 +144,12 @@ def registrations_in(path, tree=None):
 # replica: router.py's bounded registry, guarded via REPLICA_STATES;
 # attr: slo.py's LATENCY_ATTR (tail-latency attribution buckets);
 # decision: capacity.py's SCALE_DECISIONS, with the shadow scaler's
-# reason= values from capacity.py's DECISION_REASONS).
+# reason= values from capacity.py's DECISION_REASONS; leg: audit.py's
+# AUDIT_LEGS, with the correctness observatory's verdict= values from
+# audit.py's AUDIT_VERDICTS).
 ENUM_LABEL_KWARGS = ("reason", "phase", "bucket", "region", "op",
                      "outcome", "objective", "kv_dtype", "verdict",
-                     "replica", "attr", "decision")
+                     "replica", "attr", "decision", "leg")
 RECORD_FUNCS = {"inc", "set", "observe", "dec"}
 
 # Rule 6: `host=` label values must originate in the cluster topology.
