@@ -148,6 +148,15 @@ def main():
                         "watchdog_ms_per_step / watchdog_overhead_pct "
                         "/ watchdog_compile_delta; target <=1% with "
                         "compile_count unchanged")
+    p.add_argument("--regress", action="store_true",
+                   help="measure the regression detector's per-step "
+                        "cost (singa_tpu.regress): paired alternating "
+                        "listener-attached/detached samples plus a "
+                        "direct measurement of the span-listener feed "
+                        "(same protocol as --watchdog) and record "
+                        "regress_us_per_step / regress_overhead_pct / "
+                        "regress_compile_delta; target <=1% with "
+                        "compile_count unchanged")
     p.add_argument("--mem-out", default=None, metavar="FILE",
                    help="with --mem: also write the focused memory "
                         "records as JSONL (the MEM_r*.json artifact "
@@ -534,6 +543,95 @@ def main():
         }
         watchdog_mod.uninstall_watchdog()
 
+    # ---- regression-detector overhead (--regress) -------------------------
+    # Same story as the watchdog guard: the detector adds pure host work
+    # per step — one span-listener callback (leaf split, signal map,
+    # lock, deque append; every `window`th call also closes a window:
+    # a sorted() median + the CUSUM update). Far below what the paired
+    # A/B resolves on a noisy host, so the headline is the DIRECT
+    # median of many timed feed calls against the measured base step,
+    # with the paired delta as a sanity field and the compile-count
+    # delta asserted (the detector is host-side only and must never
+    # retrace).
+    regress_fields = {}
+    if args.regress:
+        from singa_tpu import regress as regress_mod
+
+        # h high enough that noisy benchmark steps never convict
+        # mid-measurement (a conviction writes a bundle — not a cost
+        # the steady-state number should include)
+        det = regress_mod.RegressionDetector(
+            warmup_samples=16, window=8, h=1e9).install()
+
+        def fenced_rg_ms():
+            t1 = time.perf_counter()
+            _o, ls = m(tx, ty)
+            np.asarray(jax.device_get(ls.data))
+            return (time.perf_counter() - t1) * 1e3
+
+        cc = observe.get_registry().get("singa_model_compile_total")
+        rg_compiles_before = sum(
+            v for _n, _k, v in cc.samples()) if cc else 0
+        # idempotent toggles (add_span_listener is append-only; remove
+        # drops every equal copy, so detach-then-attach never doubles)
+        def rg_off():
+            observe.remove_span_listener(det._on_span)
+
+        def rg_on():
+            observe.remove_span_listener(det._on_span)
+            observe.add_span_listener(det._on_span)
+
+        fenced_rg_ms()  # both arms warm
+        fenced_rg_ms()
+        offs, ons = [], []
+        for i in range(2 * args.step_samples):
+            if i % 2 == 0:
+                rg_off()
+                offs.append(fenced_rg_ms())
+                rg_on()
+                ons.append(fenced_rg_ms())
+            else:
+                rg_on()
+                ons.append(fenced_rg_ms())
+                rg_off()
+                offs.append(fenced_rg_ms())
+        rg_on()
+        rg_base_ms = float(np.median(np.asarray(offs)))
+        # direct feed cost at steady state: freeze the baseline on
+        # constant samples (z stays 0, no convictions), then time
+        # batches of listener calls — each 8th closes a real window
+        base_s = rg_base_ms / 1e3
+        for _ in range(16):
+            det._on_span("model.step", base_s, {})
+        batch_n, batches = 200, []
+        for _ in range(15):
+            t1 = time.perf_counter()
+            for _ in range(batch_n):
+                det._on_span("model.step", base_s, {})
+            batches.append((time.perf_counter() - t1) / batch_n)
+        feed_us = float(np.median(np.asarray(batches))) * 1e6
+        deltas = np.asarray(ons) - np.asarray(offs)
+        rg_overhead_pct = 100.0 * (feed_us / 1e3) / rg_base_ms
+        cc = observe.get_registry().get("singa_model_compile_total")
+        rg_compiles_after = sum(
+            v for _n, _k, v in cc.samples()) if cc else 0
+        rg_state = det.signal_state("model.step") or {}
+        regress_fields = {
+            "regress_us_per_step": round(feed_us, 3),
+            "regress_ms_per_step": round(rg_base_ms + feed_us / 1e3,
+                                         3),
+            "regress_overhead_pct": round(rg_overhead_pct, 4),
+            "regress_ab_delta_pct": round(
+                100.0 * float(np.median(deltas)) / rg_base_ms, 2),
+            "regress_compile_delta": int(rg_compiles_after
+                                         - rg_compiles_before),
+            "regress_windows": int(rg_state.get("windows") or 0),
+            "regress_ok": bool(
+                rg_overhead_pct <= 1.0
+                and rg_compiles_after == rg_compiles_before),
+        }
+        regress_mod.uninstall()
+
     # ---- overlap layer A/B (--overlap / --ckpt-async) --------------------
     # the record's goodput_* fields must describe the REAL benchmarked
     # run: snapshot before the A/B arms feed the same tracker synthetic
@@ -842,6 +940,8 @@ def main():
         rec.update(mem_fields)  # mirrored into singa_bench_* below
     if watchdog_fields:
         rec.update(watchdog_fields)  # mirrored into singa_bench_* below
+    if regress_fields:
+        rec.update(regress_fields)  # mirrored into singa_bench_* below
     if overlap_fields:
         rec.update(overlap_fields)  # mirrored into singa_bench_* below
     if args.explain:

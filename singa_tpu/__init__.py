@@ -25,7 +25,7 @@ _LAZY_MODULES = (
     "parallel", "utils", "ops", "models", "io", "channel", "native",
     "observe", "xprof", "health", "serving", "introspect",
     "goodput", "diag", "overlap", "resilience", "distributed", "fleet",
-    "memory", "watchdog", "engine",
+    "memory", "watchdog", "engine", "regress",
 )
 
 

@@ -96,6 +96,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "/routerz": self._routerz,
                 "/capacityz": self._capacityz,
                 "/auditz": self._auditz,
+                "/regressz": self._regressz,
                 "/tailz": self._tailz,
                 "/memz": self._memz,
                 "/slo": self._sloz,
@@ -133,6 +134,10 @@ class _Handler(BaseHTTPRequestHandler):
             "param fingerprint, canary/replay verdict table per "
             "replica, quarantine ledger; ?json=1 for the structured "
             "form\n"
+            "  /regressz     performance regression observatory: "
+            "per-signal latency baseline + CUSUM table, verdict "
+            "tail with attributed causes, evidence-bundle index; "
+            "?json=1 for the structured form\n"
             "  /tailz        tail-latency attribution: p99 "
             "contribution per LATENCY_ATTR bucket; ?json=1 for "
             "the structured form\n"
@@ -214,6 +219,11 @@ class _Handler(BaseHTTPRequestHandler):
             parts.append(audit.audit_report())
         except Exception as e:
             parts.append(f"(audit unavailable: {e})")
+        try:
+            from . import regress
+            parts.append(regress.regress_report())
+        except Exception as e:
+            parts.append(f"(regress unavailable: {e})")
         mon = self._monitor()
         if mon is None:
             parts.append("== health ==\nno HealthMonitor attached")
@@ -287,6 +297,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(capacity.capacity_json(), status=status)
         else:
             self._send(capacity.capacity_report() + "\n", status=status)
+
+    def _regressz(self, q):
+        """The performance regression observatory (singa_tpu.regress):
+        the per-signal baseline/CUSUM table (baseline vs window median,
+        z, score, HLO fingerprint, state), the conviction tail with
+        attributed causes and evidence-bundle names, and the fleet
+        regression block when an aggregator is running. `?json=1`
+        returns the detector snapshot plus the full verdict ring. 503
+        until a RegressionDetector is installed."""
+        from . import regress
+        status = 200 if regress.get_detector() is not None else 503
+        if (q.get("json") or ["0"])[0] not in ("0", "", "false"):
+            self._send_json(regress.regress_json(), status=status)
+        else:
+            self._send(regress.regress_report() + "\n", status=status)
 
     def _auditz(self, q):
         """The serving correctness observatory (singa_tpu.audit): this

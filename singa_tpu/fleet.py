@@ -294,6 +294,17 @@ class ShardWriter:
         except Exception:
             aud = None
         lines.append({"kind": "fleet_audit", "audit": aud})
+        reg = None
+        try:
+            # this replica's regression-detector rollup
+            # (singa_tpu.regress): the aggregator's localization vote
+            # over these lines splits one-host-regressed (hardware
+            # suspect) from fleet-wide-regressed (software)
+            from . import regress
+            reg = regress.fleet_regress_snapshot()
+        except Exception:
+            reg = None
+        lines.append({"kind": "fleet_regress", "regress": reg})
         for rec in observe.span_records():
             lines.append({"kind": "fleet_span", "name": rec["name"],
                           "t0": rec["t0"], "dur": rec["dur"],
@@ -374,6 +385,8 @@ def read_shard(path: str) -> "dict | None":
                           if r.get("kind") == "fleet_capacity"), None),
         "audit": next((r.get("audit") for r in rows
                        if r.get("kind") == "fleet_audit"), None),
+        "regress": next((r.get("regress") for r in rows
+                         if r.get("kind") == "fleet_regress"), None),
         "spans": [r for r in rows if r.get("kind") == "fleet_span"],
     }
 
@@ -421,7 +434,8 @@ def merge_metric_snapshots(snaps: dict) -> dict:
 class _WorkerState:
     __slots__ = ("path", "host", "pid", "seq", "ts", "perf", "steps",
                  "started_ts", "metrics", "goodput", "health", "mem",
-                 "hang", "serve", "capacity", "audit", "spans",
+                 "hang", "serve", "capacity", "audit", "regress",
+                 "spans",
                  "prev_ts", "prev_steps", "step_rate", "over_since")
 
     def __init__(self, path):
@@ -441,6 +455,7 @@ class _WorkerState:
         self.serve = None  # per-host serving snapshot (slo.fleet_serve)
         self.capacity = None  # per-host headroom row (fleet_capacity)
         self.audit = None  # per-host param fingerprint (fleet_audit)
+        self.regress = None  # per-host detector rollup (fleet_regress)
         self.spans = {}   # (tid, t0, name) -> span rec, insertion-ordered
         self.prev_ts = None
         self.prev_steps = 0
@@ -553,6 +568,7 @@ class FleetAggregator:
             w.serve = shard.get("serve")
             w.capacity = shard.get("capacity")
             w.audit = shard.get("audit")
+            w.regress = shard.get("regress")
             if fresh and w.prev_ts and w.ts > w.prev_ts:
                 w.step_rate = max(
                     0.0, (w.steps - w.prev_steps) / (w.ts - w.prev_ts))
@@ -987,6 +1003,12 @@ class FleetAggregator:
                             self._audit_dissent.get(w.host) or {})
                         or None,
                     } if isinstance(w.audit, dict) else None,
+                    # regression observatory (fleet_regress shard
+                    # line): active-episode count + last verdict for
+                    # the /fleetz regression column and the
+                    # localization vote
+                    "regress": dict(w.regress)
+                    if isinstance(w.regress, dict) else None,
                 })
             # worst-HBM host: max live bytes across workers that
             # published a memory snapshot (freshest shard per host
@@ -1350,6 +1372,13 @@ def fleet_report() -> str:
     try:
         from . import audit as _audit_mod
         lines.extend(_audit_mod.fleetz_lines())
+    except Exception:
+        pass
+    # the regression observatory's per-host column + localization vote
+    # over the fleet_regress shard lines
+    try:
+        from . import regress as _regress_mod
+        lines.extend(_regress_mod.fleetz_lines())
     except Exception:
         pass
     steps_total = 0

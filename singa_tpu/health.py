@@ -66,6 +66,7 @@ KIND_MEM_LEAK = "mem_leak"    # memory-ledger sustained-growth verdict
 KIND_HANG = "hang"            # watchdog deadline-breach abort verdict
 KIND_SLO = "slo"              # SLO tracker sustained burn-rate breach
 KIND_DIVERGENCE = "divergence"  # audit correctness verdict (wrong tokens)
+KIND_REGRESSION = "regression"  # regress sustained-latency-regression verdict
 
 
 class HealthError(RuntimeError):
@@ -639,7 +640,7 @@ def record_nan_logits(n: int, kind: str):
 __all__ = [
     "POLICIES", "HealthError", "StepStatsCollector", "collector",
     "KIND_STRAGGLER", "KIND_MEM_LEAK", "KIND_HANG", "KIND_SLO",
-    "KIND_DIVERGENCE",
+    "KIND_DIVERGENCE", "KIND_REGRESSION",
     "apply_skip", "FlightRecorder", "load_flight_bundle", "HealthMonitor",
     "record_nan_logits", "set_active_monitor", "active_monitor",
 ]

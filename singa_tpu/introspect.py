@@ -442,6 +442,17 @@ def executable_manifest():
     return [dict(e) for e in _manifest]
 
 
+def latest_fingerprint(key: str) -> "str | None":
+    """The newest manifest fingerprint for `key`, or None before any
+    build. regress.py anchors each latency baseline to this: a baseline
+    whose fingerprint no longer matches is compile-cause evidence, and
+    only fingerprint-MATCHED baselines compare across restarts."""
+    for e in reversed(_manifest):
+        if e.get("key") == key:
+            return e.get("fingerprint")
+    return None
+
+
 def last_build(key: str) -> "dict | None":
     """The most recent build record for `key` (phases, cost, memory,
     blame) — bench.py --explain reads this."""
@@ -837,7 +848,8 @@ __all__ = [
     "set_peak_tflops", "peak_tflops",
     "signature", "blame", "build_compiled", "AotExecutor",
     "note_step_flops",
-    "capture_hlo", "executable_manifest", "last_build", "blame_history",
+    "capture_hlo", "executable_manifest", "latest_fingerprint",
+    "last_build", "blame_history",
     "compile_phase_totals",
     "explain", "format_explain", "reset", "main",
 ]

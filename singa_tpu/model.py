@@ -646,7 +646,10 @@ class Model(Layer, metaclass=ModelMeta):
         # region (nested no-op when a TrainController's outer guard is
         # already armed); a cold jit fallback's build span taints the
         # entry, so first-compile time neither breaches nor calibrates
-        with watchdog.guard("step"), observe.span("model.step"):
+        # tag attr: the regress detector baselines each optimizer-tag
+        # variant separately (different tags dispatch different
+        # executables with different per-step costs)
+        with watchdog.guard("step"), observe.span("model.step", tag=tag):
             try:
                 if cold_jit:
                     # nested mapped span: the fresh trace+compile nets

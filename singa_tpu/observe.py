@@ -945,6 +945,17 @@ def record_scaler_decision(rec: dict):
     _default.emit({**rec, "kind": "scaler_decision"})
 
 
+def record_regress_verdict(rec: dict):
+    """Mirror one regression conviction (singa_tpu.regress's verdict
+    record) into the in-memory event ring and any attached EventLog, so
+    convictions interleave with the step/serving records that produced
+    them. Counters/gauges stay in regress._metrics — this is only the
+    event-stream copy."""
+    if not _enabled:
+        return
+    _default.emit({**rec, "kind": "regress_verdict"})
+
+
 def record_bench(rec: dict):
     """Mirror a bench.py result record into the registry (gauges named
     singa_bench_<field>) and the EventLog, so BENCH_*.json artifacts and
@@ -975,6 +986,6 @@ __all__ = [
     "record_compile", "record_hbm", "record_opt_update", "record_comm",
     "record_comm_host",
     "record_decode", "record_bench", "record_scaler_decision",
-    "record_checkpoint_bytes",
+    "record_regress_verdict", "record_checkpoint_bytes",
     "record_prefetch", "record_ckpt_async",
 ]

@@ -319,6 +319,30 @@ def burn_rate(att: "float | None", target: float) -> "float | None":
 
 # ---- the tracker -----------------------------------------------------------
 
+def request_latency_sample(req, timeline: dict) -> "dict | None":
+    """Reduce one terminal (req, timeline) listener callback to the
+    latency sample the regression detector feeds on: {"ttft_s",
+    "itl_s", "tokens"}. None for anything that should not calibrate or
+    convict a latency baseline — synthetic audit probes (same door as
+    note_timeline), non-completed outcomes (an eviction's short total
+    is not a latency), and requests that never produced a first token.
+    itl_s is the mean inter-token latency (decode span over tokens
+    after the first); None when only one token was produced."""
+    if not timeline or timeline.get("synthetic"):
+        return None
+    if timeline.get("outcome") != "completed":
+        return None
+    ttft = timeline.get("ttft_s")
+    if ttft is None:
+        return None
+    tokens = int(timeline.get("new_tokens") or 0)
+    itl = None
+    total = timeline.get("total_s")
+    if total is not None and tokens > 1:
+        itl = max(0.0, (float(total) - float(ttft)) / (tokens - 1))
+    return {"ttft_s": float(ttft), "itl_s": itl, "tokens": tokens}
+
+
 class SLOTracker:
     """Evaluates an `SLOConfig` over the engine's terminal-request
     stream. `install()` subscribes it to `engine.add_request_listener`
@@ -1634,7 +1658,7 @@ def main(argv=None) -> int:
 
 __all__ = [
     "REQUEST_PHASES", "SLO_OBJECTIVES", "LATENCY_ATTR",
-    "SLOConfig", "SLOTracker",
+    "SLOConfig", "SLOTracker", "request_latency_sample",
     "objective_good", "attainment", "burn_rate", "phase_durations",
     "attribute_timeline", "attribute_route", "note_attribution",
     "tail_records", "tail_summary", "tail_report", "tail_json",

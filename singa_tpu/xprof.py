@@ -353,6 +353,60 @@ def top_ops(path_or_table, k: int = 10):
     return rows[:int(k)]
 
 
+def diff_op_tables(before, after):
+    """Per-op time delta between two op_table row lists: the evidence
+    bundle's "which ops got slower" section, useful standalone for any
+    before/after trace pair.
+
+    Returns rows sorted by regression contribution (delta_ms desc):
+      {op, category, before_ms, after_ms, delta_ms, ratio,
+       pct_of_regression}
+    `ratio` is after/before (None for ops absent on one side — a new op
+    diffs against 0, a vanished op contributes its negative delta).
+    `pct_of_regression` is each op's share of the total POSITIVE delta,
+    so the top rows name the regression even when other ops got faster.
+    Span envelope rows and python-frame "$file.py" TraceMe rows are
+    excluded, matching top_ops — the diff ranks device ops."""
+    def fold(rows):
+        out = {}
+        for r in rows or []:
+            if r.get("category") == "span" \
+                    or str(r.get("op", "")).startswith("$"):
+                continue
+            op = r.get("op")
+            if op is None:
+                continue
+            prev = out.get(op)
+            if prev is None:
+                out[op] = dict(r)
+            else:  # same op split across planes: sum it
+                prev["total_ms"] = (prev.get("total_ms") or 0.0) \
+                    + (r.get("total_ms") or 0.0)
+        return out
+
+    b, a = fold(before), fold(after)
+    rows = []
+    for op in set(b) | set(a):
+        bm = float((b.get(op) or {}).get("total_ms") or 0.0)
+        am = float((a.get(op) or {}).get("total_ms") or 0.0)
+        rows.append({
+            "op": op,
+            "category": (a.get(op) or b.get(op) or {}).get("category"),
+            "before_ms": round(bm, 6),
+            "after_ms": round(am, 6),
+            "delta_ms": round(am - bm, 6),
+            "ratio": round(am / bm, 4) if bm > 0.0 and op in a
+            else None,
+        })
+    pos = sum(r["delta_ms"] for r in rows if r["delta_ms"] > 0.0)
+    for r in rows:
+        r["pct_of_regression"] = (
+            round(100.0 * r["delta_ms"] / pos, 2)
+            if pos > 0.0 and r["delta_ms"] > 0.0 else 0.0)
+    rows.sort(key=lambda r: -r["delta_ms"])
+    return rows
+
+
 def span_table(logdir: str):
     """Just the observe.span() rows of op_table (category "span"),
     with the `singa.span/` prefix stripped — the bridge between the

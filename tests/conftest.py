@@ -54,10 +54,11 @@ def _metrics_isolation():
     thread alive, and no stray non-daemon thread behind."""
     from singa_tpu import (audit, capacity, diag, engine, fleet,
                            goodput, health, introspect, memory,
-                           observe, router, slo, watchdog)
+                           observe, regress, router, slo, watchdog)
     diag.stop_diag_server()
     goodput.uninstall()
     audit.reset()
+    regress.reset()
     router.reset()
     fleet.uninstall()
     engine.reset()
@@ -103,6 +104,23 @@ def _metrics_isolation():
         f"audit thread(s) left running: {leaked_audit} — call "
         "AuditObservatory.stop() / ParamFingerprinter.stop() (or "
         "audit.reset()) before the test ends")
+    # regress teardown (ISSUE-19): the regression detector uninstalled
+    # — its observe span listener and engine request listener detached,
+    # any singa-regress-profile-* capture threads joined, and the
+    # baseline store's JSONL handle closed. Runs BEFORE the tail/SLO
+    # listener checks below, which would otherwise misread the
+    # detector's request listener as a raw leak. Capture-then-clean
+    # like every block here: the leak is recorded first and cleaned
+    # regardless, so one leaky test fails itself without cascading
+    # into the suite.
+    leaked_regress = [t.name for t in threading.enumerate()
+                      if t.is_alive()
+                      and t.name.startswith("singa-regress")]
+    regress.reset()
+    assert not leaked_regress, (
+        f"regress thread(s) left running: {leaked_regress} — call "
+        "RegressionDetector.uninstall() (or regress.reset()) before "
+        "the test ends")
     # router teardown (ISSUE-15): the installed router stopped — its
     # dispatcher/health/sender threads joined, replica subprocesses
     # reaped, and every still-pending request drained with a TERMINAL

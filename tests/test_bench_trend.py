@@ -83,7 +83,10 @@ def test_regressions_flagged_against_best_prior_round():
     # observatory's oscillation/reaction counts (flaps 1->3, churn
     # 3->6, delay 2->4: all at or beyond +100%) — nor the audit
     # correctness records (divergence 6->11, miscompares 3->9,
-    # false positives 0->2 is delta inf)
+    # false positives 0->2 is delta inf) — nor the regression
+    # observatory's records (contention detect latency 3->9 windows,
+    # clean-arm false positives 0->3 is delta inf, verdicts_total
+    # 2->7)
     loose = bench_trend.find_regressions(table, threshold=0.5)
     assert {m for m, *_ in loose} == {"harn_ok", "router_lost_requests",
                                       "router_failover_requests",
@@ -92,7 +95,10 @@ def test_regressions_flagged_against_best_prior_round():
                                       "capacity_scale_up_delay_polls",
                                       "audit_divergence_count",
                                       "audit_canary_miscompare_count",
-                                      "audit_false_positive_count"}
+                                      "audit_false_positive_count",
+                                      "regress_contention_detect_windows",
+                                      "regress_false_positives",
+                                      "regress_verdicts_total"}
 
 
 def test_cli_exit_codes(capsys):
@@ -365,6 +371,59 @@ def test_router_loss_fixture_regression_flagged():
     rnd, v, best_r, best, delta = regs["router_failover_requests"]
     assert (rnd, v, best_r, best) == (4, 4.0, 3, 1.0)
     assert abs(delta - 3.0) < 1e-9
+
+
+def test_regress_observatory_metrics_lower_is_better():
+    """ISSUE-19 satellite: the regression observatory's outputs —
+    detection latency (`detect_windows`), clean-arm false positives,
+    and the `regress_*_total` incident counters — regress UP (a good
+    detector convicts the same injected slowdown FASTER, with fewer
+    false alarms), while the non-counter regress fields (bundle
+    round-trip ok-flags) stay higher-is-better."""
+    assert bench_trend.lower_is_better(
+        "regress_contention_detect_windows", "windows")
+    assert bench_trend.lower_is_better(
+        "regress_compile_detect_windows", "")
+    assert bench_trend.lower_is_better("regress_false_positives",
+                                       "count")
+    assert bench_trend.lower_is_better("regress_verdicts_total",
+                                       "count")
+    assert bench_trend.lower_is_better("singa_regress_bundles_total",
+                                       "")
+    assert not bench_trend.lower_is_better("regress_bundle_roundtrip",
+                                           "bool")
+    assert not bench_trend.lower_is_better("regressions_handled_per_s",
+                                           "items/s")
+
+
+def test_regress_fixture_regressions_flagged():
+    """The checked-in REG fixture rounds carry the --ab harness's
+    records: detection latency down / false positives flat at zero in
+    clean/ (no flag), and in regress/ a detect-latency rise (3 -> 9
+    windows), a 0 -> 3 clean-arm false-positive jump (delta inf) and a
+    verdicts_total rise (2 -> 7), all flagged against the best prior
+    round; the flat compile leg and the bundle round-trip flag are
+    not."""
+    clean = bench_trend.trend_table(bench_trend.collect([CLEAN]))
+    assert clean["regress_contention_detect_windows"]["by_round"] \
+        == {1: 3.0, 2: 2.0}
+    assert clean["regress_false_positives"]["by_round"] \
+        == {1: 0.0, 2: 0.0}
+    assert not [r for r in bench_trend.find_regressions(clean)
+                if r[0].startswith("regress_")]
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = {m: (rnd, v, best_r, best, delta)
+            for m, rnd, v, best_r, best, delta
+            in bench_trend.find_regressions(table, threshold=0.05)}
+    rnd, v, best_r, best, delta = \
+        regs["regress_contention_detect_windows"]
+    assert (rnd, v, best_r, best) == (2, 9.0, 1, 3.0)
+    assert abs(delta - 2.0) < 1e-9
+    rnd, v, best_r, best, delta = regs["regress_false_positives"]
+    assert (v, best) == (3.0, 0.0) and delta == float("inf")
+    assert regs["regress_verdicts_total"][1] == 7.0
+    assert "regress_compile_detect_windows" not in regs
+    assert "regress_bundle_roundtrip" not in regs
 
 
 def test_audit_metrics_lower_is_better():

@@ -767,6 +767,59 @@ def test_lint_covers_audit_metric_names():
     assert "verdict" in check_metrics_names.ENUM_LABEL_KWARGS
 
 
+def test_lint_covers_regress_metric_names():
+    """ISSUE-19: rule 5 extends to the regression observatory's
+    `cause=` label — REGRESS_CAUSES is recognized as the declared enum
+    tuple, every singa_regress_* registration in regress.py passes the
+    full lint (the dynamic `cause=rec["cause"]` record site is proven
+    by the `assert rec["cause"] in REGRESS_CAUSES` guard), and the new
+    kwarg is enforced."""
+    reg_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                          "regress.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(reg_py)}
+    assert {"singa_regress_windows_total",
+            "singa_regress_verdicts_total",
+            "singa_regress_recoveries_total",
+            "singa_regress_bundles_total",
+            "singa_regress_baselines",
+            "singa_regress_active_episodes",
+            "singa_regress_score"} <= names
+    assert all(n.startswith("singa_regress_") for n in names)
+    assert check_metrics_names.check([reg_py]) == []
+    import ast
+    enums, _consts = check_metrics_names._module_enum_info(
+        ast.parse(open(reg_py).read()))
+    assert enums["REGRESS_CAUSES"] == (
+        "compile", "workload_shift", "contention", "host", "unknown")
+    assert "cause" in check_metrics_names.ENUM_LABEL_KWARGS
+
+
+def test_cause_label_rule(tmp_path):
+    """A cause= literal outside REGRESS_CAUSES is a violation; members,
+    constant members, and enum-guarded dynamic values — regress.py's
+    `assert rec["cause"] in REGRESS_CAUSES` shape — pass, unguarded
+    dynamics fail."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "REGRESS_CAUSES = ('compile', 'contention', 'unknown')\n"
+        "CAUSE_COMPILE = 'compile'\n"
+        "observe.counter('singa_v_total', 'a').inc(cause='compile')\n"
+        "observe.counter('singa_v_total', 'a').inc(cause=CAUSE_COMPILE)\n"
+        "observe.counter('singa_v_total', 'a').inc(cause='gremlins')\n"
+        "def guarded(rec):\n"
+        "    assert rec['cause'] in REGRESS_CAUSES\n"
+        "    observe.counter('singa_v_total', 'a')"
+        ".inc(cause=rec['cause'])\n"
+        "def unguarded(rec):\n"
+        "    observe.counter('singa_v_total', 'a')"
+        ".inc(cause=rec['cause'])\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 2, problems
+    assert any("'gremlins'" in p for p in problems)
+    assert any("dynamic" in p for p in problems)
+
+
 def test_leg_and_audit_verdict_label_rules(tmp_path):
     """A leg= literal outside AUDIT_LEGS (or a verdict= outside
     AUDIT_VERDICTS) is a violation; members and enum-guarded dynamic

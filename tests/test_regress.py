@@ -1,0 +1,644 @@
+"""Performance regression observatory (ISSUE-19): baseline-store
+persistence and the cross-restart conviction, the windowed-CUSUM
+detector (freeze, sustained-slowdown conviction, straggler immunity,
+recovery), cause attribution for every member of REGRESS_CAUSES, the
+evidence-bundle round-trip through health.load_flight_bundle, the
+telemetry-gating contract (the verdict's event-stream mirror honors
+observe.enable(False); the health note and the detector ring do not),
+and the /regressz + /statusz + fleet surfaces."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from singa_tpu import diag, fleet, health, introspect, observe, regress, slo
+from singa_tpu.regress import (REGRESS_CAUSES, BaselineStore,
+                               RegressionDetector)
+
+
+def _detector(tmp_path, store=None, **kw):
+    """A small-window detector tuned so unit tests converge in a
+    handful of samples; never installed unless the test says so."""
+    kw.setdefault("warmup_samples", 8)
+    kw.setdefault("window", 4)
+    kw.setdefault("sustain", 2)
+    kw.setdefault("out_dir", str(tmp_path))
+    return RegressionDetector(store, **kw)
+
+
+def _warm(det, signal="model.step", value=0.01, n=None):
+    for _ in range(n if n is not None else det.warmup_samples):
+        det.feed(signal, value)
+
+
+def _slow_until_verdict(det, signal="model.step", value=0.03,
+                        max_samples=64):
+    for _ in range(max_samples):
+        det.feed(signal, value)
+        if det.verdicts():
+            return
+    raise AssertionError(
+        f"no verdict after {max_samples} slow samples: "
+        f"{det.signal_state(signal)}")
+
+
+def _note_build(key, fingerprint):
+    """Plant a manifest entry so _fingerprint_of resolves — the unit
+    stand-in for introspect.build_compiled's _register_build."""
+    introspect._manifest.append({"key": key, "fingerprint": fingerprint,
+                                 "hlo_path": None,
+                                 "ts": round(time.time(), 6)})
+
+
+def _get(url, timeout=60.0):
+    try:
+        r = urllib.request.urlopen(url, timeout=timeout)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---- the enum ---------------------------------------------------------------
+
+def test_regress_causes_enum():
+    assert REGRESS_CAUSES == ("compile", "workload_shift", "contention",
+                              "host", "unknown")
+    assert regress.CAUSE_COMPILE in REGRESS_CAUSES
+    assert regress.CAUSE_UNKNOWN in REGRESS_CAUSES
+
+
+# ---- piece 1: the baseline store --------------------------------------------
+
+def test_baseline_store_freeze_and_get(tmp_path):
+    p = str(tmp_path / "base.jsonl")
+    st = BaselineStore(p)
+    e = st.freeze("model.step", [0.01, 0.012, 0.011, 0.013],
+                  fingerprint="fpA")
+    assert e["kind"] == "baseline" and e["n"] == 4
+    assert e["median_s"] == pytest.approx(0.0115)
+    assert st.get("model.step")["fingerprint"] == "fpA"
+    assert st.get("nope") is None
+    st.close()
+    # persisted as one JSONL baseline line, last-line-wins on reload
+    lines = [json.loads(x) for x in open(p) if x.strip()]
+    assert [r["kind"] for r in lines] == ["baseline"]
+
+
+def test_baseline_store_prior_and_restart_regression(tmp_path):
+    p = str(tmp_path / "base.jsonl")
+    a = BaselineStore(p)
+    a.freeze("model.step", [0.01] * 8, fingerprint="fpA")
+    a.freeze("engine.step", [0.02] * 8, fingerprint="fpB")
+    a.close()
+    b = BaselineStore(p, restart_factor=1.5)
+    assert b.prior("model.step")["median_s"] == pytest.approx(0.01)
+    # same fingerprint, 3x slower: a cross-restart regression
+    slow = b.freeze("model.step", [0.03] * 8, fingerprint="fpA")
+    rr = b.restart_regression(slow)
+    assert rr is not None and rr["ratio"] == pytest.approx(3.0)
+    assert rr["prior"]["median_s"] == pytest.approx(0.01)
+    # inside the restart_factor band: no verdict
+    ok = b.freeze("engine.step", [0.025] * 8, fingerprint="fpB")
+    assert b.restart_regression(ok) is None
+    # fingerprint moved: a different program, not a regression of it
+    moved = b.freeze("model.step", [0.05] * 8, fingerprint="fpC")
+    assert b.restart_regression(moved) is None
+    # no prior at all
+    fresh = b.freeze("request.ttft", [0.05] * 8, fingerprint="fpD")
+    assert b.restart_regression(fresh) is None
+    b.close()
+
+
+def test_baseline_store_tolerates_garbage_lines(tmp_path):
+    p = tmp_path / "base.jsonl"
+    p.write_text('not json\n{"kind": "other"}\n'
+                 '{"kind": "baseline", "signal": "model.step", '
+                 '"median_s": 0.01, "fingerprint": "fpA"}\n')
+    st = BaselineStore(str(p))
+    assert st.prior("model.step")["median_s"] == 0.01
+    st.close()
+
+
+# ---- piece 2: windowed-CUSUM detection --------------------------------------
+
+def test_detector_freezes_then_convicts_sustained_slowdown(tmp_path):
+    det = _detector(tmp_path)
+    _warm(det, value=0.01)
+    st = det.signal_state("model.step")
+    assert st["state"] == "ok"
+    assert st["baseline_median_s"] == pytest.approx(0.01)
+    # clean windows at the baseline never advance the score
+    for _ in range(3 * det.window):
+        det.feed("model.step", 0.01)
+    assert det.signal_state("model.step")["cusum"] == 0.0
+    assert det.verdicts() == []
+    # a sustained 3x slowdown convicts within `sustain` windows
+    _slow_until_verdict(det, value=0.03)
+    v = det.verdicts()[0]
+    assert v["signal"] == "model.step"
+    assert v["cause"] in REGRESS_CAUSES
+    assert v["ratio"] == pytest.approx(3.0)
+    assert v["restart"] is False
+    assert det.signal_state("model.step")["state"] == "REGRESSED"
+    # conviction latency: sustain windows past the clean arm
+    assert v["window"] - 3 == det.sustain
+
+
+def test_single_straggler_sample_does_not_convict(tmp_path):
+    det = _detector(tmp_path)
+    _warm(det, value=0.01)
+    # one wild sample per window: the window MEDIAN is what the CUSUM
+    # consumes, so the score never moves
+    for _ in range(4):
+        det.feed("model.step", 0.01)
+        det.feed("model.step", 0.01)
+        det.feed("model.step", 0.01)
+        det.feed("model.step", 1.0)
+    st = det.signal_state("model.step")
+    assert st["windows"] == 4 and st["cusum"] == 0.0
+    assert det.verdicts() == []
+
+
+def test_z_cap_bounds_single_window_score(tmp_path):
+    det = _detector(tmp_path, z_cap=8.0, k=0.5)
+    _warm(det, value=0.01)
+    for _ in range(det.window):  # one catastrophic window
+        det.feed("model.step", 10.0)
+    st = det.signal_state("model.step")
+    assert st["z"] == 8.0  # capped, not (10-0.01)/sigma
+    assert st["cusum"] == pytest.approx(7.5)
+    assert det.verdicts() == []  # sustain=2: one window is not enough
+
+
+def test_episode_recovers_and_counts(tmp_path):
+    det = _detector(tmp_path)
+    _warm(det, value=0.01)
+    _slow_until_verdict(det, value=0.03)
+    assert det.signal_state("model.step")["state"] == "REGRESSED"
+    # back under the baseline band for recover_sustain windows
+    for _ in range(det.recover_sustain * det.window):
+        det.feed("model.step", 0.01)
+    st = det.signal_state("model.step")
+    assert st["state"] == "ok" and st["cusum"] == 0.0
+    m = observe.get_registry().get("singa_regress_recoveries_total")
+    assert m is not None and m.value() == 1
+    recs = [r for r in observe.get_registry().recent
+            if r.get("kind") == "regress_recovery"]
+    assert recs and recs[-1]["signal"] == "model.step"
+
+
+def test_max_signals_bounds_tracking(tmp_path):
+    det = _detector(tmp_path, max_signals=2)
+    det.feed("a", 0.01)
+    det.feed("b", 0.01)
+    det.feed("c", 0.01)
+    assert det.signal_state("c") is None
+    assert det.snapshot()["n_signals"] == 2
+
+
+# ---- signal mapping / listener feeds ----------------------------------------
+
+def test_signal_of_mapping():
+    f = RegressionDetector._signal_of
+    assert f("model.step", {}) == "model.step"
+    assert f("model.step", {"tag": "eval"}) == "model.step.teval"
+    assert f("serving.engine_step", {}) == "engine.step"
+    assert f("serving.engine_prefill", {"bucket": 16}) \
+        == "engine.prefill.16"
+    assert f("serving.engine_prefill", {}) == "engine.prefill"
+    assert f("opt.apply_updates", {}) is None
+
+
+def test_span_listener_feeds_installed_detector(tmp_path):
+    det = _detector(tmp_path).install()
+    try:
+        with observe.span("model.step"):
+            pass
+        assert det.signal_state("model.step")["samples"] == 1
+        # unmapped spans are ignored
+        with observe.span("data.load"):
+            pass
+        assert det.snapshot()["n_signals"] == 1
+    finally:
+        regress.reset()
+    # detached: further spans no longer feed
+    with observe.span("model.step"):
+        pass
+    assert det.signal_state("model.step")["samples"] == 1
+
+
+def test_jit_fallback_taints_enclosing_step_sample(tmp_path):
+    det = _detector(tmp_path)
+    det.feed("model.step", 0.01)
+    # a nested build exits BEFORE its parent step span: the taint must
+    # absorb the step sample that follows (first-compile time neither
+    # calibrates nor convicts)
+    det._on_span("model.step/model.jit_fallback", 0.5, {})
+    det._on_span("model.step", 0.6, {})
+    assert det.signal_state("model.step")["samples"] == 1
+    det._on_span("model.step", 0.01, {})
+    assert det.signal_state("model.step")["samples"] == 2
+
+
+def test_request_listener_feeds_ttft_and_itl(tmp_path):
+    det = _detector(tmp_path)
+    tl = {"outcome": "completed", "ttft_s": 0.1, "total_s": 0.5,
+          "new_tokens": 5}
+    det._on_request(None, tl)
+    assert det.signal_state("request.ttft")["samples"] == 1
+    assert det.signal_state("request.itl")["samples"] == 1
+    # synthetic audit probes and non-completed outcomes are excluded
+    det._on_request(None, dict(tl, synthetic=True))
+    det._on_request(None, dict(tl, outcome="evicted"))
+    assert det.signal_state("request.ttft")["samples"] == 1
+
+
+def test_request_latency_sample_contract():
+    tl = {"outcome": "completed", "ttft_s": 0.1, "total_s": 0.5,
+          "new_tokens": 5}
+    s = slo.request_latency_sample(None, tl)
+    assert s["ttft_s"] == pytest.approx(0.1)
+    assert s["itl_s"] == pytest.approx(0.1)  # (0.5-0.1)/(5-1)
+    assert s["tokens"] == 5
+    assert slo.request_latency_sample(None, None) is None
+    assert slo.request_latency_sample(
+        None, dict(tl, synthetic=True)) is None
+    assert slo.request_latency_sample(
+        None, dict(tl, outcome="timeout")) is None
+    assert slo.request_latency_sample(
+        None, dict(tl, ttft_s=None)) is None
+    # a single-token request has no inter-token latency
+    one = slo.request_latency_sample(
+        None, dict(tl, new_tokens=1))
+    assert one["itl_s"] is None and one["tokens"] == 1
+
+
+# ---- the cross-restart conviction (acceptance criterion) --------------------
+
+def test_cross_restart_baseline_convicts_slow_incarnation(tmp_path):
+    path = str(tmp_path / "REGRESS_baselines.jsonl")
+    _note_build("step", "fp-restart")
+    # incarnation A: freezes fast and persists
+    a = _detector(tmp_path, store=BaselineStore(path))
+    _warm(a, value=0.01)
+    assert a.verdicts() == []
+    a.uninstall()
+    # incarnation B: same fingerprint, 3x slower — convicted AT FREEZE
+    b = _detector(tmp_path, store=BaselineStore(path))
+    _warm(b, value=0.03)
+    vs = b.verdicts()
+    assert len(vs) == 1
+    v = vs[0]
+    assert v["restart"] is True
+    assert v["ratio"] == pytest.approx(3.0)
+    assert v["baseline_median_s"] == pytest.approx(0.01)  # the PRIOR's
+    # a fresh process has no recompile blame: a slow deploy must not
+    # masquerade as compile
+    assert v["cause"] != regress.CAUSE_COMPILE
+    assert v["cause"] in REGRESS_CAUSES
+    b.uninstall()
+
+
+def test_cross_restart_needs_fingerprint_match(tmp_path):
+    path = str(tmp_path / "REGRESS_baselines.jsonl")
+    _note_build("step", "fp-v1")
+    a = _detector(tmp_path, store=BaselineStore(path))
+    _warm(a, value=0.01)
+    a.uninstall()
+    _note_build("step", "fp-v2")  # the executable changed
+    b = _detector(tmp_path, store=BaselineStore(path))
+    _warm(b, value=0.03)
+    assert b.verdicts() == []  # different program: not comparable
+    b.uninstall()
+
+
+# ---- cause attribution ------------------------------------------------------
+
+def test_attribution_compile(tmp_path):
+    _note_build("step", "fpA")
+    det = _detector(tmp_path)
+    _warm(det, value=0.01)
+    # a recompile after the freeze: blame record + fingerprint drift
+    introspect._blames.append(
+        {"key": "step", "reason": "batch_bucket", "detail": "8->64",
+         "fingerprint": "fpB", "ts": round(time.time(), 6)})
+    _note_build("step", "fpB")
+    _slow_until_verdict(det, value=0.03)
+    v = det.verdicts()[0]
+    assert v["cause"] == regress.CAUSE_COMPILE
+    assert v["evidence"]["fingerprint_changed"] is True
+    assert v["evidence"]["blames"][0]["reason"] == "batch_bucket"
+    assert v["baseline_fingerprint"] == "fpA"
+    assert v["fingerprint"] == "fpB"
+
+
+def test_attribution_contention_via_queue_depth(tmp_path):
+    det = _detector(tmp_path)
+    # warmup with an empty admission queue in the span attrs
+    for _ in range(det.warmup_samples):
+        det._on_span("serving.engine_step", 0.01, {"queue": 0})
+    # slow at the same work, queue deep past its freeze level
+    for _ in range(8 * det.window):
+        det._on_span("serving.engine_step", 0.03, {"queue": 8})
+        if det.verdicts():
+            break
+    v = det.verdicts()[0]
+    assert v["signal"] == "engine.step"
+    assert v["cause"] == regress.CAUSE_CONTENTION
+    env = v["evidence"]["env"]
+    assert env["now"]["span_queue"] > (env["frozen"]["span_queue"] or 0)
+
+
+def test_attribution_workload_shift_via_output_length(tmp_path):
+    det = _detector(tmp_path)
+
+    def req(ttft, tokens):
+        det._on_request(None, {"outcome": "completed", "ttft_s": ttft,
+                               "total_s": ttft + 0.01 * tokens,
+                               "new_tokens": tokens})
+
+    for _ in range(det.warmup_samples):
+        req(0.01, 10)
+    # requests got 4x longer AND slower: the mix moved, not the host
+    for _ in range(8 * det.window):
+        req(0.05, 40)
+        if any(v["signal"] == "request.ttft" for v in det.verdicts()):
+            break
+    v = next(x for x in det.verdicts() if x["signal"] == "request.ttft")
+    assert v["cause"] == regress.CAUSE_WORKLOAD_SHIFT
+    assert v["evidence"]["mix"]["shifted"] is True
+    assert v["evidence"]["mix"]["out_len_ratio"] == pytest.approx(
+        4.0, rel=0.2)
+
+
+def _write_regress_shard(fleet_dir, host, pid, active):
+    """Hand-build one worker shard carrying a fleet_regress line (the
+    test_fleet.py fake-shard pattern)."""
+    os.makedirs(fleet_dir, exist_ok=True)
+    rows = [
+        {"kind": "fleet_shard_header", "version": 1, "seq": 1,
+         "host": host, "pid": pid, "ts": time.time(),
+         "perf": time.perf_counter(), "started_ts": 0.0, "steps": 10},
+        {"kind": "fleet_regress",
+         "regress": {"signals": 2, "baselines": 2, "active": active,
+                     "active_signals": ["engine.step"] if active else [],
+                     "verdicts": active, "windows": 20,
+                     "last": {"signal": "engine.step",
+                              "cause": "unknown", "ratio": 2.5,
+                              "restart": False, "ts": time.time()}
+                     if active else None}},
+    ]
+    path = os.path.join(fleet_dir, f"worker_{pid}" + fleet.SHARD_SUFFIX)
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in rows:
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def test_fleet_regress_vote_localizes_one_host(tmp_path):
+    d = str(tmp_path)
+    _write_regress_shard(d, "host0", 100, active=0)
+    _write_regress_shard(d, "host1", 101, active=1)
+    _write_regress_shard(d, "host2", 102, active=0)
+    agg = fleet.install_aggregator(d, stale_after_s=60.0)
+    try:
+        agg.poll()
+        vote = regress.fleet_regress_vote()
+        assert vote == {"verdict": "host", "voters": 3,
+                        "regressed": ["host1"]}
+        lines = regress.fleetz_lines()
+        assert lines[0] == "== fleet regress =="
+        assert any(x.startswith("host1") for x in lines)
+        assert any("vote: host" in x for x in lines)
+    finally:
+        fleet.uninstall()
+
+
+def test_fleet_regress_vote_fleet_wide_is_software(tmp_path):
+    d = str(tmp_path)
+    for i in range(3):
+        _write_regress_shard(d, f"host{i}", 100 + i, active=1)
+    agg = fleet.install_aggregator(d, stale_after_s=60.0)
+    try:
+        agg.poll()
+        vote = regress.fleet_regress_vote()
+        assert vote["verdict"] == "software"
+        assert len(vote["regressed"]) == 3
+    finally:
+        fleet.uninstall()
+
+
+def test_fleet_regress_vote_needs_quorum(tmp_path):
+    d = str(tmp_path)
+    _write_regress_shard(d, "host0", 100, active=1)
+    _write_regress_shard(d, "host1", 101, active=0)
+    agg = fleet.install_aggregator(d, stale_after_s=60.0)
+    try:
+        agg.poll()
+        assert regress.fleet_regress_vote() is None  # 2 < 3 voters
+    finally:
+        fleet.uninstall()
+    assert regress.fleet_regress_vote() is None  # no aggregator at all
+
+
+def test_attribution_host_from_fleet_vote(tmp_path):
+    d = str(tmp_path / "spool")
+    _write_regress_shard(d, "host0", 100, active=0)
+    _write_regress_shard(d, "host1", 101, active=1)
+    _write_regress_shard(d, "host2", 102, active=0)
+    agg = fleet.install_aggregator(d, stale_after_s=60.0)
+    try:
+        agg.poll()
+        det = _detector(tmp_path)
+        _warm(det, value=0.01)
+        _slow_until_verdict(det, value=0.03)
+        v = det.verdicts()[0]
+        assert v["cause"] == regress.CAUSE_HOST
+        assert v["evidence"]["fleet_vote"]["regressed"] == ["host1"]
+    finally:
+        fleet.uninstall()
+
+
+def test_attribution_unknown_without_evidence(tmp_path):
+    det = _detector(tmp_path)
+    _warm(det, value=0.01)
+    _slow_until_verdict(det, value=0.03)
+    assert det.verdicts()[0]["cause"] == regress.CAUSE_UNKNOWN
+
+
+# ---- the evidence bundle ----------------------------------------------------
+
+def test_conviction_writes_bundle_that_roundtrips(tmp_path):
+    det = _detector(tmp_path)
+    _warm(det, value=0.01)
+    _slow_until_verdict(det, value=0.03)
+    v = det.verdicts()[0]
+    path = v["bundle"]
+    assert path and os.path.isfile(path)
+    assert det.bundles() == [path]
+    name = os.path.basename(path)
+    assert name == "flight_regress_1.jsonl"
+    assert diag._BUNDLE_RE.match(name)  # /flightz indexes it
+    b = health.load_flight_bundle(path)
+    h = b["header"]
+    assert h["kind"] == "flight_header"
+    assert h["reason"] == "regression"
+    assert h["signal"] == "model.step"
+    assert h["verdict"]["cause"] == v["cause"]
+    assert h["verdict"]["ratio"] == pytest.approx(3.0)
+    assert h["baseline"]["median_s"] == pytest.approx(0.01)
+    # one flight_step line per retained raw sample
+    assert len(b["steps"]) == h["n_steps"] > 0
+    assert all(s["signal"] == "model.step" for s in b["steps"])
+    m = observe.get_registry().get("singa_regress_bundles_total")
+    assert m is not None and m.value() == 1
+
+
+# ---- telemetry gating -------------------------------------------------------
+
+def test_verdict_metrics_and_event_mirror(tmp_path):
+    det = _detector(tmp_path)
+    _warm(det, value=0.01)
+    _slow_until_verdict(det, value=0.03)
+    reg = observe.get_registry()
+    v = det.verdicts()[0]
+    assert reg.get("singa_regress_verdicts_total").value(
+        cause=v["cause"]) == 1
+    assert reg.get("singa_regress_windows_total").value() > 0
+    assert reg.get("singa_regress_baselines").value() == 1
+    assert reg.get("singa_regress_active_episodes").value() == 1
+    assert reg.get("singa_regress_score").value(
+        signal="model.step") > 0
+    mirrors = [r for r in reg.recent
+               if r.get("kind") == "regress_verdict"]
+    assert mirrors and mirrors[-1]["signal"] == "model.step"
+
+
+def test_detection_survives_enable_false_but_telemetry_gated(tmp_path):
+    mon = health.HealthMonitor(out_dir=str(tmp_path))
+    health.set_active_monitor(mon)
+    observe.enable(False)
+    try:
+        det = _detector(tmp_path)
+        _warm(det, value=0.01)
+        _slow_until_verdict(det, value=0.03)
+        # detection + forensics are NOT telemetry: the ring, the
+        # bundle, and the health note all survive enable(False)
+        v = det.verdicts()[0]
+        assert os.path.isfile(v["bundle"])
+        notes = [r for r in mon.recorder.ring
+                 if r.get("external") == health.KIND_REGRESSION]
+        assert len(notes) == 1
+        assert notes[0]["detail"]["signal"] == "model.step"
+        # the telemetry mirror IS gated: no metrics, no event record
+        reg = observe.get_registry()
+        assert reg.get("singa_regress_verdicts_total") is None
+        assert not [r for r in reg.recent
+                    if r.get("kind") == "regress_verdict"]
+    finally:
+        observe.enable(True)
+        health.set_active_monitor(None)
+
+
+# ---- lifecycle --------------------------------------------------------------
+
+def test_install_uninstall_reset_lifecycle(tmp_path):
+    det = _detector(tmp_path).install()
+    assert regress.get_detector() is det
+    det2 = _detector(tmp_path).install()  # replaces AND uninstalls
+    assert regress.get_detector() is det2
+    assert det._installed is False
+    regress.uninstall()
+    assert regress.get_detector() is None
+    det2.uninstall()  # idempotent
+    regress.reset()
+    assert not [t.name for t in threading.enumerate()
+                if t.name.startswith("singa-regress")]
+
+
+def test_uninstall_closes_store(tmp_path):
+    p = str(tmp_path / "base.jsonl")
+    det = _detector(tmp_path, store=BaselineStore(p)).install()
+    _warm(det, value=0.01)
+    regress.reset()
+    assert det.store._fh is None
+    # the freeze made it to disk before the close
+    assert BaselineStore._load(p)["model.step"]["median_s"] \
+        == pytest.approx(0.01)
+
+
+def test_fleet_regress_snapshot_and_shard_line(tmp_path):
+    assert regress.fleet_regress_snapshot() is None
+    det = _detector(tmp_path).install()
+    try:
+        _warm(det, value=0.01)
+        _slow_until_verdict(det, value=0.03)
+        snap = regress.fleet_regress_snapshot()
+        assert snap["baselines"] == 1 and snap["active"] == 1
+        assert snap["active_signals"] == ["model.step"]
+        assert snap["verdicts"] == 1
+        assert snap["last"]["signal"] == "model.step"
+        # the shard writer publishes it as the fleet_regress line
+        w = fleet.ShardWriter(str(tmp_path / "spool"), interval_s=0,
+                              host="hostA", name="worker_a")
+        w.publish()
+        shard = fleet.read_shard(w.path)
+        assert shard["regress"]["active"] == 1
+        w.close(final_publish=False)
+    finally:
+        regress.reset()
+        fleet.uninstall()
+
+
+# ---- reports / surfaces -----------------------------------------------------
+
+def test_regress_report_without_detector():
+    assert "no RegressionDetector installed" in regress.regress_report()
+    assert regress.regress_json() == {"installed": False}
+
+
+def test_regress_report_table_and_json(tmp_path):
+    det = _detector(tmp_path).install()
+    try:
+        _warm(det, value=0.01)
+        _slow_until_verdict(det, value=0.03)
+        rep = regress.regress_report()
+        assert "== regress ==" in rep and "base ms" in rep
+        assert "model.step" in rep and "REGRESSED" in rep
+        assert "verdicts:" in rep
+        assert "flight_regress_1.jsonl" in rep
+        j = regress.regress_json()
+        assert j["installed"] is True
+        assert j["snapshot"]["active"] == ["model.step"]
+        assert j["verdicts"][0]["signal"] == "model.step"
+    finally:
+        regress.reset()
+
+
+def test_regressz_endpoint_and_statusz_block(tmp_path):
+    srv = diag.start_diag_server(port=0)
+    try:
+        code, body = _get(srv.url + "/regressz")
+        assert code == 503  # no detector yet
+        det = _detector(tmp_path).install()
+        _warm(det, value=0.01)
+        code, body = _get(srv.url + "/regressz")
+        assert code == 200 and "== regress ==" in body
+        assert "model.step" in body
+        code, body = _get(srv.url + "/regressz?json=1")
+        assert code == 200
+        j = json.loads(body)
+        assert j["installed"] is True
+        assert j["snapshot"]["baselines"] == 1
+        code, body = _get(srv.url + "/statusz")
+        assert code == 200 and "== regress ==" in body
+        code, body = _get(srv.url + "/")
+        assert "/regressz" in body
+    finally:
+        regress.reset()
+        diag.stop_diag_server()

@@ -114,3 +114,91 @@ def test_top_ops(tracedir):
     kept = [r for r in rows if r["category"] != "span"
             and not r["op"].startswith("$")]
     assert top[0]["op"] == kept[0]["op"]
+
+
+# ---- diff_op_tables (ISSUE-19 satellite) ------------------------------------
+
+_BEFORE = [
+    {"op": "fusion.1", "category": "fusion", "total_ms": 2.0},
+    {"op": "copy.2", "category": "copy", "total_ms": 1.0},
+    {"op": "gone.3", "category": "fusion", "total_ms": 0.5},
+    # span envelopes and python-frame rows must not enter the diff
+    {"op": "singa.span/model.step", "category": "span",
+     "total_ms": 9.9},
+    {"op": "$train.py:10 step", "category": "host", "total_ms": 5.0},
+]
+_AFTER = [
+    {"op": "fusion.1", "category": "fusion", "total_ms": 6.0},
+    {"op": "copy.2", "category": "copy", "total_ms": 0.5},
+    {"op": "new.4", "category": "fusion", "total_ms": 1.0},
+    {"op": "singa.span/model.step", "category": "span",
+     "total_ms": 30.0},
+]
+
+
+def test_diff_op_tables_deltas_and_ordering():
+    rows = xprof.diff_op_tables(_BEFORE, _AFTER)
+    by_op = {r["op"]: r for r in rows}
+    assert set(by_op) == {"fusion.1", "copy.2", "gone.3", "new.4"}
+    # sorted by regression contribution: the op that got slower leads
+    assert rows[0]["op"] == "fusion.1"
+    f = by_op["fusion.1"]
+    assert f["before_ms"] == 2.0 and f["after_ms"] == 6.0
+    assert f["delta_ms"] == 4.0 and f["ratio"] == 3.0
+    assert by_op["copy.2"]["delta_ms"] == -0.5
+    assert by_op["copy.2"]["ratio"] == 0.5
+    deltas = [r["delta_ms"] for r in rows]
+    assert deltas == sorted(deltas, reverse=True)
+
+
+def test_diff_op_tables_one_sided_ops():
+    rows = xprof.diff_op_tables(_BEFORE, _AFTER)
+    by_op = {r["op"]: r for r in rows}
+    # a new op diffs against 0 with no finite ratio
+    n = by_op["new.4"]
+    assert n["before_ms"] == 0.0 and n["after_ms"] == 1.0
+    assert n["delta_ms"] == 1.0 and n["ratio"] is None
+    # a vanished op contributes its negative delta, ratio None
+    g = by_op["gone.3"]
+    assert g["after_ms"] == 0.0 and g["delta_ms"] == -0.5
+    assert g["ratio"] is None
+    assert g["category"] == "fusion"  # carried from the before side
+
+
+def test_diff_op_tables_pct_of_regression():
+    rows = xprof.diff_op_tables(_BEFORE, _AFTER)
+    by_op = {r["op"]: r for r in rows}
+    # positive-delta pool: fusion.1 (+4.0) + new.4 (+1.0) = 5.0
+    assert by_op["fusion.1"]["pct_of_regression"] == 80.0
+    assert by_op["new.4"]["pct_of_regression"] == 20.0
+    # ops that got faster never claim a share of the regression
+    assert by_op["copy.2"]["pct_of_regression"] == 0.0
+    assert by_op["gone.3"]["pct_of_regression"] == 0.0
+
+
+def test_diff_op_tables_folds_split_rows_and_empty_inputs():
+    # the same op split across planes is summed before diffing
+    before = [{"op": "a", "category": "fusion", "total_ms": 1.0},
+              {"op": "a", "category": "fusion", "total_ms": 2.0}]
+    after = [{"op": "a", "category": "fusion", "total_ms": 9.0}]
+    [row] = xprof.diff_op_tables(before, after)
+    assert row["before_ms"] == 3.0 and row["ratio"] == 3.0
+    assert xprof.diff_op_tables([], []) == []
+    assert xprof.diff_op_tables(None, None) == []
+    # an all-faster diff has no regression pool: every pct is 0
+    rows = xprof.diff_op_tables(after, before)
+    assert rows[0]["pct_of_regression"] == 0.0
+
+
+def test_diff_op_tables_real_capture_self_diff(tracedir):
+    """End-to-end on a real capture: a table diffed against itself is
+    all-zero deltas over exactly the top_ops row set."""
+    rows = xprof.op_table(tracedir)
+    diff = xprof.diff_op_tables(rows, rows)
+    assert diff
+    assert all(r["delta_ms"] == 0.0 for r in diff)
+    # ratio is 1.0 wherever there was measurable time (a 0 ms op has
+    # no finite self-ratio)
+    assert all(r["ratio"] == 1.0 for r in diff if r["before_ms"] > 0.0)
+    assert {r["op"] for r in diff} \
+        == {r["op"] for r in xprof.top_ops(rows, 10 ** 9)}

@@ -32,7 +32,11 @@ capacity_decision_flaps), and correctness-observatory incident counts
 (any metric naming `divergence`, `miscompare`, or `false_positive` —
 AUDIT_r*.json's audit_divergence_count / audit_canary_miscompare_count
 / audit_false_positive_count, where more wrong-token incidents or
-false alarms at the same injected fault is the regression) regress UP,
+false alarms at the same injected fault is the regression), and the
+regression observatory's outputs (any metric naming `detect_windows`
+— REG_r*.json's detection latency, where convicting the same injected
+slowdown later is the regression — plus `regress_*_total` incident
+counters and `false_positives`) regress UP,
 everything else
 (throughput, ratios, ok-flags) regresses DOWN. Rate units ("tokens/s") always win over the
 name heuristics, and SLO `attainment` metrics plus speculative-decode
@@ -84,10 +88,15 @@ LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_seconds", "_latency", "_bytes",
 #: audit_canary_miscompare_count / audit_false_positive_count), where
 #: any rise — especially zero-to-nonzero false positives — is the
 #: regression
+#: `detect_windows` is the regression observatory's detection latency
+#: (REG_rNN's regress_contention_detect_windows /
+#: regress_compile_detect_windows) — convicting the same injected
+#: slowdown LATER is the regression
 LOWER_BETTER_SUBSTRINGS = ("ttft", "dropped", "lost", "failover",
                            "startup", "cold", "spawn", "flap",
                            "decision_churn", "delay", "divergence",
-                           "miscompare", "false_positive")
+                           "miscompare", "false_positive",
+                           "detect_windows")
 #: name substrings that mark a higher-is-better metric even when a
 #: lower-better suffix would otherwise match — SLO attainment records
 #: end in `_pct` (and the percentile suffixes), but a DROP in
@@ -197,6 +206,16 @@ def lower_is_better(metric: str, unit: str) -> bool:
     if u in LOWER_BETTER_UNITS:
         return True
     if any(sub in metric.lower() for sub in LOWER_BETTER_SUBSTRINGS):
+        return True
+    m = metric.lower()
+    if m.startswith(("regress_", "singa_regress_")) \
+            and m.endswith("_total"):
+        # the regression observatory's incident counters
+        # (regress_verdicts_total, regress_bundles_total mirrors):
+        # more convictions/bundles at the SAME injected fault means
+        # the detector got noisier — only the `_total` counters; the
+        # other regress_* fields (roundtrip ok-flags) stay
+        # higher-is-better
         return True
     return any(metric.endswith(sfx) for sfx in LOWER_BETTER_SUFFIXES)
 
