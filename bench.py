@@ -216,6 +216,13 @@ def main():
                    help="attach a JSONL EventLog: per-step records during "
                         "the run plus the final bench record, same schema "
                         "as runtime telemetry")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="enable the warm store (singa_tpu.warmstart) "
+                        "rooted at DIR: staged builds persist serialized "
+                        "executables + the XLA compile cache there and a "
+                        "second run loads them — with --goodput the "
+                        "compile bucket collapses on the warm run; the "
+                        "record gains a compile_cache section")
     args = p.parse_args()
     if args.amp is None:
         args.amp = True
@@ -232,6 +239,12 @@ def main():
 
     if args.events_out:
         observe.set_event_log(args.events_out)
+
+    if args.compile_cache:
+        from singa_tpu import warmstart
+        # enabled before any staged build so the FIRST compile already
+        # exports into the store (and a warm rerun loads from it)
+        warmstart.enable(args.compile_cache)
 
     goodput_tracker = None
     if args.goodput or args.diag_port is not None:
@@ -944,6 +957,16 @@ def main():
         rec.update(regress_fields)  # mirrored into singa_bench_* below
     if overlap_fields:
         rec.update(overlap_fields)  # mirrored into singa_bench_* below
+    if args.compile_cache:
+        from singa_tpu import warmstart
+        ws = warmstart.snapshot()
+        rec["compile_cache"] = {
+            "root": ws["root"], "lookups": ws["lookups"],
+            "hit_rate": ws["hit_rate"], "exports": ws["exports"],
+            "entries": ws.get("entries"),
+            "store_bytes": ws.get("store_bytes")}
+        if ws["hit_rate"] is not None:
+            rec["compile_cache_hit_rate"] = round(ws["hit_rate"], 4)
     if args.explain:
         # the timed step compiled through the AOT stages (model.py); use
         # the build record snapshotted before the --health arm rather

@@ -139,7 +139,17 @@ def main():
     p.add_argument("--serve-slo-tok-s", type=float, default=0.0,
                    help="per-request tokens/sec floor (0 disables the "
                         "objective)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="enable the warm store (singa_tpu.warmstart) "
+                        "rooted at DIR: the decode/prefill/spec "
+                        "executables persist there and a rerun loads "
+                        "them instead of compiling")
     args = p.parse_args()
+
+    if args.compile_cache:
+        from singa_tpu import warmstart
+        # before any staged build, so every mode's executables persist
+        warmstart.enable(args.compile_cache)
 
     if args.spec:
         return spec_main(args)

@@ -20,6 +20,16 @@ Shims:
   attribute check, `make_` constructs (returning None on an orbax too
   old to have it) — singa_tpu.overlap falls back to the blocking
   `StandardCheckpointer` write in that case.
+- `has_jax_export` / `has_aot_serialize` / `serialize_executable` /
+  `deserialize_executable`: executable serialization for the
+  warm-start layer (singa_tpu.warmstart). Modern jax serializes a
+  jitted callable specialized to concrete args via `jax.export`
+  (StableHLO bytes); where a future jax grows AOT
+  `Compiled.serialize` the probe reports it, but the export path is
+  what both sides of the warm store speak — the serialize/deserialize
+  pair must round-trip within ONE mechanism. All four return
+  None/False instead of raising: a jax too old to export simply
+  leaves the warm store disabled while fresh compiles proceed.
 """
 
 from __future__ import annotations
@@ -86,6 +96,147 @@ def standard_save_args(tree):
     try:
         import orbax.checkpoint as ocp
         return ocp.args.StandardSave(tree)
+    except Exception:
+        return None
+
+
+def has_jax_export() -> bool:
+    """True when this jax can serialize/deserialize exported modules
+    (`jax.export.export` + `jax.export.deserialize`). A pure attribute
+    probe — importing `jax.export` does not initialize a backend."""
+    try:
+        from jax import export as jexport
+        return (hasattr(jexport, "export")
+                and hasattr(jexport, "deserialize"))
+    except Exception:
+        return False
+
+
+def has_aot_serialize() -> bool:
+    """True when jax's AOT `Compiled` stage carries a `serialize`
+    method (post-export jax releases). Informational: the warm store
+    speaks the `jax.export` mechanism everywhere so its blobs stay
+    self-consistent; this probe exists so /statusz can say which
+    mechanisms the runtime offers."""
+    try:
+        import jax.stages
+        return hasattr(jax.stages.Compiled, "serialize")
+    except Exception:
+        return False
+
+
+# Typed-key blob framing: jax.export's flatbuffer serializer has no
+# encoding for extended PRNG-key dtypes (`key<fry>` raises KeyError in
+# _serialize_aval on 0.4.x), so any executable whose inputs or outputs
+# carry a typed key — every training step threading dev.rng_state —
+# would silently never persist. The bridge exports an adapter that
+# speaks raw uint32 key-data at the boundary (wrap_key_data on the way
+# in, key_data on the way out) and frames the blob with the key
+# positions so deserialization can rebuild a transparent wrapper: the
+# caller still passes/receives typed keys and never sees the framing.
+_KEY_BLOB_MAGIC = b"SGXK1"
+
+
+def _key_leaves(tree):
+    """[(flat_leaf_index, impl_name), ...] for every typed-PRNG-key
+    leaf of `tree` (works on concrete arrays and eval_shape structs)."""
+    import jax
+    out = []
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+        dt = getattr(leaf, "dtype", None)
+        try:
+            if dt is not None and jax.dtypes.issubdtype(
+                    dt, jax.dtypes.prng_key):
+                try:
+                    impl = str(dt._impl.name)
+                except Exception:
+                    impl = "threefry2x32"
+                out.append((i, impl))
+        except Exception:
+            pass
+    return out
+
+
+def serialize_executable(fn, args) -> "bytes | None":
+    """`jax.export` blob of jitted `fn` specialized to the concrete
+    `args` tuple, or None when this jax cannot export (old release) or
+    the function resists exporting (e.g. unserializable custom calls)
+    — the caller then builds fresh and skips the store write. Typed
+    PRNG keys in the signature are bridged to raw key-data (see
+    _KEY_BLOB_MAGIC above); note the adapter is a plain jit, so buffer
+    donation declared on `fn` does not survive into the stored module."""
+    try:
+        import json
+        import jax
+        from jax import export as jexport
+        keys_in = _key_leaves(args)
+        out_sds = jax.eval_shape(fn, *args)
+        keys_out = _key_leaves(out_sds)
+        if not keys_in and not keys_out:
+            return jexport.export(fn)(*args).serialize()
+        in_td = jax.tree_util.tree_structure(tuple(args))
+        out_td = jax.tree_util.tree_structure(out_sds)
+
+        def adapter(*raw):
+            ls = list(jax.tree_util.tree_leaves(raw))
+            for i, impl in keys_in:
+                ls[i] = jax.random.wrap_key_data(ls[i], impl=impl)
+            out = fn(*jax.tree_util.tree_unflatten(in_td, ls))
+            ols = list(jax.tree_util.tree_leaves(out))
+            for i, _impl in keys_out:
+                ols[i] = jax.random.key_data(ols[i])
+            return jax.tree_util.tree_unflatten(out_td, ols)
+
+        raw_leaves = list(jax.tree_util.tree_leaves(tuple(args)))
+        for i, _impl in keys_in:
+            raw_leaves[i] = jax.random.key_data(raw_leaves[i])
+        raw_args = jax.tree_util.tree_unflatten(in_td, raw_leaves)
+        fb = jexport.export(jax.jit(adapter))(*raw_args).serialize()
+        header = json.dumps(
+            {"keys_in": keys_in, "keys_out": keys_out}).encode("utf-8")
+        return (_KEY_BLOB_MAGIC + len(header).to_bytes(4, "big")
+                + header + fb)
+    except Exception:
+        return None
+
+
+def deserialize_executable(blob: bytes):
+    """A fresh jit-wrapped callable over the deserialized exported
+    module (`jax.jit(Exported.call)`), or None when the blob does not
+    deserialize on this jax — the warm store treats that as a corrupt
+    entry. Staging the returned callable re-traces only the exported
+    module's call wrapper (depth-independent), and its XLA cache key
+    is stable across processes — the property the warm-start layer's
+    cold path relies on by staging through this same round-trip.
+    Key-framed blobs (see _KEY_BLOB_MAGIC) come back wrapped so the
+    caller passes and receives typed PRNG keys exactly as it would
+    with the original function."""
+    try:
+        import json
+        import jax
+        from jax import export as jexport
+        if not blob[:len(_KEY_BLOB_MAGIC)] == _KEY_BLOB_MAGIC:
+            return jax.jit(jexport.deserialize(blob).call)
+        off = len(_KEY_BLOB_MAGIC)
+        n = int.from_bytes(blob[off:off + 4], "big")
+        header = json.loads(blob[off + 4:off + 4 + n].decode("utf-8"))
+        keys_in = [(int(i), str(impl)) for i, impl in header["keys_in"]]
+        keys_out = [(int(i), str(impl)) for i, impl in header["keys_out"]]
+        exp = jexport.deserialize(blob[off + 4 + n:])
+
+        def call(*a):
+            ls = list(jax.tree_util.tree_leaves(a))
+            td = jax.tree_util.tree_structure(tuple(a))
+            for i, _impl in keys_in:
+                ls[i] = jax.random.key_data(ls[i])
+            out = exp.call(*jax.tree_util.tree_unflatten(td, ls))
+            ols = list(jax.tree_util.tree_leaves(out))
+            otd = jax.tree_util.tree_structure(out)
+            for i, impl in keys_out:
+                ols[i] = jax.random.wrap_key_data(ols[i], impl=impl)
+            return jax.tree_util.tree_unflatten(otd, ols)
+
+        return jax.jit(call)
     except Exception:
         return None
 

@@ -224,6 +224,11 @@ class _Handler(BaseHTTPRequestHandler):
             parts.append(regress.regress_report())
         except Exception as e:
             parts.append(f"(regress unavailable: {e})")
+        try:
+            from . import warmstart
+            parts.append(warmstart.warm_report())
+        except Exception as e:
+            parts.append(f"(warm-start unavailable: {e})")
         mon = self._monitor()
         if mon is None:
             parts.append("== health ==\nno HealthMonitor attached")

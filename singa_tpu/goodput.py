@@ -88,6 +88,11 @@ SPAN_BUCKETS = {
     "serving.beam_decode": BUCKET_STEP,
     "model.build": BUCKET_COMPILE,
     "introspect.build": BUCKET_COMPILE,
+    # warm-store read + deserialize (singa_tpu.warmstart): a warm
+    # restart's disk time is still compile-bucket time — the point of
+    # the warm-start layer is that there is ~none of it, which is
+    # exactly what the cold-vs-warm goodput A/B asserts
+    "introspect.warm_load": BUCKET_COMPILE,
     "model.jit_fallback": BUCKET_COMPILE,
     "data.wait": BUCKET_DATA_WAIT,
     "snapshot.flush": BUCKET_CHECKPOINT,

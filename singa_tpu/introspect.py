@@ -36,6 +36,19 @@ the build-time half of observability, in three parts:
    `capture_hlo(dir)` additionally dumps each executable's HLO text
    (manifest + fingerprint); FlightRecorder bundles reference the
    manifest so an anomaly dump pins the exact executable.
+
+4. **Warm staging (singa_tpu.warmstart).** When the warm store is
+   enabled (`SINGA_TPU_COMPILE_CACHE` / `warmstart.enable`),
+   `build_compiled` looks the (key, signature-fingerprint) pair up in
+   the serialized-executable store before staging
+   (`load_executable`) and, on a fresh build, exports the jitted
+   callable into it (`export_executable`). Both cold and warm builds
+   then stage through the exported module's round-trip, so the XLA
+   persistent cache key is identical across process lifetimes — a
+   restarted replica's "compile" is a disk read. Every lookup result
+   (hit|miss|stale|corrupt) is counted, recorded on the build record,
+   and emitted with the compile/recompile EventLog record. With the
+   store disabled (the default) the staging path is bit-unchanged.
 """
 
 from __future__ import annotations
@@ -467,6 +480,89 @@ def blame_history():
 
 # ---- the AOT build ---------------------------------------------------------
 
+def _sig_fingerprint(key: str, sig: dict) -> str:
+    """16-hex fingerprint of (key, abstract call signature) — the
+    identity executables are manifested, blamed, and warm-stored
+    under. Deliberately signature-based rather than HLO-based: it must
+    be computable BEFORE any staging, so a warm restart can look the
+    store up without first paying the trace the store exists to skip."""
+    return hashlib.sha256(
+        (key + "|" + json.dumps(
+            {"tag": sig.get("tag"), "static": sig.get("static"),
+             "donated": list(sig.get("donated") or ()),
+             "leaves": [[n, list(s), d] for n, s, d in sig["leaves"]]},
+            sort_keys=True, default=str)).encode()).hexdigest()[:16]
+
+
+def _stage(fn, args):
+    """Explicit trace -> lower -> compile of one jitted callable, with
+    per-phase wall timing. Raises whatever the staging machinery
+    raises; callers decide the fallback."""
+    t0 = time.perf_counter()
+    if hasattr(fn, "trace"):
+        traced = fn.trace(*args)
+        t1 = time.perf_counter()
+        lowered = traced.lower()
+    else:
+        # pre-0.4.30 jax: no Traced stage; trace+lower in one call
+        t1 = t0
+        lowered = fn.lower(*args)
+    t2 = time.perf_counter()
+    compiled = lowered.compile()
+    t3 = time.perf_counter()
+    return compiled, {"trace": t1 - t0, "lower": t2 - t1,
+                      "compile": t3 - t2}
+
+
+def export_executable(fn, args, key, fingerprint) -> "bytes | None":
+    """Serialize jitted `fn` specialized to the concrete `args` tuple
+    (jax.export, version-gated in _compat) and write it into the warm
+    store under (key, fingerprint). Returns the blob, or None when the
+    store is disabled, this jax cannot export, the function resists
+    exporting, or the store write fails — in every case the caller
+    simply proceeds without persistence."""
+    from . import _compat, warmstart
+    store = warmstart.get_store()
+    if store is None:
+        return None
+    blob = _compat.serialize_executable(fn, args)
+    if blob is None:
+        return None
+    if store.save(key, fingerprint, blob) is None:
+        return None
+    return blob
+
+
+def load_executable(key, fingerprint, *, count: bool = True):
+    """Load + deserialize the warm-store entry for (key, fingerprint).
+    Returns (callable, result, seconds): the callable is a jit-wrapped
+    deserialized module ready for `_stage` (None unless `result` is
+    "hit"), and result is a member of warmstart.CACHE_RESULTS — or
+    (None, None, 0.0) with the store disabled. Integrity failures
+    (unreadable meta, sha-256 mismatch, undeserializable blob) classify
+    as corrupt; a meta whose fingerprint or jax version does not match
+    classifies as stale; both delete the entry so the fresh rebuild
+    re-exports a clean replacement. With count=False the caller records
+    the classification itself (`build_compiled` does, after staging
+    confirms the artifact actually compiles)."""
+    from . import _compat, warmstart
+    store = warmstart.get_store()
+    if store is None:
+        return None, None, 0.0
+    t0 = time.perf_counter()
+    blob, result = store.load(key, fingerprint)
+    warm_fn = None
+    if blob is not None:
+        warm_fn = _compat.deserialize_executable(blob)
+        if warm_fn is None:
+            result = warmstart.RESULT_CORRUPT
+            store.discard(key, fingerprint)
+    seconds = time.perf_counter() - t0
+    if count:
+        warmstart.note_lookup(key, fingerprint, result, seconds)
+    return warm_fn, result, seconds
+
+
 def build_compiled(fn, args, key, sig=None, device=None):
     """Build `fn` (a jax.jit-wrapped callable) for `args` through the
     explicit trace -> lower -> compile stages.
@@ -477,28 +573,68 @@ def build_compiled(fn, args, key, sig=None, device=None):
     (compiled_executable, build_record). Returns (None, None) when AOT
     staging fails for any reason — the caller falls back to the plain jit
     call, so telemetry can never break dispatch.
+
+    With the warm store enabled (singa_tpu.warmstart), staging goes
+    through the serialized-executable layer: a warm build loads the
+    stored blob and stages its deserialized module (near-zero trace;
+    compile is an XLA persistent-cache disk hit), a cold build exports
+    first and stages the same round-trip so the persistent cache is
+    seeded under the process-stable module key, and any stale/corrupt
+    entry — or a warm artifact that fails to stage — falls back to the
+    fresh path and re-exports. The lookup classification lands on the
+    build record (`warm`) and the EventLog compile record.
     """
+    from . import _compat, warmstart
     if sig is None:
         sig = signature(args)
+    fingerprint = _sig_fingerprint(key, sig)
+    warmstart.maybe_enable_from_env()
+    warm_result = None
+    warm_fn = None
+    load_s = 0.0
+    if warmstart.is_enabled():
+        # separate leaf span, also mapped to the goodput `compile`
+        # bucket: a warm restart's disk time is still compile-bucket
+        # time — there is just ~none of it
+        with observe.span("introspect.warm_load", key=key):
+            warm_fn, warm_result, load_s = load_executable(
+                key, fingerprint, count=False)
+    compiled = phases = None
     # span -> the goodput `compile` bucket (and nets out of any mapped
     # enclosing span, e.g. a first-call model.eval)
     with observe.span("introspect.build", key=key):
-        t0 = time.perf_counter()
-        try:
-            if hasattr(fn, "trace"):
-                traced = fn.trace(*args)
-                t1 = time.perf_counter()
-                lowered = traced.lower()
-            else:
-                # pre-0.4.30 jax: no Traced stage; trace+lower in one call
-                t1 = t0
-                lowered = fn.lower(*args)
-            t2 = time.perf_counter()
-            compiled = lowered.compile()
-            t3 = time.perf_counter()
-        except Exception:
-            return None, None
-    phases = {"trace": t1 - t0, "lower": t2 - t1, "compile": t3 - t2}
+        if warm_fn is not None:
+            try:
+                compiled, phases = _stage(warm_fn, args)
+            except Exception:
+                # deserialized but will not stage on this backend: the
+                # same trust verdict as a bad blob — drop the entry and
+                # rebuild fresh below (which re-exports a replacement)
+                warm_result = warmstart.RESULT_CORRUPT
+                st = warmstart.get_store()
+                if st is not None:
+                    st.discard(key, fingerprint)
+        if compiled is None and warmstart.is_enabled():
+            # cold build WITH the store: export first, then stage the
+            # deserialized round-trip — one compile that (a) proves the
+            # stored blob reproduces, and (b) seeds the XLA persistent
+            # cache with the exact module a warm restart stages (the
+            # exported module's cache key is stable across processes;
+            # the original python callable's is not)
+            blob = export_executable(fn, args, key, fingerprint)
+            rt = _compat.deserialize_executable(blob) if blob else None
+            if rt is not None:
+                try:
+                    compiled, phases = _stage(rt, args)
+                except Exception:
+                    compiled = None
+        if compiled is None:
+            try:
+                compiled, phases = _stage(fn, args)
+            except Exception:
+                return None, None
+    if warm_result is not None:
+        warmstart.note_lookup(key, fingerprint, warm_result, load_s)
     _observe_phase(PHASE_TRACE, key, phases["trace"])
     _observe_phase(PHASE_LOWER, key, phases["lower"])
     _observe_phase(PHASE_COMPILE, key, phases["compile"])
@@ -513,15 +649,10 @@ def build_compiled(fn, args, key, sig=None, device=None):
                       ).set(float(cost.get("bytes accessed", 0.0) or 0.0),
                             key=key)
         _set_hbm_gauges(mem, key)
-    fingerprint = hashlib.sha256(
-        (key + "|" + json.dumps(
-            {"tag": sig.get("tag"), "static": sig.get("static"),
-             "donated": list(sig.get("donated") or ()),
-             "leaves": [[n, list(s), d] for n, s, d in sig["leaves"]]},
-            sort_keys=True, default=str)).encode()).hexdigest()[:16]
     hlo_path = _write_hlo(compiled, key, fingerprint) if _hlo_dir else None
     rec = {"key": key, "fingerprint": fingerprint, "phases": phases,
            "cost": cost, "memory": mem, "hlo_path": hlo_path,
+           "warm": warm_result,
            "ts": round(time.time(), 6)}
     _register_build(key, sig, rec, device=device)
     return compiled, rec
@@ -553,6 +684,10 @@ def _register_build(key, sig, rec, device=None):
             "fingerprint": rec["fingerprint"],
             "phases": {k: round(v, 6) for k, v in rec["phases"].items()},
             "flops": rec["cost"].get("flops"),
+            # warm-store classification (hit|miss|stale|corrupt), None
+            # when the store is disabled — the recompile-blame EventLog
+            # doubles as the warm-start audit trail
+            "warm": rec.get("warm"),
         })
     if key == "step":
         global _step_flops, _step_device_kind
@@ -581,12 +716,17 @@ class AotExecutor:
     jit then (re)traces exactly as it always did; a failed signature is
     negative-cached so the fallback never re-pays staging per call."""
 
-    __slots__ = ("fn", "key", "names", "_execs")
+    __slots__ = ("fn", "key", "names", "donated", "_execs")
 
-    def __init__(self, fn, key, names=None):
+    def __init__(self, fn, key, names=None, donated=()):
         self.fn = fn
         self.key = key
         self.names = names
+        # the jit's donate_argnums, recorded into every signature this
+        # executor registers: donation is part of the compiled module's
+        # identity (input-output aliasing), so the warm store must not
+        # key a donated variant and an undonated one identically
+        self.donated = tuple(donated)
         self._execs = {}
 
     def _sig_key(self, args):
@@ -598,7 +738,8 @@ class AotExecutor:
         k = self._sig_key(args)
         ex = self._execs.get(k, _AOT_MISS)
         if ex is _AOT_MISS:
-            sig = signature(args, names=self.names)
+            sig = signature(args, names=self.names,
+                            donated=self.donated)
             ex, _rec = build_compiled(self.fn, args, self.key, sig)
             self._execs[k] = ex  # None negative-caches failed staging
             if ex is None:
@@ -847,6 +988,7 @@ __all__ = [
     "PEAK_TFLOPS_BF16", "PEAK_HBM_GBS", "chip_peak",
     "set_peak_tflops", "peak_tflops",
     "signature", "blame", "build_compiled", "AotExecutor",
+    "export_executable", "load_executable",
     "note_step_flops",
     "capture_hlo", "executable_manifest", "latest_fingerprint",
     "last_build", "blame_history",

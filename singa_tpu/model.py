@@ -754,6 +754,36 @@ class Model(Layer, metaclass=ModelMeta):
             # staging just failed: the jit dispatch below compiles
             # cold — goodput must book that as compile, not step
             cold_jit = aot is None
+            if entry is not None and "t" not in self._out_template_box:
+                # warm-store hit: the executable came back deserialized,
+                # so the original step fn was never traced and the
+                # out-template side channel is empty. One abstract trace
+                # (no lower/compile) recovers it; snapshot + restore the
+                # state the trace mutates (lower_step's contract) so no
+                # tracer escapes into eager work.
+                dev = self._device
+                opt_obj = self._optimizer
+                snap_state = [t.data for t in self._state_tensors]
+                snap_opt = list(opt_obj.state_arrays()) \
+                    if opt_obj is not None else []
+                snap_rng = dev.rng_state
+                snap_training = autograd.training
+                try:
+                    jax.eval_shape(fn, state_arrs, opt_arrs, rng,
+                                   input_arrs)
+                except Exception:
+                    # template unrecoverable: drop the warm variant and
+                    # let plain jit own the signature — its first
+                    # dispatch traces the fn and fills the box
+                    entry = self._step_execs[exec_key] = None
+                    cold_jit = True
+                finally:
+                    autograd.training = snap_training
+                    dev.rng_state = snap_rng
+                    for t, a in zip(self._state_tensors, snap_state):
+                        t.data = a
+                    if opt_obj is not None and snap_opt:
+                        opt_obj.load_state_arrays(snap_opt)
         if entry is not None:
             step_fn, flops = entry
         else:
@@ -984,6 +1014,21 @@ class Model(Layer, metaclass=ModelMeta):
                 (concrete, arrs), names=("state", "arg"), batch_hint=nb)
             aot, _rec = introspect.build_compiled(
                 self._compiled_eval, (concrete, arrs), "eval", asig)
+            if aot is not None and \
+                    not hasattr(self, "_eval_template"):
+                # warm-store hit: efwd was never traced, so the eval
+                # out-template side channel is empty — one abstract
+                # trace recovers it (same contract as the step path;
+                # efwd's only other side effects are the trace counter
+                # and state-tensor assignments restored below)
+                snap_state = [t.data for t in self._eval_tensors]
+                try:
+                    jax.eval_shape(self._compiled_eval, concrete, arrs)
+                except Exception:
+                    aot = None  # jit owns it: first dispatch traces
+                finally:
+                    for t, a in zip(self._eval_tensors, snap_state):
+                        t.data = a
             # None negative-caches a failed build: jit owns this shape
             self._eval_execs[key] = aot
             if aot is None:

@@ -272,6 +272,13 @@ def build_manifest(model, step: int, status: str = "ok",
             for e in introspect.executable_manifest()[-8:]]
     except Exception:
         pass
+    warm_store = None
+    try:
+        from . import warmstart
+        if warmstart.is_enabled():
+            warm_store = warmstart.get_store().root
+    except Exception:
+        pass
     man = {
         "kind": "singa_ckpt_manifest",
         "version": MANIFEST_VERSION,
@@ -282,6 +289,10 @@ def build_manifest(model, step: int, status: str = "ok",
         "params": param_signature(model),
         "n_opt_slots": len(opt.state_arrays()) if opt is not None else 0,
         "hlo_fingerprints": fingerprints,
+        # the warm-store root this run compiled against: resume()
+        # re-enables it so the restarted run re-stages its executables
+        # from disk instead of re-compiling (zero-compile restart)
+        "warm_store": warm_store,
     }
     if extra:
         man.update(extra)
@@ -791,6 +802,21 @@ class TrainController:
             self._last_saved_step = self._step
             self._last_ckpt_path = path
             m["resumed_step"].set(float(self._step))
+            # re-join the warm store the dead run compiled against (a
+            # restart's builds then load serialized executables instead
+            # of re-compiling). An explicit enable() made before resume
+            # wins; a store that vanished with the dead machine is
+            # skipped, never fatal — resume must not die on a cache.
+            ws = man.get("warm_store")
+            if ws:
+                try:
+                    from . import warmstart
+                    if not warmstart.is_enabled() \
+                            and os.path.isdir(ws):
+                        warmstart.enable(ws)
+                        self._emit("warm_store_rejoined", root=ws)
+                except Exception:
+                    pass
             import jax
             saved = (man.get("mesh") or {}).get("n_devices")
             live = len(jax.devices())

@@ -1404,13 +1404,22 @@ def _replica_main(args) -> int:
     build/warm report pure non-XLA wall time."""
     t_entry = time.time()
     t0 = time.time()
-    from . import diag, engine, fleet, introspect, resilience, slo
+    from . import diag, engine, fleet, introspect, resilience, slo, \
+        warmstart
     startup = {"import": time.time() - t0}
     spawned_at = getattr(args, "spawned_at", None)
     if spawned_at is not None:
         startup["spawn"] = max(0.0, t_entry - float(spawned_at))
     observe.enable(True)
     observe.enable_span_records()
+    # warm store BEFORE any staged build: with --warm-dir every
+    # executable this replica compiles lands in (or loads from) the
+    # shared store, so a restart — watchdog, resilience, or scale-up —
+    # re-stages from disk instead of re-compiling
+    if getattr(args, "warm_dir", None):
+        warmstart.enable(args.warm_dir)
+    else:
+        warmstart.maybe_enable_from_env()
     T = args.prompt_hi + args.new_hi
     c0 = introspect.compile_phase_totals()
     t0 = time.time()
@@ -1425,17 +1434,7 @@ def _replica_main(args) -> int:
     # executable) BEFORE announcing ready: the router's p99 TTFT must
     # measure serving, not XLA compiles
     t0 = time.time()
-    first_token_wall = None
-    for b in sorted({eng._bucket(s)
-                     for s in (args.prompt_lo, args.prompt_hi)}):
-        w = eng.submit(np.zeros(min(b, T - 2), np.int32) + 1, 2)
-        if not w.wait(600):
-            raise RuntimeError(f"replica warmup (bucket {b}) stalled")
-        if first_token_wall is None \
-                and w.first_token_ts is not None:
-            # engine stamps are monotonic; shift onto the wall clock
-            first_token_wall = float(w.first_token_ts) \
-                + (time.time() - time.monotonic())
+    _, first_token_wall = eng.prewarm((args.prompt_lo, args.prompt_hi))
     warm_wall = time.time() - t0
     c2 = introspect.compile_phase_totals()
     build_xla = sum(max(0.0, c1[p] - c0[p])
@@ -1505,6 +1504,14 @@ def _replica_main(args) -> int:
     if spawned_at is not None and first_token_wall is not None:
         ready["spawn_to_first_token_s"] = round(
             first_token_wall - float(spawned_at), 6)
+    if warmstart.is_enabled():
+        # the parent's warm A/B reads these to prove the child really
+        # loaded from the store (hits) vs compiled fresh (misses)
+        ws = warmstart.snapshot()
+        ready["warm"] = {
+            "root": ws["root"], "lookups": ws["lookups"],
+            "hit_rate": ws["hit_rate"], "exports": ws["exports"],
+            "entries": ws["entries"]}
     print(json.dumps(ready), flush=True)
     try:
         while not ctl.shutdown_evt.wait(0.2):
@@ -1551,6 +1558,10 @@ def spawn_replica(name: str, fleet_dir: str, args, *,
         cmd += ["--audit-interval", str(args.audit_interval)]
     if getattr(args, "corrupt_after", 0):
         cmd += ["--corrupt-after", str(args.corrupt_after)]
+    if getattr(args, "warm_dir", None):
+        # ship the warm store: the child loads serialized executables
+        # instead of compiling, so restarts/scale-ups reach ready fast
+        cmd += ["--warm-dir", str(args.warm_dir)]
     proc = subprocess.Popen(cmd, cwd=root, env=env,
                             stdout=subprocess.PIPE, stderr=sys.stderr,
                             text=True)
@@ -1958,6 +1969,150 @@ def _ab_main(args) -> int:
     return 0 if rec["ok"] else 1
 
 
+# ---- the cold-vs-warm spawn A/B ---------------------------------------------
+
+def _warm_probe(ctl_port: int, args, rid: int = 1) -> "list[int]":
+    """One seeded deterministic probe against a replica's control
+    surface; returns its greedy tokens. Run against both A/B arms, the
+    token lists must be identical — executables loaded from the warm
+    store must compute exactly what fresh compiles compute."""
+    rng = np.random.RandomState(int(args.seed))
+    prompt = rng.randint(1, int(args.vocab),
+                         size=max(1, int(args.prompt_lo))).tolist()
+    deadline = time.monotonic() + float(args.timeout)
+    while True:
+        out = _http_json(f"http://127.0.0.1:{ctl_port}/submit",
+                         {"rid": int(rid), "prompt": prompt,
+                          "max_new": max(1, min(8, int(args.new_hi))),
+                          "wait_s": 10.0}, timeout=30.0)
+        if out.get("outcome") == "completed":
+            return [int(t) for t in out["tokens"]]
+        if out.get("outcome") != "pending":
+            raise RuntimeError(f"warm A/B probe failed: {out}")
+        if time.monotonic() > deadline:
+            raise RuntimeError("warm A/B probe timed out")
+
+
+def _warm_ab_main(args) -> int:
+    """The zero-compile-restart A/B: spawn a COLD replica against an
+    empty warm store (every staged executable compiles fresh and is
+    exported), shut it down, then spawn a WARM replica — a genuinely
+    fresh Python process — against the SAME store. The warm arm must
+    prove, from the outside:
+
+      * its staged builds were store HITS across the process boundary
+        (and the cold arm's were misses that exported),
+      * its XLA compile seconds collapsed to <= --warm-compile-frac of
+        the cold arm's,
+      * its spawn-to-first-token beat cold by >= --warm-speedup, and
+      * a fixed seeded probe decodes token-identical tokens on both
+        arms — loading serialized executables must not change what the
+        model computes.
+
+    Writes the JSONL artifact (metric rows + a final rec with "ok") to
+    args.out. The `spawn_to_first_token_s` / `compile_cache_hit_rate`
+    rows feed tools/bench_trend.py's regression tracking."""
+    import shutil
+    import tempfile
+    from types import SimpleNamespace
+
+    workdir = tempfile.mkdtemp(prefix="singa-warmab-")
+    fleet_dir = os.path.join(workdir, "spool")
+    os.makedirs(fleet_dir, exist_ok=True)
+    cargs = SimpleNamespace(**vars(args))
+    cargs.warm_dir = os.path.join(workdir, "warmstore")
+    cargs.fault_delay = 0.0
+    cargs.corrupt_after = 0
+    arms = {}
+    try:
+        for arm, name in (("cold", "w0"), ("warm", "w1")):
+            print(f"[warm-ab] spawning {arm} replica {name} "
+                  f"(store: {cargs.warm_dir})", file=sys.stderr)
+            proc, ready = spawn_replica(
+                name, fleet_dir, cargs, ready_timeout_s=args.timeout)
+            try:
+                toks = _warm_probe(ready["ctl_port"], args)
+            finally:
+                try:
+                    _http_json(
+                        f"http://127.0.0.1:{ready['ctl_port']}"
+                        "/shutdown", {}, timeout=10.0)
+                    proc.wait(timeout=30.0)
+                except Exception:
+                    proc.kill()
+                    proc.wait(timeout=10.0)
+            arms[arm] = {"ready": ready, "tokens": toks}
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    cold, warm = arms["cold"]["ready"], arms["warm"]["ready"]
+    c_compile = float(cold.get("startup", {}).get("compile", 0.0))
+    w_compile = float(warm.get("startup", {}).get("compile", 0.0))
+    c_sft = cold.get("spawn_to_first_token_s")
+    w_sft = warm.get("spawn_to_first_token_s")
+    c_look = (cold.get("warm") or {}).get("lookups") or {}
+    w_look = (warm.get("warm") or {}).get("lookups") or {}
+    hit_rate = (warm.get("warm") or {}).get("hit_rate")
+    # the floor keeps the frac check meaningful when the model is so
+    # small that cold compile itself is noise-level
+    frac = w_compile / max(c_compile, 1e-9)
+    speedup = (float(c_sft) / float(w_sft)
+               if c_sft and w_sft and float(w_sft) > 0 else 0.0)
+    checks = {
+        "cold_exported": (cold.get("warm") or {}).get("exports", 0) > 0,
+        "cold_no_hits": int(c_look.get("hit", 0)) == 0,
+        "warm_hits_across_process": int(w_look.get("hit", 0)) > 0,
+        "warm_no_fallbacks": sum(
+            int(w_look.get(k, 0))
+            for k in ("miss", "stale", "corrupt")) == 0,
+        "warm_compile_frac_ok":
+            frac <= float(args.warm_compile_frac),
+        "warm_spawn_speedup_ok":
+            speedup >= float(args.warm_speedup),
+        "tokens_match":
+            arms["cold"]["tokens"] == arms["warm"]["tokens"],
+    }
+    rec = {
+        "bench": "router_warm_ab", "schema": 1,
+        "seed": int(args.seed),
+        "model": {"vocab": int(args.vocab), "dim": int(args.dim),
+                  "layers": int(args.layers)},
+        "thresholds": {
+            "warm_compile_frac": float(args.warm_compile_frac),
+            "warm_speedup": float(args.warm_speedup)},
+        "cold": {"startup": cold.get("startup"),
+                 "spawn_to_first_token_s": c_sft,
+                 "warm": cold.get("warm")},
+        "warm": {"startup": warm.get("startup"),
+                 "spawn_to_first_token_s": w_sft,
+                 "warm": warm.get("warm")},
+        "compile_frac": round(frac, 6),
+        "spawn_speedup": round(speedup, 6),
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    lines = [
+        {"metric": "warmab_cold_compile_s", "value": c_compile,
+         "unit": "s"},
+        {"metric": "warmab_warm_compile_s", "value": w_compile,
+         "unit": "s"},
+        {"metric": "spawn_to_first_token_cold_s",
+         "value": float(c_sft or 0.0), "unit": "s"},
+        {"metric": "spawn_to_first_token_s",
+         "value": float(w_sft or 0.0), "unit": "s"},
+        {"metric": "compile_cache_hit_rate",
+         "value": float(hit_rate or 0.0), "unit": "ratio"},
+        {"metric": "warmab_spawn_speedup", "value": float(speedup),
+         "unit": "ratio"},
+        rec,
+    ]
+    with open(args.out, "w", encoding="utf-8") as f:
+        for obj in lines:
+            f.write(json.dumps(obj, sort_keys=True) + "\n")
+    print(json.dumps(rec, indent=2, sort_keys=True))
+    return 0 if rec["ok"] else 1
+
+
 # ---- CLI --------------------------------------------------------------------
 
 def main(argv=None) -> int:
@@ -1965,9 +2120,14 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m singa_tpu.router",
         description="serving control plane: --replica runs one serving "
-                    "replica; --ab runs the kill-and-replace harness")
+                    "replica; --ab runs the kill-and-replace harness; "
+                    "--warm-ab runs the cold-vs-warm spawn A/B")
     p.add_argument("--replica", action="store_true")
     p.add_argument("--ab", action="store_true")
+    p.add_argument("--warm-ab", action="store_true",
+                   help="spawn a cold replica against an empty warm "
+                        "store, then a warm one against the same store; "
+                        "prove zero-compile restart (see _warm_ab_main)")
     p.add_argument("--name", default="r0")
     p.add_argument("--fleet-dir", default=None)
     p.add_argument("--replicas", type=int, default=3)
@@ -2003,16 +2163,31 @@ def main(argv=None) -> int:
                         "Nth fingerprint tick via fault_point("
                         "'audit.corrupt_params') — the audit --ab "
                         "corrupt arm's SDC injection")
+    p.add_argument("--warm-dir", default=None,
+                   help="warm-store root: replicas persist serialized "
+                        "executables + the XLA compile cache here and "
+                        "load them on restart (replica/--ab modes; "
+                        "--warm-ab manages its own store)")
+    p.add_argument("--warm-compile-frac", type=float, default=0.10,
+                   help="--warm-ab: warm arm's XLA compile seconds "
+                        "must be <= this fraction of the cold arm's")
+    p.add_argument("--warm-speedup", type=float, default=3.0,
+                   help="--warm-ab: warm spawn-to-first-token must "
+                        "beat cold by at least this factor")
     p.add_argument("--timeout", type=float, default=600.0)
-    p.add_argument("--out", default="SERVE_r01.json")
+    p.add_argument("--out", default=None)
     args = p.parse_args(argv)
+    if args.out is None:
+        args.out = "WARM_r01.json" if args.warm_ab else "SERVE_r01.json"
     if args.replica:
         if not args.fleet_dir:
             p.error("--replica needs --fleet-dir")
         return _replica_main(args)
+    if args.warm_ab:
+        return _warm_ab_main(args)
     if args.ab:
         return _ab_main(args)
-    p.error("pick a mode: --replica or --ab")
+    p.error("pick a mode: --replica, --ab, or --warm-ab")
     return 2
 
 

@@ -997,7 +997,12 @@ def build_decode(m, B, S0, max_new, temperature, top_k,
     # signature is built through explicit trace/lower/compile stages, so
     # serving compiles land in singa_compile_phase_seconds and a rebuilt
     # decode fn (new batch/prompt/max_new) produces a recompile-blame
-    # record instead of a silent jit retrace
+    # record instead of a silent jit retrace. With the warm store
+    # enabled (singa_tpu.warmstart), each build also persists its
+    # serialized executable keyed by this name + abstract-signature
+    # fingerprint — a restarted process (replica respawn, resilience
+    # resume) re-stages these same serving executables from disk and
+    # its compile phase collapses to near zero
     from . import introspect
     prefill_jit = introspect.AotExecutor(
         jax.jit(prefill_stage), "serving.prefill",

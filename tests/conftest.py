@@ -54,7 +54,14 @@ def _metrics_isolation():
     thread alive, and no stray non-daemon thread behind."""
     from singa_tpu import (audit, capacity, diag, engine, fleet,
                            goodput, health, introspect, memory,
-                           observe, regress, router, slo, watchdog)
+                           observe, regress, router, slo, warmstart,
+                           watchdog)
+    # warm-store isolation: an ambient SINGA_TPU_COMPILE_CACHE (set by
+    # an operator shell) must not leak a shared on-disk cache into the
+    # suite — pop it for the test's duration and restore on teardown;
+    # warmstart.reset() also detaches the XLA persistent-cache config
+    _warm_env = os.environ.pop("SINGA_TPU_COMPILE_CACHE", None)
+    warmstart.reset()
     diag.stop_diag_server()
     goodput.uninstall()
     audit.reset()
@@ -236,6 +243,15 @@ def _metrics_isolation():
     assert not stray_prefetch, (
         f"prefetcher thread(s) leaked: {stray_prefetch} — close() the "
         "DevicePrefetcher (Model.fit does this on every exit path)")
+    # warm-store teardown (ISSUE-20): the store disabled, its lookup
+    # ring/counters cleared, and the process-wide XLA persistent-cache
+    # config detached — a test that enabled a per-test cache dir must
+    # not leave later tests silently writing compile artifacts into it.
+    # warmstart spawns no threads, so the generic sweep below needs no
+    # dedicated prefix; the env var popped at setup is restored here.
+    warmstart.reset()
+    if _warm_env is not None:
+        os.environ["SINGA_TPU_COMPILE_CACHE"] = _warm_env
     stray = [t.name for t in threading.enumerate()
              if t.is_alive() and t is not threading.main_thread()
              and not t.daemon

@@ -468,3 +468,44 @@ def test_audit_fixture_regressions_flagged():
     rnd, v, best_r, best, delta = regs["audit_false_positive_count"]
     assert (v, best) == (2.0, 0.0) and delta == float("inf")
     assert "audit_lost_requests" not in regs
+
+
+def test_warm_start_metrics_directions():
+    """ISSUE-20 satellite: the warm-store's `hit_rate` is
+    higher-is-better — a restart that compiles where it used to load
+    regresses DOWN — while `spawn_to_first_token_s` keeps the `spawn`
+    lower-better rule even when written unit-less; rate units still
+    win over both."""
+    assert not bench_trend.lower_is_better("compile_cache_hit_rate",
+                                           "ratio")
+    assert not bench_trend.lower_is_better("compile_cache_hit_rate", "")
+    assert bench_trend.lower_is_better("spawn_to_first_token_s", "s")
+    assert bench_trend.lower_is_better("spawn_to_first_token_cold_s", "")
+    assert bench_trend.lower_is_better("warmab_warm_compile_s", "s")
+    assert not bench_trend.lower_is_better("cache_hits_per_s", "items/s")
+
+
+def test_warm_fixture_regressions_flagged():
+    """The checked-in WARM fixture rounds: clean/ improves
+    spawn-to-first-token (1.2 -> 1.15) at a held 1.0 hit rate (no
+    flags); regress/ slows the warm spawn (1.2 -> 1.8, flagged UP) and
+    halves the hit rate (1.0 -> 0.5, flagged DOWN), both against the
+    best prior round."""
+    clean = bench_trend.trend_table(bench_trend.collect([CLEAN]))
+    assert clean["spawn_to_first_token_s"]["by_round"] == {1: 1.2,
+                                                          2: 1.15}
+    assert clean["compile_cache_hit_rate"]["by_round"] == {1: 1.0,
+                                                           2: 1.0}
+    assert not [r for r in bench_trend.find_regressions(clean)
+                if r[0] in ("spawn_to_first_token_s",
+                            "compile_cache_hit_rate")]
+    table = bench_trend.trend_table(bench_trend.collect([REGRESS]))
+    regs = {m: (rnd, v, best_r, best, delta)
+            for m, rnd, v, best_r, best, delta
+            in bench_trend.find_regressions(table, threshold=0.05)}
+    rnd, v, best_r, best, delta = regs["spawn_to_first_token_s"]
+    assert (rnd, v, best_r, best) == (2, 1.68, 1, 1.2)
+    assert abs(delta - 0.4) < 1e-9
+    rnd, v, best_r, best, delta = regs["compile_cache_hit_rate"]
+    assert (rnd, v, best_r, best) == (2, 0.7, 1, 1.0)
+    assert abs(delta - 0.3) < 1e-9

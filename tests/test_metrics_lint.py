@@ -847,3 +847,51 @@ def test_leg_and_audit_verdict_label_rules(tmp_path):
     assert any("'teleportation'" in p for p in problems)
     assert any("'maybe'" in p for p in problems)
     assert any("dynamic" in p for p in problems)
+
+
+def test_lint_covers_warmstart_metric_names():
+    """ISSUE-20: rule 5 extends to the warm store's `result=` label —
+    CACHE_RESULTS is recognized as the declared enum tuple, every
+    singa_compile_cache_* registration in warmstart.py passes the full
+    lint, and the family carries the counter/gauge/histogram split the
+    warm-start observatory documents."""
+    ws_py = os.path.join(check_metrics_names.ROOT, "singa_tpu",
+                         "warmstart.py")
+    names = {n for n, _t, _h, _l
+             in check_metrics_names.registrations_in(ws_py)}
+    assert {"singa_compile_cache_lookups_total",
+            "singa_compile_cache_exports_total",
+            "singa_compile_cache_evictions_total",
+            "singa_compile_cache_entries",
+            "singa_compile_cache_store_bytes",
+            "singa_compile_cache_load_seconds"} <= names
+    assert all(n.startswith("singa_compile_cache_") for n in names)
+    assert check_metrics_names.check([ws_py]) == []
+    import ast
+    enums, _consts = check_metrics_names._module_enum_info(
+        ast.parse(open(ws_py).read()))
+    assert enums["CACHE_RESULTS"] == ("hit", "miss", "stale", "corrupt")
+    assert "result" in check_metrics_names.ENUM_LABEL_KWARGS
+
+
+def test_result_label_rule(tmp_path):
+    """A result= literal outside the declared CACHE_RESULTS enum is a
+    violation; members, resolved constants, and enum-guarded dynamic
+    values — warmstart.py's `assert result in CACHE_RESULTS` shape —
+    pass, unguarded dynamics fail."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "CACHE_RESULTS = ('hit', 'miss', 'stale', 'corrupt')\n"
+        "RESULT_HIT = 'hit'\n"
+        "observe.counter('singa_c_total', 'a').inc(result='hit')\n"
+        "observe.counter('singa_c_total', 'a').inc(result=RESULT_HIT)\n"
+        "observe.counter('singa_c_total', 'a').inc(result='expired')\n"
+        "def guarded(result):\n"
+        "    assert result in CACHE_RESULTS\n"
+        "    observe.counter('singa_c_total', 'a').inc(result=result)\n"
+        "def unguarded(result):\n"
+        "    observe.counter('singa_c_total', 'a').inc(result=result)\n")
+    problems = check_metrics_names.check([str(f)])
+    assert len(problems) == 2, problems
+    assert any("'expired'" in p for p in problems)
+    assert any("dynamic" in p for p in problems)

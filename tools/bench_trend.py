@@ -104,8 +104,11 @@ LOWER_BETTER_SUBSTRINGS = ("ttft", "dropped", "lost", "failover",
 #: `acceptance` rates (BENCHDEC_r07's spec records) likewise regress
 #: DOWN even when written unit-less or percentile-suffixed; capacity
 #: `headroom` fractions (CAPACITY_rNN) regress DOWN too — shrinking
-#: headroom at the same load is the capacity regression
-HIGHER_BETTER_SUBSTRINGS = ("attainment", "accept", "headroom")
+#: headroom at the same load is the capacity regression; `hit_rate` is
+#: the warm-store's compile_cache_hit_rate (WARM_rNN), where a restart
+#: that compiles where it used to load regresses DOWN
+HIGHER_BETTER_SUBSTRINGS = ("attainment", "accept", "headroom",
+                            "hit_rate")
 
 
 def parse_records(path: str, family: str):

@@ -19,8 +19,8 @@ literal, then fails if
      every name must describe itself), or
   5. a `reason=` / `phase=` / `bucket=` / `region=` / `op=` /
      `outcome=` / `objective=` / `kv_dtype=` / `verdict=` /
-     `replica=` / `attr=` / `decision=` / `leg=` / `cause=` label
-     value on a metric record call
+     `replica=` / `attr=` / `decision=` / `leg=` / `cause=` /
+     `result=` label value on a metric record call
      (.inc/.set/.observe/.dec) does not come from a declared enum: these
      labels are CONTRACTUALLY low-cardinality (introspect.py's
      RECOMPILE_REASONS / COMPILE_PHASES, goodput.py's GOODPUT_BUCKETS,
@@ -44,7 +44,9 @@ literal, then fails if
      replay and its `verdict=` values exactly match / mismatch /
      error — and regress.py's REGRESS_CAUSES — the regression
      observatory's `cause=` values are exactly compile /
-     workload_shift / contention / host / unknown),
+     workload_shift / contention / host / unknown — and warmstart.py's
+     CACHE_RESULTS — the warm-store lookup counter's `result=` values
+     are exactly hit / miss / stale / corrupt),
      so a string literal must be a
      member of a module-level ALL-CAPS tuple of string literals, a NAME
      must be a module-level constant whose value is a member, and a
@@ -149,10 +151,13 @@ def registrations_in(path, tree=None):
 # reason= values from capacity.py's DECISION_REASONS; leg: audit.py's
 # AUDIT_LEGS, with the correctness observatory's verdict= values from
 # audit.py's AUDIT_VERDICTS; cause: regress.py's REGRESS_CAUSES — the
-# regression observatory's attributed-cause enum).
+# regression observatory's attributed-cause enum; result: warmstart.py's
+# CACHE_RESULTS — the warm-store lookup classification
+# hit|miss|stale|corrupt).
 ENUM_LABEL_KWARGS = ("reason", "phase", "bucket", "region", "op",
                      "outcome", "objective", "kv_dtype", "verdict",
-                     "replica", "attr", "decision", "leg", "cause")
+                     "replica", "attr", "decision", "leg", "cause",
+                     "result")
 RECORD_FUNCS = {"inc", "set", "observe", "dec"}
 
 # Rule 6: `host=` label values must originate in the cluster topology.
